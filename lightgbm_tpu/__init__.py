@@ -1,0 +1,2 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework."""
+__version__ = "0.1.0"

@@ -1,0 +1,453 @@
+"""Feature binning: value -> bin mapping construction.
+
+TPU-native analog of the reference BinMapper (ref: include/LightGBM/bin.h:61-218,
+src/io/bin.cpp:78-520).  Behavior-equivalent re-implementation in vectorized
+numpy: greedy equal-count bin finding honoring ``min_data_in_bin``, the
+zero-as-one-bin partition around ``kZeroThreshold``, NaN handling as an extra
+last bin, categorical vocabularies sorted by count, forced bin bounds, trivial
+feature pre-filtering, and the default/most-frequent-bin bookkeeping used by
+the histogram FixHistogram trick.
+
+Binning runs on host (numpy) — the reference also does this on CPU during
+dataset loading — while the resulting ``[num_rows, num_features]`` bin matrix
+is what lives in TPU HBM.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .utils import log
+
+# ref: include/LightGBM/meta.h:54
+K_ZERO_THRESHOLD = 1e-35
+# ref: include/LightGBM/bin.h:39
+K_SPARSE_THRESHOLD = 0.7
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+_MISSING_TYPE_STR = {MISSING_NONE: "None", MISSING_ZERO: "Zero", MISSING_NAN: "NaN"}
+_MISSING_TYPE_FROM_STR = {v: k for k, v in _MISSING_TYPE_STR.items()}
+
+
+def _next_after(a: float) -> float:
+    # ref: utils/common.h:855 GetDoubleUpperBound
+    return math.nextafter(a, math.inf)
+
+
+def _double_equal_ordered(a: float, b: float) -> bool:
+    # ref: utils/common.h:850 CheckDoubleEqualOrdered
+    return b <= math.nextafter(a, math.inf)
+
+
+def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
+                    max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Equal-count greedy bin boundary search over a sorted distinct-value
+    histogram (behavioral analog of ref: src/io/bin.cpp:78 GreedyFindBin).
+
+    Values with count >= mean bin size get dedicated bins; the rest are packed
+    greedily to roughly equal counts.  Returns bin upper bounds ending in +inf.
+    """
+    n = len(distinct_values)
+    bounds: List[float] = []
+    if max_bin <= 0:
+        log.fatal("max_bin must be positive")
+    if n <= max_bin:
+        cur_cnt = 0
+        for i in range(n - 1):
+            cur_cnt += counts[i]
+            if cur_cnt >= min_data_in_bin:
+                val = _next_after((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bounds or not _double_equal_ordered(bounds[-1], val):
+                    bounds.append(val)
+                    cur_cnt = 0
+        bounds.append(math.inf)
+        return bounds
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+
+    big = [counts[i] >= mean_bin_size for i in range(n)]
+    rest_bins = max_bin - sum(big)
+    rest_cnt = total_cnt - sum(c for c, b in zip(counts, big) if b)
+    mean_bin_size = rest_cnt / rest_bins if rest_bins > 0 else math.inf
+
+    uppers: List[float] = []
+    lowers: List[float] = [distinct_values[0]]
+    cur_cnt = 0
+    for i in range(n - 1):
+        if not big[i]:
+            rest_cnt -= counts[i]
+        cur_cnt += counts[i]
+        need_new = (big[i] or cur_cnt >= mean_bin_size
+                    or (big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5)))
+        if need_new:
+            uppers.append(distinct_values[i])
+            lowers.append(distinct_values[i + 1])
+            if len(uppers) >= max_bin - 1:
+                break
+            cur_cnt = 0
+            if not big[i]:
+                rest_bins -= 1
+                mean_bin_size = rest_cnt / rest_bins if rest_bins > 0 else math.inf
+
+    for i in range(len(uppers)):
+        val = _next_after((uppers[i] + lowers[i + 1]) / 2.0)
+        if not bounds or not _double_equal_ordered(bounds[-1], val):
+            bounds.append(val)
+    bounds.append(math.inf)
+    return bounds
+
+
+def _split_zero_counts(distinct_values, counts):
+    left_cnt_data = cnt_zero = right_cnt_data = 0
+    for v, c in zip(distinct_values, counts):
+        if v <= -K_ZERO_THRESHOLD:
+            left_cnt_data += c
+        elif v > K_ZERO_THRESHOLD:
+            right_cnt_data += c
+        else:
+            cnt_zero += c
+    return left_cnt_data, cnt_zero, right_cnt_data
+
+
+def find_bin_zero_as_one(distinct_values: List[float], counts: List[int],
+                         max_bin: int, total_cnt: int,
+                         min_data_in_bin: int) -> List[float]:
+    """Numerical bin bounds with a dedicated zero bin (ref: bin.cpp:256)."""
+    n = len(distinct_values)
+    left_cnt_data, cnt_zero, right_cnt_data = _split_zero_counts(
+        distinct_values, counts)
+
+    left_cnt = next((i for i in range(n)
+                     if distinct_values[i] > -K_ZERO_THRESHOLD), n)
+
+    bounds: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        denom = total_cnt - cnt_zero
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1))) \
+            if denom > 0 else 1
+        bounds = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                                 left_max_bin, left_cnt_data, min_data_in_bin)
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+
+    right_start = next((i for i in range(left_cnt, n)
+                        if distinct_values[i] > K_ZERO_THRESHOLD), -1)
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        right = greedy_find_bin(distinct_values[right_start:],
+                                counts[right_start:], right_max_bin,
+                                right_cnt_data, min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right)
+    else:
+        bounds.append(math.inf)
+    return bounds
+
+
+def find_bin_with_forced(distinct_values: List[float], counts: List[int],
+                         max_bin: int, total_cnt: int, min_data_in_bin: int,
+                         forced_bounds: List[float]) -> List[float]:
+    """Numerical bin bounds honoring user-forced boundaries
+    (ref: bin.cpp:157 FindBinWithPredefinedBin)."""
+    n = len(distinct_values)
+    left_cnt = next((i for i in range(n)
+                     if distinct_values[i] > -K_ZERO_THRESHOLD), n)
+    right_start = next((i for i in range(left_cnt, n)
+                        if distinct_values[i] > K_ZERO_THRESHOLD), -1)
+
+    bounds: List[float] = []
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if left_cnt == 0 else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bounds.append(K_ZERO_THRESHOLD)
+    bounds.append(math.inf)
+
+    max_to_insert = max_bin - len(bounds)
+    inserted = 0
+    for fb in forced_bounds:
+        if inserted >= max_to_insert:
+            break
+        if abs(fb) > K_ZERO_THRESHOLD:
+            bounds.append(fb)
+            inserted += 1
+    bounds.sort()
+
+    free_bins = max_bin - len(bounds)
+    to_add: List[float] = []
+    value_ind = 0
+    for i, ub in enumerate(bounds):
+        cnt_in_bin = 0
+        bin_start = value_ind
+        while value_ind < n and distinct_values[value_ind] < ub:
+            cnt_in_bin += counts[value_ind]
+            value_ind += 1
+        bins_remaining = max_bin - len(bounds) - len(to_add)
+        num_sub = min(round(cnt_in_bin * free_bins / total_cnt), bins_remaining) + 1
+        if i == len(bounds) - 1:
+            num_sub = bins_remaining + 1
+        sub = greedy_find_bin(distinct_values[bin_start:value_ind],
+                              counts[bin_start:value_ind], num_sub,
+                              cnt_in_bin, min_data_in_bin)
+        to_add.extend(sub[:-1])  # last bound is inf
+    bounds.extend(to_add)
+    bounds.sort()
+    if len(bounds) > max_bin:
+        log.fatal("forced bins produced more than max_bin bounds")
+    return bounds
+
+
+class BinMapper:
+    """Per-feature value→bin mapping (ref: include/LightGBM/bin.h:61)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.missing_type: int = MISSING_NONE
+        self.is_trivial: bool = True
+        self.sparse_rate: float = 1.0
+        self.bin_type: int = BIN_NUMERICAL
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0
+        self.most_freq_bin: int = 0
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int, min_split_data: int, pre_filter: bool,
+                 bin_type: int, use_missing: bool, zero_as_missing: bool,
+                 forced_bounds: Optional[List[float]] = None) -> None:
+        """Construct the mapping from non-zero sampled ``values``
+        (behavioral analog of ref: src/io/bin.cpp:325 BinMapper::FindBin).
+
+        ``total_sample_cnt`` includes implicit zeros not present in ``values``.
+        """
+        forced_bounds = forced_bounds or []
+        values = np.asarray(values, dtype=np.float64)
+        finite = values[~np.isnan(values)]
+        na_cnt = 0
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        else:
+            if finite.size == values.size:
+                self.missing_type = MISSING_NONE
+            else:
+                self.missing_type = MISSING_NAN
+                na_cnt = values.size - finite.size
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - finite.size - na_cnt)
+
+        # distinct values with zero inserted at its sorted position, merging
+        # float-equal neighbors (keeping the larger; ref: bin.cpp:357-389)
+        sv = np.sort(finite, kind="stable")
+        distinct: List[float] = []
+        counts: List[int] = []
+        if sv.size == 0 or (sv[0] > 0.0 and zero_cnt > 0):
+            distinct.append(0.0)
+            counts.append(zero_cnt)
+        for i, v in enumerate(sv):
+            if i == 0:
+                distinct.append(float(v))
+                counts.append(1)
+            elif not _double_equal_ordered(sv[i - 1], v):
+                if sv[i - 1] < 0.0 and v > 0.0:
+                    distinct.append(0.0)
+                    counts.append(zero_cnt)
+                distinct.append(float(v))
+                counts.append(1)
+            else:
+                distinct[-1] = float(v)
+                counts[-1] += 1
+        if sv.size > 0 and sv[-1] < 0.0 and zero_cnt > 0:
+            distinct.append(0.0)
+            counts.append(zero_cnt)
+
+        self.min_val = distinct[0] if distinct else 0.0
+        self.max_val = distinct[-1] if distinct else 0.0
+
+        cnt_in_bin: List[int] = []
+        if bin_type == BIN_NUMERICAL:
+            if self.missing_type == MISSING_NAN:
+                eff_max_bin, eff_total = max_bin - 1, total_sample_cnt - na_cnt
+            else:
+                eff_max_bin, eff_total = max_bin, total_sample_cnt
+            if forced_bounds:
+                bounds = find_bin_with_forced(distinct, counts, eff_max_bin,
+                                              eff_total, min_data_in_bin,
+                                              forced_bounds)
+            else:
+                bounds = find_bin_zero_as_one(distinct, counts, eff_max_bin,
+                                              eff_total, min_data_in_bin)
+            if self.missing_type == MISSING_ZERO and len(bounds) == 2:
+                self.missing_type = MISSING_NONE
+            if self.missing_type == MISSING_NAN:
+                bounds.append(math.nan)
+            self.bin_upper_bound = np.asarray(bounds)
+            self.num_bin = len(bounds)
+            cnt_in_bin = [0] * self.num_bin
+            i_bin = 0
+            for v, c in zip(distinct, counts):
+                while i_bin < self.num_bin - 1 and v > bounds[i_bin]:
+                    i_bin += 1
+                cnt_in_bin[i_bin] += c
+            if self.missing_type == MISSING_NAN:
+                cnt_in_bin[-1] = na_cnt
+        else:
+            # categorical: count-sorted vocabulary, bin 0 = NaN/other
+            # (ref: bin.cpp:424-491)
+            cat_counts: Dict[int, int] = {}
+            for v, c in zip(distinct, counts):
+                iv = int(v)
+                if iv < 0:
+                    na_cnt += c
+                    log.warning("Met negative value in categorical features, "
+                                "will convert it to NaN")
+                else:
+                    cat_counts[iv] = cat_counts.get(iv, 0) + c
+            rest_cnt = total_sample_cnt - na_cnt
+            self.categorical_2_bin = {-1: 0}
+            self.bin_2_categorical = [-1]
+            cnt_in_bin = [0]
+            self.num_bin = 1
+            if rest_cnt > 0:
+                order = sorted(cat_counts.items(), key=lambda kv: -kv[1])
+                cut_cnt = int(round(rest_cnt * 0.99))
+                distinct_cnt = len(order) + (1 if na_cnt > 0 else 0)
+                eff_max_bin = min(distinct_cnt, max_bin)
+                used_cnt = 0
+                for idx, (cat, c) in enumerate(order):
+                    if not (used_cnt < cut_cnt or self.num_bin < eff_max_bin):
+                        break
+                    if c < min_data_in_bin and idx > 1:
+                        break
+                    self.bin_2_categorical.append(cat)
+                    self.categorical_2_bin[cat] = self.num_bin
+                    used_cnt += c
+                    cnt_in_bin.append(c)
+                    self.num_bin += 1
+                if len(self.bin_2_categorical) - 1 == len(order) and na_cnt == 0:
+                    self.missing_type = MISSING_NONE
+                else:
+                    self.missing_type = MISSING_NAN
+                cnt_in_bin[0] = total_sample_cnt - used_cnt
+
+        self.is_trivial = self.num_bin <= 1
+        if not self.is_trivial and pre_filter and self._need_filter(
+                cnt_in_bin, total_sample_cnt, min_split_data):
+            self.is_trivial = True
+
+        if not self.is_trivial:
+            self.default_bin = int(self.value_to_bin(0.0))
+            self.most_freq_bin = int(np.argmax(cnt_in_bin))
+            max_sparse_rate = cnt_in_bin[self.most_freq_bin] / total_sample_cnt
+            if (self.most_freq_bin != self.default_bin
+                    and max_sparse_rate < K_SPARSE_THRESHOLD):
+                self.most_freq_bin = self.default_bin
+            self.sparse_rate = cnt_in_bin[self.most_freq_bin] / total_sample_cnt
+        else:
+            self.sparse_rate = 1.0
+
+    def _need_filter(self, cnt_in_bin: List[int], total_cnt: int,
+                     filter_cnt: int) -> bool:
+        """True if no split on this feature could satisfy min_data
+        (ref: bin.h:87-120 NeedFilter analog: cumulative count check)."""
+        if self.bin_type == BIN_NUMERICAL:
+            sum_left = 0
+            for i in range(len(cnt_in_bin) - 1):
+                sum_left += cnt_in_bin[i]
+                if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                    return False
+            return True
+        else:
+            if len(cnt_in_bin) <= 2:
+                for c in cnt_in_bin:
+                    if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                        return False
+                return True
+            return False
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, value):
+        """Vectorized value→bin (ref: bin.h:457-495 ValueToBin)."""
+        scalar = np.isscalar(value)
+        v = np.atleast_1d(np.asarray(value, dtype=np.float64))
+        if self.bin_type == BIN_CATEGORICAL:
+            out = np.zeros(v.shape, dtype=np.int32)
+            iv = np.where(np.isnan(v), -1, v).astype(np.int64)
+            for cat, b in self.categorical_2_bin.items():
+                out[iv == cat] = b
+            return out[0] if scalar else out
+        nan_mask = np.isnan(v)
+        if self.missing_type == MISSING_ZERO:
+            v = np.where(nan_mask, 0.0, v)
+        n_numeric = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+        bounds = self.bin_upper_bound[:n_numeric]
+        # bin = smallest i with value <= bin_upper_bound[i]; searchsorted
+        # side='left' returns exactly the first index whose bound >= value
+        safe_v = np.where(nan_mask, 0.0, v)
+        out = np.searchsorted(bounds, safe_v, side="left").astype(np.int32)
+        out = np.minimum(out, n_numeric - 1)
+        if self.missing_type == MISSING_NAN:
+            out = np.where(nan_mask, self.num_bin - 1, out)
+        return out[0] if scalar else out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative threshold for a bin (used in model text output —
+        ref: tree.cpp RealThreshold uses the bin upper bound)."""
+        if self.bin_type == BIN_CATEGORICAL:
+            return float(self.bin_2_categorical[bin_idx])
+        return float(self.bin_upper_bound[bin_idx])
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_type": self.bin_type,
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "bin_upper_bound": self.bin_upper_bound.tolist(),
+            "bin_2_categorical": list(self.bin_2_categorical),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = d["num_bin"]
+        m.missing_type = d["missing_type"]
+        m.is_trivial = d["is_trivial"]
+        m.sparse_rate = d["sparse_rate"]
+        m.bin_type = d["bin_type"]
+        m.min_val = d["min_val"]
+        m.max_val = d["max_val"]
+        m.default_bin = d["default_bin"]
+        m.most_freq_bin = d["most_freq_bin"]
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = list(d.get("bin_2_categorical", []))
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        return m
+
+    def missing_type_str(self) -> str:
+        return _MISSING_TYPE_STR[self.missing_type]

@@ -1,0 +1,418 @@
+"""Declarative parameter system.
+
+TPU-native analog of the reference config layer (ref: include/LightGBM/config.h,
+src/io/config.cpp:16,45,193 and the generated src/io/config_auto.cpp).  The
+reference keeps one source of truth — parameter name, aliases, type, check and
+doc — in header comments and code-generates the alias table / setters
+(helpers/parameter_generator.py).  Here the same single source of truth is the
+``_PARAMS`` registry below; alias resolution, type coercion and range checks are
+driven from it at runtime.
+
+Unknown parameters are kept and forwarded with a warning, matching the
+reference's behavior of passing unrecognized keys through (config.cpp:193).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils import log
+
+__all__ = ["Config", "PARAM_ALIASES", "param_docs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Param:
+    name: str
+    ptype: type  # int, float, bool, str, list
+    default: Any
+    aliases: Tuple[str, ...] = ()
+    check: Optional[Tuple[str, float]] = None  # (op, bound): ">", ">=", "<", "<="
+    check2: Optional[Tuple[str, float]] = None
+    desc: str = ""
+
+
+def _p(name, ptype, default, aliases=(), check=None, check2=None, desc=""):
+    return _Param(name, ptype, default, tuple(aliases), check, check2, desc)
+
+
+# One row per parameter; mirrors the surface of the reference Config struct
+# (ref: include/LightGBM/config.h:139-1029).  Grouped as in Parameters.rst.
+_PARAMS: List[_Param] = [
+    # ---- Core parameters ----
+    _p("task", str, "train", ("task_type",), desc="train, predict, convert_model, refit"),
+    _p("objective", str, "regression",
+       ("objective_type", "app", "application", "loss"),
+       desc="objective name (regression, binary, multiclass, lambdarank, ...)"),
+    _p("boosting", str, "gbdt", ("boosting_type", "boost"),
+       desc="gbdt, rf, dart, goss"),
+    _p("data", str, "", ("train", "train_data", "train_data_file", "data_filename"),
+       desc="path of training data (CLI)"),
+    _p("valid", list, [], ("test", "valid_data", "valid_data_file", "test_data",
+                           "test_data_file", "valid_filenames"),
+       desc="paths of validation data (CLI)"),
+    _p("num_iterations", int, 100,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "nrounds", "num_boost_round", "n_estimators", "max_iter"),
+       check=(">=", 0)),
+    _p("learning_rate", float, 0.1, ("shrinkage_rate", "eta"), check=(">", 0.0)),
+    _p("num_leaves", int, 31, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"),
+       check=(">", 1), check2=("<=", 131072)),
+    _p("tree_learner", str, "serial",
+       ("tree", "tree_type", "tree_learner_type"),
+       desc="serial, feature, data, voting"),
+    _p("num_threads", int, 0, ("num_thread", "nthread", "nthreads", "n_jobs"),
+       desc="unused on TPU (XLA owns threading); kept for API parity"),
+    _p("device_type", str, "tpu", ("device",), desc="tpu or cpu (cpu = XLA on host)"),
+    _p("seed", int, 0, ("random_seed", "random_state"),
+       desc="master seed deriving data_random_seed etc."),
+    _p("deterministic", bool, False),
+    # ---- Learning control ----
+    _p("force_col_wise", bool, False),
+    _p("force_row_wise", bool, False),
+    _p("histogram_pool_size", float, -1.0, ("hist_pool_size",)),
+    _p("max_depth", int, -1, desc="<=0 means no limit"),
+    _p("min_data_in_leaf", int, 20,
+       ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"),
+       check=(">=", 0)),
+    _p("min_sum_hessian_in_leaf", float, 1e-3,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+        "min_child_weight"), check=(">=", 0.0)),
+    _p("bagging_fraction", float, 1.0, ("sub_row", "subsample", "bagging"),
+       check=(">", 0.0), check2=("<=", 1.0)),
+    _p("pos_bagging_fraction", float, 1.0,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"),
+       check=(">", 0.0), check2=("<=", 1.0)),
+    _p("neg_bagging_fraction", float, 1.0,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"),
+       check=(">", 0.0), check2=("<=", 1.0)),
+    _p("bagging_freq", int, 0, ("subsample_freq",)),
+    _p("bagging_seed", int, 3, ("bagging_fraction_seed",)),
+    _p("feature_fraction", float, 1.0,
+       ("sub_feature", "colsample_bytree"), check=(">", 0.0), check2=("<=", 1.0)),
+    _p("feature_fraction_bynode", float, 1.0,
+       ("sub_feature_bynode", "colsample_bynode"),
+       check=(">", 0.0), check2=("<=", 1.0)),
+    _p("feature_fraction_seed", int, 2),
+    _p("extra_trees", bool, False, ("extra_tree",)),
+    _p("extra_seed", int, 6),
+    _p("early_stopping_round", int, 0,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    _p("first_metric_only", bool, False),
+    _p("max_delta_step", float, 0.0, ("max_tree_output", "max_leaf_output")),
+    _p("lambda_l1", float, 0.0, ("reg_alpha", "l1_regularization"), check=(">=", 0.0)),
+    _p("lambda_l2", float, 0.0, ("reg_lambda", "lambda", "l2_regularization"),
+       check=(">=", 0.0)),
+    _p("linear_lambda", float, 0.0, check=(">=", 0.0)),
+    _p("min_gain_to_split", float, 0.0, ("min_split_gain",), check=(">=", 0.0)),
+    _p("drop_rate", float, 0.1, ("rate_drop",), check=(">=", 0.0), check2=("<=", 1.0)),
+    _p("max_drop", int, 50),
+    _p("skip_drop", float, 0.5, check=(">=", 0.0), check2=("<=", 1.0)),
+    _p("xgboost_dart_mode", bool, False),
+    _p("uniform_drop", bool, False),
+    _p("drop_seed", int, 4),
+    _p("top_rate", float, 0.2, check=(">=", 0.0), check2=("<=", 1.0),
+       desc="GOSS: keep-ratio of large-gradient rows"),
+    _p("other_rate", float, 0.1, check=(">=", 0.0), check2=("<=", 1.0),
+       desc="GOSS: sample-ratio of small-gradient rows"),
+    _p("min_data_per_group", int, 100, check=(">", 0)),
+    _p("max_cat_threshold", int, 32, check=(">", 0)),
+    _p("cat_l2", float, 10.0, check=(">=", 0.0)),
+    _p("cat_smooth", float, 10.0, check=(">=", 0.0)),
+    _p("max_cat_to_onehot", int, 4, check=(">", 0)),
+    _p("top_k", int, 20, ("topk",), check=(">", 0),
+       desc="voting-parallel: per-shard feature proposals"),
+    _p("monotone_constraints", list, [], ("mc", "monotone_constraint")),
+    _p("monotone_constraints_method", str, "basic",
+       ("monotone_constraining_method", "mc_method"),
+       desc="basic, intermediate, advanced"),
+    _p("monotone_penalty", float, 0.0, ("monotone_splits_penalty", "ms_penalty",
+                                        "mc_penalty"), check=(">=", 0.0)),
+    _p("feature_contri", list, [], ("feature_contrib", "fc", "fp", "feature_penalty")),
+    _p("forcedsplits_filename", str, "", ("fs", "forced_splits_filename",
+                                          "forced_splits_file", "forced_splits")),
+    _p("refit_decay_rate", float, 0.9, check=(">=", 0.0), check2=("<=", 1.0)),
+    _p("cegb_tradeoff", float, 1.0, check=(">=", 0.0)),
+    _p("cegb_penalty_split", float, 0.0, check=(">=", 0.0)),
+    _p("cegb_penalty_feature_lazy", list, []),
+    _p("cegb_penalty_feature_coupled", list, []),
+    _p("path_smooth", float, 0.0, check=(">=", 0.0)),
+    _p("interaction_constraints", list, []),
+    _p("verbosity", int, 1, ("verbose",)),
+    _p("input_model", str, "", ("model_input", "model_in")),
+    _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
+    _p("saved_feature_importance_type", int, 0),
+    _p("snapshot_freq", int, -1, ("save_period",)),
+    # ---- Linear tree ----
+    _p("linear_tree", bool, False, ("linear_trees",)),
+    # ---- Dataset parameters ----
+    _p("max_bin", int, 255, ("max_bins",), check=(">", 1)),
+    _p("max_bin_by_feature", list, []),
+    _p("min_data_in_bin", int, 3, check=(">", 0)),
+    _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",), check=(">", 0)),
+    _p("data_random_seed", int, 1, ("data_seed",)),
+    _p("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse")),
+    _p("enable_bundle", bool, True, ("is_enable_bundle", "bundle")),
+    _p("use_missing", bool, True),
+    _p("zero_as_missing", bool, False),
+    _p("feature_pre_filter", bool, True),
+    _p("pre_partition", bool, False, ("is_pre_partition",)),
+    _p("two_round", bool, False, ("two_round_loading", "use_two_round_loading")),
+    _p("header", bool, False, ("has_header",)),
+    _p("label_column", str, "", ("label",)),
+    _p("weight_column", str, "", ("weight",)),
+    _p("group_column", str, "", ("group", "group_id", "query_column", "query",
+                                 "query_id")),
+    _p("ignore_column", str, "", ("ignore_feature", "blacklist")),
+    _p("categorical_feature", list, [], ("cat_feature", "categorical_column",
+                                         "cat_column")),
+    _p("forcedbins_filename", str, ""),
+    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file")),
+    _p("precise_float_parser", bool, False),
+    # ---- Predict parameters ----
+    _p("start_iteration_predict", int, 0),
+    _p("num_iteration_predict", int, -1),
+    _p("predict_raw_score", bool, False, ("is_predict_raw_score", "predict_rawscore",
+                                          "raw_score")),
+    _p("predict_leaf_index", bool, False, ("is_predict_leaf_index", "leaf_index")),
+    _p("predict_contrib", bool, False, ("is_predict_contrib", "contrib")),
+    _p("predict_disable_shape_check", bool, False),
+    _p("pred_early_stop", bool, False),
+    _p("pred_early_stop_freq", int, 10),
+    _p("pred_early_stop_margin", float, 10.0),
+    _p("output_result", str, "LightGBM_predict_result.txt",
+       ("predict_result", "prediction_result", "predict_name", "pred_name",
+        "name_pred")),
+    # ---- Convert parameters ----
+    _p("convert_model_language", str, ""),
+    _p("convert_model", str, "gbdt_prediction.cpp", ("convert_model_file",)),
+    # ---- Objective parameters ----
+    _p("objective_seed", int, 5),
+    _p("num_class", int, 1, ("num_classes",), check=(">", 0)),
+    _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),
+    _p("scale_pos_weight", float, 1.0, check=(">", 0.0)),
+    _p("sigmoid", float, 1.0, check=(">", 0.0)),
+    _p("boost_from_average", bool, True),
+    _p("reg_sqrt", bool, False),
+    _p("alpha", float, 0.9, check=(">", 0.0)),
+    _p("fair_c", float, 1.0, check=(">", 0.0)),
+    _p("poisson_max_delta_step", float, 0.7, check=(">", 0.0)),
+    _p("tweedie_variance_power", float, 1.5, check=(">=", 1.0), check2=("<", 2.0)),
+    _p("lambdarank_truncation_level", int, 30, check=(">", 0)),
+    _p("lambdarank_norm", bool, True),
+    _p("label_gain", list, []),
+    # ---- Metric parameters ----
+    _p("metric", list, [], ("metrics", "metric_types")),
+    _p("metric_freq", int, 1, ("output_freq",), check=(">", 0)),
+    _p("is_provide_training_metric", bool, False,
+       ("training_metric", "is_training_metric", "train_metric")),
+    _p("eval_at", list, [1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at",
+                                          "map_at")),
+    _p("multi_error_top_k", int, 1, check=(">", 0)),
+    _p("auc_mu_weights", list, []),
+    # ---- Network (distributed) parameters ----
+    # On TPU these select mesh behavior rather than socket/MPI endpoints
+    # (ref: config.h:983-1006; src/network/*).
+    _p("num_machines", int, 1, ("num_machine",), check=(">", 0)),
+    _p("local_listen_port", int, 12400, ("local_port", "port"),
+       desc="unused on TPU (XLA owns transport); kept for API parity"),
+    _p("time_out", int, 120, check=(">", 0)),
+    _p("machine_list_filename", str, "", ("machine_list_file", "machine_list",
+                                          "mlist")),
+    _p("machines", str, "", ("workers", "nodes")),
+    # ---- GPU (reference) → TPU parameters ----
+    _p("gpu_platform_id", int, -1),
+    _p("gpu_device_id", int, -1),
+    _p("gpu_use_dp", bool, False,
+       desc="use float64 histogram accumulation (parity mode)"),
+    _p("num_gpu", int, 1, check=(">", 0)),
+    # ---- TPU-specific ----
+    _p("grow_policy", str, "auto",
+       desc="auto, leafwise (exact LightGBM semantics), depthwise "
+            "(frontier-batched, fastest on TPU)"),
+    _p("tpu_histogram_impl", str, "auto",
+       desc="auto, segment (XLA segment-sum), onehot (one-hot matmul), "
+            "pallas (Pallas kernel)"),
+    _p("tpu_rows_per_shard_pad", int, 8,
+       desc="pad row count to a multiple of this per mesh shard"),
+    _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),
+    _p("mesh_axis_feature", str, "feature",
+       desc="mesh axis name for feature sharding"),
+]
+
+_BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}
+PARAM_ALIASES: Dict[str, str] = {}
+for _param in _PARAMS:
+    for _a in _param.aliases:
+        PARAM_ALIASES[_a] = _param.name
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression",
+    "l2_root": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg", "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def _coerce(p: _Param, value: Any) -> Any:
+    if p.ptype is bool:
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "+", "yes")
+        return bool(value)
+    if p.ptype is int:
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if p.ptype is float:
+        return float(value)
+    if p.ptype is list:
+        if isinstance(value, str):
+            if not value:
+                return []
+            return [_auto_num(v) for v in value.split(",")]
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        return [value]
+    return str(value)
+
+
+def _auto_num(s: str) -> Any:
+    s = s.strip()
+    try:
+        f = float(s)
+        return int(f) if f == int(f) and "." not in s and "e" not in s.lower() else f
+    except ValueError:
+        return s
+
+
+def _check(p: _Param, value: Any) -> None:
+    for chk in (p.check, p.check2):
+        if chk is None or not isinstance(value, (int, float)):
+            continue
+        op, bound = chk
+        ok = {"<": value < bound, "<=": value <= bound,
+              ">": value > bound, ">=": value >= bound}[op]
+        if not ok:
+            log.fatal("Parameter %s should be %s %s; got %s", p.name, op, bound, value)
+
+
+class Config:
+    """Resolved training configuration.
+
+    Usage: ``cfg = Config({"num_leaves": 63, "eta": 0.05})``; attribute access
+    returns resolved values (``cfg.learning_rate == 0.05``).
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        # copy list defaults so in-place mutation can't corrupt the registry
+        self._values: Dict[str, Any] = {
+            p.name: (list(p.default) if p.ptype is list else p.default)
+            for p in _PARAMS}
+        self.unknown: Dict[str, Any] = {}
+        self._user_set: set = set()
+        if params:
+            self.update(params)
+        else:
+            self._post_process()
+
+    # -- alias resolution (ref: config.cpp:45 KeyAliasTransform) --
+    @staticmethod
+    def resolve_key(key: str) -> str:
+        key = key.strip().replace("-", "_")
+        return PARAM_ALIASES.get(key, key)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        for raw_key, value in params.items():
+            key = self.resolve_key(raw_key)
+            if value is None:
+                continue
+            p = _BY_NAME.get(key)
+            if p is None:
+                self.unknown[key] = value
+                continue
+            v = _coerce(p, value)
+            _check(p, v)
+            self._values[key] = v
+            self._user_set.add(key)
+        self._post_process()
+
+    def _post_process(self) -> None:
+        # Objective alias resolution + derived flags
+        # (ref: config.cpp:193 Config::Set derived is_parallel etc.)
+        obj = str(self._values["objective"]).lower()
+        self._values["objective"] = _OBJECTIVE_ALIASES.get(obj, obj)
+        tl = self._values["tree_learner"]
+        tl_alias = {"serial": "serial", "feature": "feature",
+                    "feature_parallel": "feature", "data": "data",
+                    "data_parallel": "data", "voting": "voting",
+                    "voting_parallel": "voting"}
+        self._values["tree_learner"] = tl_alias.get(tl, tl)
+        self.is_parallel = self._values["tree_learner"] != "serial"
+        self.is_data_based_parallel = self._values["tree_learner"] in ("data", "voting")
+        if self._values["verbosity"] < 0:
+            log.set_log_level(log.LogLevel.WARNING if self._values["verbosity"] == -1
+                              else log.LogLevel.FATAL)
+        elif self._values["verbosity"] == 0:
+            log.set_log_level(log.LogLevel.WARNING)
+        elif self._values["verbosity"] == 1:
+            log.set_log_level(log.LogLevel.INFO)
+        else:
+            log.set_log_level(log.LogLevel.DEBUG)
+
+    def was_set(self, key: str) -> bool:
+        return self.resolve_key(key) in self._user_set
+
+    def __getattr__(self, name: str) -> Any:
+        values = self.__dict__.get("_values")
+        if values is not None and name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def __getitem__(self, name: str) -> Any:
+        return self._values[self.resolve_key(name)]
+
+    def set(self, name: str, value: Any) -> None:
+        self.update({name: value})
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dict(self._values)
+        d.update(self.unknown)
+        return d
+
+    @staticmethod
+    def kv2map(args: List[str]) -> Dict[str, str]:
+        """Parse CLI ``k=v`` tokens (ref: config.cpp:16 KV2Map)."""
+        out: Dict[str, str] = {}
+        for arg in args:
+            if "=" not in arg:
+                continue
+            k, v = arg.split("=", 1)
+            out[k.strip()] = v.strip()
+        return out
+
+
+def param_docs() -> str:
+    """Render parameter documentation (analog of generated Parameters.rst)."""
+    lines = []
+    for p in _PARAMS:
+        alias = f" (aliases: {', '.join(p.aliases)})" if p.aliases else ""
+        chk = ""
+        if p.check:
+            chk = f", constraint: {p.check[0]} {p.check[1]}"
+        lines.append(f"- ``{p.name}``{alias}: {p.ptype.__name__}, "
+                     f"default={p.default!r}{chk}. {p.desc}")
+    return "\n".join(lines)

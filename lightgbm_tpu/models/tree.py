@@ -1,0 +1,224 @@
+"""Tree representation.
+
+TPU-native analog of the reference flat-array tree (ref:
+include/LightGBM/tree.h:25, src/io/tree.cpp).  Two forms:
+
+- ``TreeArrays``: a NamedTuple of fixed-size device arrays (struct-of-arrays,
+  static ``max_leaves`` slots) produced by the jitted learner.  Child pointers
+  follow the reference convention: ``>= 0`` is an internal node index,
+  negative is ``~leaf_index`` (ref: tree.h left_child_/right_child_).
+- ``HostTree``: the host-side object used for model text IO, prediction on raw
+  features, SHAP, and refit.  Thresholds are converted from bin indices to real
+  values with the dataset's BinMapper upper bounds (ref: tree.h RealThreshold).
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TreeArrays(NamedTuple):
+    """Device-side tree under construction/training (static shapes)."""
+    num_leaves: jax.Array          # int32 scalar — actual leaf count
+    split_feature: jax.Array       # int32 [L-1] inner feature index
+    threshold_bin: jax.Array       # int32 [L-1]
+    default_left: jax.Array        # bool  [L-1]
+    cat_flag: jax.Array            # bool  [L-1] categorical split?
+    cat_mask: jax.Array            # bool  [L-1, B] bins routed left (cat only)
+    left_child: jax.Array          # int32 [L-1]
+    right_child: jax.Array         # int32 [L-1]
+    split_gain: jax.Array          # f32   [L-1]
+    internal_value: jax.Array      # f32   [L-1]
+    internal_count: jax.Array      # f32   [L-1]
+    internal_weight: jax.Array     # f32   [L-1] (sum_hessian)
+    leaf_value: jax.Array          # f32   [L]
+    leaf_count: jax.Array          # f32   [L]
+    leaf_weight: jax.Array         # f32   [L] (sum_hessian)
+    leaf_depth: jax.Array          # int32 [L]
+
+
+def empty_tree(max_leaves: int, max_bins: int) -> TreeArrays:
+    L = max_leaves
+    return TreeArrays(
+        num_leaves=jnp.int32(1),
+        split_feature=jnp.full((L - 1,), -1, jnp.int32),
+        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+        default_left=jnp.zeros((L - 1,), bool),
+        cat_flag=jnp.zeros((L - 1,), bool),
+        cat_mask=jnp.zeros((L - 1, max_bins), bool),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        split_gain=jnp.zeros((L - 1,), jnp.float32),
+        internal_value=jnp.zeros((L - 1,), jnp.float32),
+        internal_count=jnp.zeros((L - 1,), jnp.float32),
+        internal_weight=jnp.zeros((L - 1,), jnp.float32),
+        leaf_value=jnp.zeros((L,), jnp.float32),
+        leaf_count=jnp.zeros((L,), jnp.float32),
+        leaf_weight=jnp.zeros((L,), jnp.float32),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+    )
+
+
+class HostTree:
+    """Host-side tree mirroring the reference text-model block
+    (ref: src/io/tree.cpp:336 Tree::ToString)."""
+
+    def __init__(self, num_leaves: int, shrinkage: float = 1.0):
+        self.num_leaves = num_leaves
+        self.shrinkage = shrinkage
+        self.split_feature: np.ndarray = np.zeros(0, np.int32)   # real indices
+        self.threshold: np.ndarray = np.zeros(0, np.float64)     # real values
+        self.threshold_bin: np.ndarray = np.zeros(0, np.int32)
+        self.decision_type: np.ndarray = np.zeros(0, np.int32)
+        self.left_child: np.ndarray = np.zeros(0, np.int32)
+        self.right_child: np.ndarray = np.zeros(0, np.int32)
+        self.split_gain: np.ndarray = np.zeros(0, np.float64)
+        self.internal_value: np.ndarray = np.zeros(0, np.float64)
+        self.internal_weight: np.ndarray = np.zeros(0, np.float64)
+        self.internal_count: np.ndarray = np.zeros(0, np.int64)
+        self.leaf_value: np.ndarray = np.zeros(1, np.float64)
+        self.leaf_weight: np.ndarray = np.zeros(1, np.float64)
+        self.leaf_count: np.ndarray = np.zeros(1, np.int64)
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []
+        self.is_linear = False
+
+    # decision_type bitfield (ref: tree.h:166-186): bit0 categorical,
+    # bit1 default_left, bits 2-3 missing type (0 none, 1 zero, 2 nan)
+    @staticmethod
+    def make_decision_type(categorical: bool, default_left: bool,
+                           missing_type: int) -> int:
+        d = 0
+        if categorical:
+            d |= 1
+        if default_left:
+            d |= 2
+        d |= (missing_type & 3) << 2
+        return d
+
+    @staticmethod
+    def decision_categorical(d: int) -> bool:
+        return bool(d & 1)
+
+    @staticmethod
+    def decision_default_left(d: int) -> bool:
+        return bool(d & 2)
+
+    @staticmethod
+    def decision_missing_type(d: int) -> int:
+        return (d >> 2) & 3
+
+    @property
+    def num_internal(self) -> int:
+        return max(0, self.num_leaves - 1)
+
+    # ------------------------------------------------------------------
+    def apply_shrinkage(self, rate: float) -> None:
+        """ref: tree.h:188 Shrinkage — scales leaf and internal values."""
+        self.shrinkage *= rate
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+
+    def add_bias(self, val: float) -> None:
+        self.leaf_value = self.leaf_value + val
+        self.internal_value = self.internal_value + val
+        self.shrinkage = 1.0
+
+    # ------------------------------------------------------------------
+    def predict_rows(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized node walk over raw features for a batch of rows
+        (ref: tree.h Tree::Predict / Decision with missing routing)."""
+        n = X.shape[0]
+        if self.num_leaves == 1:
+            return np.full(n, self.leaf_value[0])
+        out = np.empty(n, dtype=np.float64)
+        # iterative vectorized traversal: node >= 0 internal, < 0 leaf (~leaf)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        for _ in range(self.num_leaves):  # depth bound
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            f = self.split_feature[nd]
+            vals = X[idx, f]
+            d = self.decision_type[nd]
+            cat = (d & 1).astype(bool)
+            dl = (d & 2).astype(bool)
+            mt = (d >> 2) & 3
+            thr = self.threshold[nd]
+            nan_mask = np.isnan(vals)
+            zero_mask = np.abs(vals) <= 1e-35  # kZeroThreshold
+            is_missing = np.where(mt == 2, nan_mask,
+                                  np.where(mt == 1, zero_mask | nan_mask,
+                                           False))
+            # NaN with missing_type none/zero is converted to 0 by the
+            # reference (tree.h NumericalDecision)
+            vals_eff = np.where(nan_mask & (mt != 2), 0.0, vals)
+            go_left = np.where(is_missing, dl, vals_eff <= thr)
+            if cat.any():
+                ci = np.nonzero(cat)[0]
+                go_left[ci] = self._cat_decision(nd[ci], vals[ci])
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            leaf_hit = nxt < 0
+            if leaf_hit.any():
+                out[idx[leaf_hit]] = self.leaf_value[~nxt[leaf_hit]]
+            node[idx] = nxt
+            active[idx] = ~leaf_hit
+        return out
+
+    def _cat_decision(self, nodes: np.ndarray, vals: np.ndarray) -> np.ndarray:
+        """Categorical bitset lookup (ref: tree.h CategoricalDecision,
+        Common::FindInBitset)."""
+        go_left = np.zeros(len(nodes), dtype=bool)
+        iv = np.where(np.isnan(vals), -1, vals).astype(np.int64)
+        for k, (nd, v) in enumerate(zip(nodes, iv)):
+            if v < 0:
+                go_left[k] = False
+                continue
+            cat_idx = int(self.threshold[nd])  # index into cat_boundaries
+            lo = self.cat_boundaries[cat_idx]
+            hi = self.cat_boundaries[cat_idx + 1]
+            word, bit = divmod(int(v), 32)
+            if word < hi - lo and (self.cat_threshold[lo + word] >> bit) & 1:
+                go_left[k] = True
+        return go_left
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        if self.num_leaves == 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        out = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        for _ in range(self.num_leaves):
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            f = self.split_feature[nd]
+            vals = X[idx, f]
+            d = self.decision_type[nd]
+            cat = (d & 1).astype(bool)
+            dl = (d & 2).astype(bool)
+            mt = (d >> 2) & 3
+            thr = self.threshold[nd]
+            nan_mask = np.isnan(vals)
+            zero_mask = np.abs(vals) <= 1e-35
+            is_missing = np.where(mt == 2, nan_mask,
+                                  np.where(mt == 1, zero_mask | nan_mask, False))
+            vals_eff = np.where(nan_mask & (mt != 2), 0.0, vals)
+            go_left = np.where(is_missing, dl, vals_eff <= thr)
+            if cat.any():
+                ci = np.nonzero(cat)[0]
+                go_left[ci] = self._cat_decision(nd[ci], vals[ci])
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            leaf_hit = nxt < 0
+            if leaf_hit.any():
+                out[idx[leaf_hit]] = ~nxt[leaf_hit]
+            node[idx] = nxt
+            active[idx] = ~leaf_hit
+        return out

@@ -1,0 +1,132 @@
+"""On-device gradient/hessian histogram construction.
+
+TPU-native replacement for the reference's histogram kernels — the hottest
+loop of GBDT training (ref: src/io/dense_bin.hpp ConstructHistogram,
+src/treelearner/ocl/histogram{16,64,256}.cl, src/treelearner/kernels/
+histogram_16_64_256.cu).  The reference uses per-thread/per-workgroup
+scatter-adds with atomics; TPUs have no fast atomics, so the formulations here
+are dense-array programs XLA can tile:
+
+- ``segment``: one ``jax.ops.segment_sum`` over a joint (slot, feature, bin)
+  index per row-chunk, scanned over chunks.  Works for any number of target
+  leaves (depth-wise frontier batches).
+- ``onehot``: builds a ``[chunk, F, B]`` one-hot of the bin indices and
+  contracts it with (grad, hess, count) on the MXU.  Fastest when targeting a
+  single leaf (leaf-wise growth; the smaller-child + subtraction trick,
+  ref: serial_tree_learner.cpp:423-425).
+- a Pallas kernel (ops/pallas_histogram.py) specializes the onehot formulation
+  with VMEM-resident accumulators to avoid materializing the one-hot in HBM.
+
+Histograms are ``float32 [num_slots, F, B, 3]`` with channels (sum_grad,
+sum_hess, count); the reference accumulates float64 on CPU and float32 on GPU
+with acceptable AUC drift (ref: docs/GPU-Performance.rst:130-160) — we match
+the GPU precision contract by default.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# channels: grad, hess, count
+NUM_CH = 3
+
+
+def _choose_chunk(num_rows: int, num_features: int, num_bins: int,
+                  budget_bytes: int = 1 << 26) -> int:
+    """Row-chunk size keeping the materialized one-hot under ``budget_bytes``."""
+    c = budget_bytes // max(1, num_features * num_bins * 4)
+    c = max(256, min(int(c), 1 << 15, max(256, num_rows)))
+    # round to a multiple of 256 for clean tiling
+    return max(256, (c // 256) * 256)
+
+
+def _pad_rows(arrs, chunk: int, pad_values):
+    n = arrs[0].shape[0]
+    rem = (-n) % chunk
+    if rem == 0:
+        return arrs
+    out = []
+    for a, pv in zip(arrs, pad_values):
+        pad_width = [(0, rem)] + [(0, 0)] * (a.ndim - 1)
+        out.append(jnp.pad(a, pad_width, constant_values=pv))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "num_bins", "impl"))
+def build_histograms(bins: jax.Array, gh: jax.Array, row_slot: jax.Array,
+                     *, num_slots: int, num_bins: int,
+                     impl: str = "auto") -> jax.Array:
+    """Histograms for a batch of target leaves.
+
+    Args:
+      bins: ``[R, F]`` uint8/uint16 binned features.
+      gh: ``[R, 3]`` float32 (grad, hess, count-weight); rows excluded by
+        bagging carry zeros.
+      row_slot: ``[R]`` int32 — target slot of each row, or -1 to ignore.
+        (Computed by the caller as ``leaf_to_slot[row_leaf]``.)
+      num_slots: static number of target leaves.
+      num_bins: static padded bin count per feature.
+
+    Returns: ``[num_slots, F, num_bins, 3]`` float32.
+    """
+    R, F = bins.shape
+    if impl == "auto":
+        impl = "onehot" if num_slots <= 2 else "segment"
+    chunk = _choose_chunk(R, F, num_bins)
+    bins_p, gh_p, slot_p = _pad_rows(
+        [bins, gh, row_slot], chunk, [0, 0.0, -1])
+    n_chunks = bins_p.shape[0] // chunk
+    bins_c = bins_p.reshape(n_chunks, chunk, F)
+    gh_c = gh_p.reshape(n_chunks, chunk, NUM_CH)
+    slot_c = slot_p.reshape(n_chunks, chunk)
+
+    if impl == "segment":
+        fb = F * num_bins
+        f_off = (jnp.arange(F, dtype=jnp.int32) * num_bins)[None, :]
+
+        def body(hist, xs):
+            b, g, s = xs
+            idx = jnp.where(s[:, None] >= 0,
+                            s[:, None] * fb + f_off + b.astype(jnp.int32),
+                            num_slots * fb)  # dump bucket
+            data = jnp.broadcast_to(g[:, None, :], (chunk, F, NUM_CH))
+            seg = jax.ops.segment_sum(data.reshape(-1, NUM_CH),
+                                      idx.reshape(-1),
+                                      num_segments=num_slots * fb + 1)
+            return hist + seg[:num_slots * fb], None
+
+        init = jnp.zeros((num_slots * fb, NUM_CH), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_c, gh_c, slot_c))
+        return hist.reshape(num_slots, F, num_bins, NUM_CH)
+
+    # one-hot matmul formulation: contraction over rows rides the MXU
+    iota_b = jnp.arange(num_bins, dtype=jnp.int32)
+
+    def body(hist, xs):
+        b, g, s = xs
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota_b).astype(jnp.float32)
+        if num_slots == 1:
+            ghm = jnp.where(s[:, None] == 0, g, 0.0)
+            h = jnp.einsum("rfb,rc->fbc", onehot, ghm,
+                           preferred_element_type=jnp.float32)
+            return hist + h[None], None
+        slot_oh = (s[:, None] == jnp.arange(num_slots, dtype=jnp.int32)
+                   ).astype(jnp.float32)  # [C, S]
+        ghs = slot_oh[:, :, None] * g[:, None, :]  # [C, S, 3]
+        h = jnp.einsum("rfb,rsc->sfbc", onehot, ghs,
+                       preferred_element_type=jnp.float32)
+        return hist + h, None
+
+    init = jnp.zeros((num_slots, F, num_bins, NUM_CH), jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (bins_c, gh_c, slot_c))
+    return hist
+
+
+def histogram_subtract(parent: jax.Array, child: jax.Array) -> jax.Array:
+    """Sibling histogram via subtraction (ref: feature_histogram.hpp Subtract,
+    serial_tree_learner.cpp:423-425 smaller/larger-leaf trick)."""
+    return parent - child

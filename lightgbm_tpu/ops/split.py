@@ -1,0 +1,260 @@
+"""On-device best-split search over histograms.
+
+TPU-native replacement for the reference's per-(leaf,feature) sequential
+threshold scan (ref: src/treelearner/feature_histogram.hpp:85
+FindBestThreshold, :858-1090 FindBestThresholdSequentially).  The reference
+walks bins one-by-one per feature on the host; here the whole
+``[slots, features, bins]`` tensor is scanned at once with cumulative sums and
+an argmax — no host round trip per leaf (the design wart called out in
+SURVEY.md §3.5).
+
+Semantics replicated from the reference dispatch
+(feature_histogram.hpp:158-200 FuncForNumricalL3):
+- missing None  -> reverse scan only (default_left=True always).
+- missing Zero  -> reverse + forward scans, the zero (default) bin excluded
+  from the directional accumulation so its rows ride the default direction;
+  threshold == default_bin (forward) / default_bin-1 (reverse) skipped.
+- missing NaN   -> reverse + forward; the NaN bin (last) is excluded from the
+  reverse accumulation so NaN rows go left; forward leaves it on the right.
+- num_bin <= 2  -> single scan (forward iff missing NaN).
+- Ties: reverse beats forward; earlier feature beats later; within forward the
+  smallest threshold wins, within reverse the largest (scan orders).
+
+Gain/leaf-output formulas are the closed-form Newton expressions with
+L1 thresholding, max_delta_step clipping and path smoothing
+(ref: feature_histogram.hpp:737-856 ThresholdL1 / CalculateSplittedLeafOutput /
+GetLeafGain / GetSplitGains).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class SplitParams(NamedTuple):
+    """Static split-finding hyper-parameters (subset of ref Config used by
+    FeatureHistogram)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    max_delta_step: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    path_smooth: float = 0.0
+    monotone_penalty: float = 0.0
+
+
+def threshold_l1(s, l1):
+    # ref: feature_histogram.hpp:737 ThresholdL1
+    reg = jnp.maximum(0.0, jnp.abs(s) - l1)
+    return jnp.sign(s) * reg
+
+
+def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams,
+                          num_data=None, parent_output=0.0):
+    """Closed-form Newton leaf value
+    (ref: feature_histogram.hpp:742 CalculateSplittedLeafOutput)."""
+    ret = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + p.lambda_l2)
+    if p.max_delta_step > 0:
+        ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
+    if p.path_smooth > 0 and num_data is not None:
+        n_s = num_data / p.path_smooth
+        ret = ret * n_s / (n_s + 1.0) + parent_output / (n_s + 1.0)
+    return ret
+
+
+def leaf_gain_given_output(sum_grad, sum_hess, p: SplitParams, output):
+    # ref: feature_histogram.hpp:846 GetLeafGainGivenOutput
+    sg = threshold_l1(sum_grad, p.lambda_l1)
+    return -(2.0 * sg * output + (sum_hess + p.lambda_l2) * output * output)
+
+
+def leaf_gain(sum_grad, sum_hess, p: SplitParams, num_data=None,
+              parent_output=0.0):
+    # ref: feature_histogram.hpp:828 GetLeafGain
+    if p.max_delta_step <= 0 and p.path_smooth <= 0:
+        sg = threshold_l1(sum_grad, p.lambda_l1)
+        return (sg * sg) / (sum_hess + p.lambda_l2)
+    out = calculate_leaf_output(sum_grad, sum_hess, p, num_data, parent_output)
+    return leaf_gain_given_output(sum_grad, sum_hess, p, out)
+
+
+class BestSplit(NamedTuple):
+    """Per-slot best split record — the SplitInfo analog
+    (ref: src/treelearner/split_info.hpp:22)."""
+    feature: jax.Array        # int32 [S], inner feature index, -1 if none
+    threshold: jax.Array      # int32 [S], bin threshold (left: bin <= t)
+    default_left: jax.Array   # bool  [S]
+    gain: jax.Array           # f32   [S], gain minus shift; -inf if invalid
+    left_output: jax.Array    # f32   [S]
+    right_output: jax.Array
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array     # f32 (weighted count channel)
+    right_sum_grad: jax.Array
+    right_sum_hess: jax.Array
+    right_count: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def best_numerical_split(hist: jax.Array, num_bin_per_feat: jax.Array,
+                         missing_type: jax.Array, default_bin: jax.Array,
+                         feature_mask: jax.Array, monotone: jax.Array,
+                         params: SplitParams,
+                         parent_output: jax.Array) -> BestSplit:
+    """Best numerical split per slot.
+
+    Args:
+      hist: ``[S, F, B, 3]`` float32 (grad, hess, count).
+      num_bin_per_feat: ``[F]`` int32 actual bin counts (rest is padding).
+      missing_type: ``[F]`` int32 (0 none / 1 zero / 2 nan).
+      default_bin: ``[F]`` int32 (bin of value 0; the zero-missing bin).
+      feature_mask: ``[F]`` bool — feature_fraction / interaction constraints.
+      monotone: ``[F]`` int32 in {-1, 0, 1}.
+      parent_output: ``[S]`` f32 leaf outputs (for path smoothing).
+
+    Returns a ``BestSplit`` with per-slot winners.
+    """
+    S, F, B, _ = hist.shape
+    p = params
+    grad = hist[..., 0]
+    hess = hist[..., 1]
+    cnt = hist[..., 2]
+
+    t_iota = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+    nb = num_bin_per_feat[None, :, None]          # [1,F,1]
+    mt = missing_type[None, :, None]
+    db = default_bin[None, :, None]
+    is_pad = t_iota >= nb
+
+    # leaf totals: every feature's bins partition the same rows, so feature 0's
+    # bin sums are the leaf totals (padding bins hold no mass)
+    tot_g = jnp.sum(grad[:, 0, :], axis=1)[:, None, None]   # [S,1,1]
+    tot_h = (jnp.sum(hess[:, 0, :], axis=1)
+             + 2.0 * K_EPSILON)[:, None, None]
+    tot_c = jnp.sum(cnt[:, 0, :], axis=1)[:, None, None]
+
+    parent_out = parent_output[:, None, None]
+    num_data = tot_c
+    gain_shift = leaf_gain(tot_g, tot_h, p, num_data, parent_out)
+    min_gain_shift = gain_shift + p.min_gain_to_split      # [S,1,1]
+
+    nan_bin = nb - 1
+    is_missing_bin_fwd = (mt == MISSING_ZERO) & (t_iota == db)
+    is_missing_bin_rev = is_missing_bin_fwd | ((mt == MISSING_NAN)
+                                               & (t_iota == nan_bin))
+
+    def directional_best(excl_missing_mask, thresh_valid, reverse):
+        """Cumulative scan in one direction; missing-bin mass excluded from
+        the accumulated side so it rides the default direction."""
+        m = (~is_pad) & (~excl_missing_mask)
+        g = jnp.where(m, grad, 0.0)
+        h = jnp.where(m, hess, 0.0)
+        c = jnp.where(m, cnt, 0.0)
+        if not reverse:
+            left_g = jnp.cumsum(g, axis=2)
+            left_h = jnp.cumsum(h, axis=2) + K_EPSILON
+            left_c = jnp.cumsum(c, axis=2)
+            right_g = tot_g - left_g
+            right_h = tot_h - left_h
+            right_c = tot_c - left_c
+        else:
+            # right side accumulates bins > t (scan from the right)
+            rg = jnp.cumsum(g[..., ::-1], axis=2)[..., ::-1]
+            rh = jnp.cumsum(h[..., ::-1], axis=2)[..., ::-1]
+            rc = jnp.cumsum(c[..., ::-1], axis=2)[..., ::-1]
+            # threshold t: right = bins >= t+1
+            right_g = jnp.concatenate([rg[..., 1:], jnp.zeros_like(rg[..., :1])],
+                                      axis=2)
+            right_h = jnp.concatenate([rh[..., 1:], jnp.zeros_like(rh[..., :1])],
+                                      axis=2) + K_EPSILON
+            right_c = jnp.concatenate([rc[..., 1:], jnp.zeros_like(rc[..., :1])],
+                                      axis=2)
+            left_g = tot_g - right_g
+            left_h = tot_h - right_h
+            left_c = tot_c - right_c
+
+        ok = (thresh_valid
+              & (left_c >= p.min_data_in_leaf)
+              & (right_c >= p.min_data_in_leaf)
+              & (left_h >= p.min_sum_hessian_in_leaf)
+              & (right_h >= p.min_sum_hessian_in_leaf)
+              & feature_mask[None, :, None])
+
+        gains = (leaf_gain(left_g, left_h, p, left_c, parent_out)
+                 + leaf_gain(right_g, right_h, p, right_c, parent_out))
+        # local monotone check (ref: GetSplitGains USE_MC branch returns 0)
+        mono = monotone[None, :, None]
+        lo = calculate_leaf_output(left_g, left_h, p, left_c, parent_out)
+        ro = calculate_leaf_output(right_g, right_h, p, right_c, parent_out)
+        viol = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+        gains = jnp.where(viol, 0.0, gains)
+        gains = jnp.where(ok & (gains > min_gain_shift), gains, K_MIN_SCORE)
+
+        if reverse:
+            # prefer LARGEST threshold on ties (reverse scan visits high t
+            # first and replaces only on strictly-greater gain)
+            idx_rev = jnp.argmax(gains[..., ::-1], axis=2)
+            t_best = B - 1 - idx_rev
+        else:
+            t_best = jnp.argmax(gains, axis=2)
+        g_best = jnp.take_along_axis(gains, t_best[..., None], axis=2)[..., 0]
+        pack = [left_g, left_h, left_c, right_g, right_h, right_c]
+        picked = [jnp.take_along_axis(a, t_best[..., None], axis=2)[..., 0]
+                  for a in pack]
+        return t_best.astype(jnp.int32), g_best, picked
+
+    # reverse scan (missing -> left; valid thresholds 0..nb-2-isNaN, skip
+    # default_bin-1 for zero-missing); run unless (nb<=2 and missing NaN)
+    rev_thresh_valid = ((t_iota <= nb - 2 - (mt == MISSING_NAN))
+                        & ~((mt == MISSING_ZERO) & (t_iota == db - 1))
+                        & ~((nb <= 2) & (mt == MISSING_NAN)))
+    t_rev, g_rev, s_rev = directional_best(is_missing_bin_rev,
+                                           rev_thresh_valid, reverse=True)
+
+    # forward scan (missing -> right); run iff (nb>2 and missing != None) or
+    # (nb<=2 and missing NaN)
+    fwd_runs = jnp.where(nb > 2, mt != MISSING_NONE, mt == MISSING_NAN)
+    fwd_thresh_valid = ((t_iota <= nb - 2)
+                        & ~((mt == MISSING_ZERO) & (t_iota == db))
+                        & fwd_runs)
+    t_fwd, g_fwd, s_fwd = directional_best(is_missing_bin_fwd,
+                                           fwd_thresh_valid, reverse=False)
+
+    # reverse wins ties (it runs first in the reference)
+    use_fwd = g_fwd > g_rev
+    t_best = jnp.where(use_fwd, t_fwd, t_rev)                       # [S,F]
+    g_best = jnp.where(use_fwd, g_fwd, g_rev)
+    stats = [jnp.where(use_fwd, a, b) for a, b in zip(s_fwd, s_rev)]
+    default_left = ~use_fwd
+
+    # across features: first feature wins ties (argmax picks first max)
+    f_best = jnp.argmax(g_best, axis=1)                              # [S]
+    take = lambda a: jnp.take_along_axis(a, f_best[:, None], axis=1)[:, 0]
+    gain = take(g_best)
+    lg, lh, lc, rg, rh, rc = [take(a) for a in stats]
+    valid = jnp.isfinite(gain)
+
+    left_out = calculate_leaf_output(lg, lh, p, lc, parent_output)
+    right_out = calculate_leaf_output(rg, rh, p, rc, parent_output)
+    out_gain = jnp.where(valid, gain - min_gain_shift[:, 0, 0], K_MIN_SCORE)
+    return BestSplit(
+        feature=jnp.where(valid, f_best.astype(jnp.int32), -1),
+        threshold=take(t_best),
+        default_left=take(default_left),
+        gain=out_gain,
+        left_output=left_out,
+        right_output=right_out,
+        left_sum_grad=lg, left_sum_hess=lh - K_EPSILON, left_count=lc,
+        right_sum_grad=rg, right_sum_hess=rh - K_EPSILON, right_count=rc,
+    )

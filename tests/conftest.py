@@ -1,0 +1,7 @@
+import os
+
+# Multi-device testing on a virtual CPU mesh (SURVEY.md §4 implication):
+# replaces the reference's localhost-subprocess distributed mockup
+# (tests/distributed/_test_distributed.py).
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
