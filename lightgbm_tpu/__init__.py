@@ -1,2 +1,23 @@
-"""lightgbm_tpu: a TPU-native gradient boosting framework."""
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch re-design of the LightGBM feature surface
+(ref: /root/reference, keisho-oh/LightGBM v3.3.1.99) for TPU hardware:
+jit-compiled JAX/XLA histogram + split kernels, tree growth without host
+round trips, and XLA collectives over a device mesh in place of the
+socket/MPI network layer.
+"""
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import CVBooster, cv, train
+from .utils.log import register_logger
+
 __version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "Config", "CVBooster",
+    "train", "cv",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException", "register_logger",
+]

@@ -1,0 +1,539 @@
+"""User-facing Dataset and Booster.
+
+Behavioral analog of ref: python-package/lightgbm/basic.py (Dataset :1122,
+Booster :2512).  There is no ctypes/C-API hop: the "library" is the in-process
+TPU runtime, so `_safe_call`/handle plumbing collapses away while the public
+surface (lazy construction, reference-aligned binning, update/eval/predict,
+model IO, continued training) is preserved.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .boosting import create_boosting
+from .config import Config
+from .dataset import TpuDataset
+from .io import model_io
+from .metric import create_metric, default_metric_for_objective
+from .models.tree import HostTree
+from .objective import create_objective, create_objective_from_string
+from .utils import log
+
+__all__ = ["Dataset", "Booster"]
+
+
+def _to_2d_numpy(data) -> np.ndarray:
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):
+        data = data.values  # pandas
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    if arr.dtype == object:
+        arr = arr.astype(np.float64)
+    return arr
+
+
+class Dataset:
+    """Training dataset with lazy construction
+    (ref: basic.py:1122 Dataset)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[TpuDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        """(ref: basic.py Dataset.construct / _lazy_init)"""
+        if self._inner is not None:
+            return self
+        cfg = Config(self.params)
+        data = _to_2d_numpy(self.data)
+        feature_names = None
+        if self.feature_name != "auto" and self.feature_name is not None:
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cats: Sequence[int] = ()
+        if self.categorical_feature != "auto" \
+                and self.categorical_feature is not None:
+            cats = []
+            for c in self.categorical_feature:
+                if isinstance(c, str):
+                    if feature_names and c in feature_names:
+                        cats.append(feature_names.index(c))
+                else:
+                    cats.append(int(c))
+        ref_inner = None
+        if self.reference is not None:
+            ref_inner = self.reference.construct()._inner
+        self._inner = TpuDataset.from_data(
+            data, cfg, categorical_feature=cats, feature_names=feature_names,
+            reference=ref_inner)
+        if self.label is not None:
+            self._inner.metadata.set_label(np.asarray(self.label))
+        if self.weight is not None:
+            self._inner.metadata.set_weight(np.asarray(self.weight))
+        if self.group is not None:
+            self._inner.metadata.set_group(np.asarray(self.group))
+        if self.init_score is not None:
+            self._inner.metadata.set_init_score(np.asarray(self.init_score))
+        if self.free_raw_data:
+            # keep raw features for prediction-time use only if small
+            pass
+        return self
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None and label is not None:
+            self._inner.metadata.set_label(np.asarray(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(
+                None if weight is None else np.asarray(weight))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None and group is not None:
+            self._inner.metadata.set_group(np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(
+                None if init_score is None else np.asarray(init_score))
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """(ref: basic.py Dataset.set_field)"""
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        raise ValueError(f"Unknown field name: {field_name}")
+
+    def get_field(self, field_name: str):
+        md = self.construct()._inner.metadata
+        if field_name == "label":
+            return md.label
+        if field_name == "weight":
+            return md.weight
+        if field_name == "group":
+            return md.query_boundaries
+        if field_name == "init_score":
+            return md.init_score
+        raise ValueError(f"Unknown field name: {field_name}")
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def get_group(self):
+        # boundaries -> per-query sizes (ref: basic.py:2321 get_group diffs)
+        boundaries = self.get_field("group")
+        return None if boundaries is None else np.diff(boundaries)
+
+    # ------------------------------------------------------------------
+    def num_data(self) -> int:
+        return self.construct()._inner.num_data
+
+    def num_feature(self) -> int:
+        return self.construct()._inner.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        return self.construct()._inner.feature_names
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing bin mappers (ref: basic.py Dataset.subset)."""
+        self.construct()
+        sub = Dataset.__new__(Dataset)
+        sub.data = None
+        sub.label = None
+        sub.reference = self
+        sub.weight = None
+        sub.group = None
+        sub.init_score = None
+        sub.feature_name = self.feature_name
+        sub.categorical_feature = self.categorical_feature
+        sub.params = dict(self.params)
+        if params:
+            sub.params.update(params)
+        sub.free_raw_data = self.free_raw_data
+        sub.used_indices = np.asarray(used_indices)
+        sub._inner = self._inner.subset(sub.used_indices)
+        sub._predictor = None
+        if self.data is not None:
+            sub.data = _to_2d_numpy(self.data)[sub.used_indices]
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        """(ref: basic.py Dataset.create_valid)"""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()._inner.save_binary(filename)
+        return self
+
+
+class Booster:
+    """Booster: training + prediction handle (ref: basic.py:2512)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._gbdt = None
+        self.models: List[HostTree] = []
+        self.objective = None
+        self.config: Optional[Config] = None
+        self.train_set: Optional[Dataset] = None
+        self.valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self.loaded_parameter = ""
+        self.average_output = False
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.monotone_constraints = None
+        self.label_index = 0
+
+        if train_set is not None:
+            self._init_train(train_set)
+        elif model_file is not None:
+            with open(model_file, "r") as fh:
+                self._load_model_string(fh.read())
+        elif model_str is not None:
+            self._load_model_string(model_str)
+
+    # ------------------------------------------------------------------
+    def _init_train(self, train_set: Dataset) -> None:
+        if not isinstance(train_set, Dataset):
+            raise TypeError("Training data should be Dataset instance")
+        merged = dict(train_set.params)
+        merged.update(self.params)
+        self.config = Config(merged)
+        train_set.params = merged
+        train_set.construct()
+        self.train_set = train_set
+        inner = train_set._inner
+        self.objective = create_objective(self.config)
+        if self.objective is not None:
+            if inner.metadata.label is None:
+                raise ValueError("Label should not be None")
+            self.objective.init(inner.metadata, inner.num_data)
+        self.num_class = max(1, int(self.config.num_class))
+        self._gbdt = create_boosting(self.config)
+        train_metrics = []
+        if self.config.is_provide_training_metric:
+            train_metrics = self._make_metrics(inner)
+        self._gbdt.init(self.config, inner, self.objective, train_metrics)
+        self.num_tree_per_iteration = self._gbdt.num_tree_per_iteration
+        self.average_output = getattr(self._gbdt, "average_output", False)
+        self.models = self._gbdt.models
+        self.max_feature_idx = inner.num_total_features - 1
+        self.feature_names = inner.feature_names
+        self.feature_infos = inner.feature_infos()
+        if inner.monotone_constraints is not None:
+            self.monotone_constraints = inner.monotone_constraints
+
+    def _make_metrics(self, inner: TpuDataset) -> List:
+        names = [str(m) for m in self.config.metric]
+        if not names:
+            default = default_metric_for_objective(self.config.objective)
+            names = [default] if default else []
+        metrics = []
+        for name in names:
+            m = create_metric(name, self.config)
+            if m is not None:
+                m.init(inner.metadata, inner.num_data)
+                metrics.append(m)
+        return metrics
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        """(ref: basic.py Booster.add_valid)"""
+        if self._gbdt is None:
+            raise Exception("Booster was not trained with a train_set")
+        if data.reference is not self.train_set:
+            data.reference = self.train_set
+        data.construct()
+        metrics = self._make_metrics(data._inner)
+        self._gbdt.add_valid_data(data._inner, name, metrics)
+        self.valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        return self
+
+    # ------------------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; True if no further splits possible
+        (ref: basic.py:2936 Booster.update)."""
+        if train_set is not None and train_set is not self.train_set:
+            raise Exception("Replacing train_set is not supported yet")
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        if self.objective is not None:
+            raise Exception(
+                "Cannot use custom objective when the booster was created "
+                "with a built-in objective; set objective='none'")
+        grad, hess = fobj(self.__inner_predict_train(), self.train_set)
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, np.float32).reshape(
+            self.num_tree_per_iteration, -1)
+        hess = np.asarray(hess, np.float32).reshape(
+            self.num_tree_per_iteration, -1)
+        return self._gbdt.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.iter if self._gbdt is not None else \
+            len(self.models) // max(1, self.num_tree_per_iteration)
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def __inner_predict_train(self) -> np.ndarray:
+        return np.asarray(self._gbdt.scores, np.float64).reshape(-1)
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return self._eval_set("training", None, feval)
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for i, name in enumerate(self.name_valid_sets):
+            out.extend(self._eval_set(name, i, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self.valid_sets):
+            if vs is data:
+                return self._eval_set(self.name_valid_sets[i], i, feval)
+        raise Exception("Data should be added with add_valid first")
+
+    def _eval_set(self, name: str, valid_idx: Optional[int], feval) -> List:
+        """Returns [(dataset_name, metric_name, value, is_higher_better)]."""
+        g = self._gbdt
+        out = []
+        if valid_idx is None:
+            score = np.asarray(g.scores, np.float64)
+            metrics = g.training_metrics
+            dataset = self.train_set
+        else:
+            score = np.asarray(g.valid_scores[valid_idx], np.float64)
+            metrics = g.valid_metrics[valid_idx]
+            dataset = self.valid_sets[valid_idx]
+        if getattr(g, "average_output", False):
+            score = score / max(1, g.num_iterations_trained)
+        for m in metrics:
+            for mn, v in zip(m.names, m.eval(score, self.objective)):
+                out.append((name, mn, v, m.is_bigger_better))
+        if feval is not None:
+            for f in (feval if isinstance(feval, list) else [feval]):
+                ret = f(score.reshape(-1), dataset)
+                rets = ret if isinstance(ret, list) else [ret]
+                for mn, v, hb in rets:
+                    out.append((name, mn, v, hb))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        """(ref: basic.py:3449 Booster.predict → predictor.hpp)"""
+        X = _to_2d_numpy(data).astype(np.float64)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        # only num_iteration=None means "use best_iteration"; an explicit
+        # <=0 means all trees (ref: basic.py predict num_iteration handling)
+        if num_iteration is None:
+            num_iteration = self.best_iteration \
+                if self.best_iteration > 0 else -1
+        total_iter = len(self.models) // max(1, k)
+        if num_iteration <= 0:
+            num_iteration = total_iter - start_iteration
+        num_iteration = min(num_iteration, total_iter - start_iteration)
+        lo = start_iteration * k
+        hi = (start_iteration + num_iteration) * k
+
+        if pred_leaf:
+            out = np.zeros((n, hi - lo), np.int32)
+            for i, t in enumerate(self.models[lo:hi]):
+                out[:, i] = t.predict_leaf_index(X)
+            return out
+        if pred_contrib:
+            from .io.shap import predict_contrib
+            return predict_contrib(self, X, lo, hi)
+
+        raw = np.zeros((k, n), np.float64)
+        for i, t in enumerate(self.models[lo:hi]):
+            raw[(lo + i) % k] += t.predict_rows(X)
+        if self.average_output and num_iteration > 0:
+            raw /= num_iteration
+        if not raw_score and self.objective is not None:
+            if k > 1:
+                return self.objective.convert_output(raw.T)
+            return np.asarray(self.objective.convert_output(raw[0]))
+        return raw[0] if k == 1 else raw.T
+
+    # ------------------------------------------------------------------
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """(ref: basic.py Booster.reset_parameter → gbdt.cpp ResetConfig)"""
+        self.params.update(params)
+        if self._gbdt is not None:
+            self.config.update(params)
+            self._gbdt.reset_config(self.config)
+        return self
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, start_iteration: int = 0,
+                        num_iteration: int = -1,
+                        importance_type: Union[int, str] = "split") -> str:
+        it = 0 if importance_type in (0, "split") else 1
+        return model_io.save_model_to_string(self, start_iteration,
+                                             num_iteration, it)
+
+    def save_model(self, filename: str, start_iteration: int = 0,
+                   num_iteration: int = -1,
+                   importance_type: Union[int, str] = "split") -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(start_iteration, num_iteration,
+                                          importance_type))
+        return self
+
+    def dump_model(self, start_iteration: int = 0,
+                   num_iteration: int = -1) -> dict:
+        import json as _json
+        return _json.loads(model_io.dump_model_json(self, start_iteration,
+                                                    num_iteration))
+
+    def _load_model_string(self, model_str: str) -> None:
+        header, trees, params = model_io.parse_model_string(model_str)
+        self.models = trees
+        self.loaded_parameter = params
+        self.num_class = int(header.get("num_class", 1))
+        self.num_tree_per_iteration = int(
+            header.get("num_tree_per_iteration", 1))
+        self.max_feature_idx = int(header.get("max_feature_idx", 0))
+        self.label_index = int(header.get("label_index", 0))
+        self.average_output = header.get("average_output", "0") == "1"
+        self.feature_names = header.get("feature_names", "").split()
+        self.feature_infos = header.get("feature_infos", "").split()
+        obj_str = header.get("objective", "none")
+        self.objective = create_objective_from_string(obj_str)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        it = 0 if importance_type == "split" else 1
+        models = self.models
+        if iteration is not None and iteration > 0:
+            models = models[:iteration * self.num_tree_per_iteration]
+        return model_io.feature_importance(models, self.max_feature_idx + 1,
+                                           it)
+
+    def feature_name(self) -> List[str]:
+        return self.feature_names
+
+    def num_feature(self) -> int:
+        return self.max_feature_idx + 1
+
+    # ------------------------------------------------------------------
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs):
+        """Refit leaf values on new data (ref: basic.py:3506 Booster.refit,
+        gbdt.cpp:287 RefitTree)."""
+        X = _to_2d_numpy(data).astype(np.float64)
+        label = np.asarray(label, np.float64).reshape(-1)
+        import copy
+        new_booster = copy.deepcopy(self)
+        # leaf assignment per tree, then leaf values blended:
+        # new = decay * old + (1-decay) * newly-fitted mean residual value
+        cfg = Config(self.params) if self.params else Config({})
+        obj = self.objective
+        k = self.num_tree_per_iteration
+        n = X.shape[0]
+        scores = np.zeros((k, n))
+        if obj is not None:
+            import jax.numpy as jnp
+            from .dataset import Metadata
+            md = Metadata(n)
+            md.set_label(label)
+            obj.init(md, n)
+        for i, t in enumerate(new_booster.models):
+            tid = i % k
+            leaves = t.predict_leaf_index(X)
+            if obj is not None:
+                g, h = obj.get_gradients(jnp.asarray(scores, jnp.float32))
+                g, h = np.asarray(g), np.asarray(h)
+            else:
+                g = scores - label[None, :]
+                h = np.ones_like(g)
+            for leaf in range(t.num_leaves):
+                rows = leaves == leaf
+                if rows.any():
+                    sum_g = g[tid, rows].sum()
+                    sum_h = h[tid, rows].sum()
+                    new_out = -sum_g / (sum_h + cfg.lambda_l2) \
+                        * t.shrinkage if sum_h > 0 else 0.0
+                    t.leaf_value[leaf] = (decay_rate * t.leaf_value[leaf]
+                                          + (1.0 - decay_rate) * new_out)
+            scores[tid] += t.predict_rows(X)
+        return new_booster
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        model_str = self.model_to_string(num_iteration=-1)
+        booster = Booster(model_str=model_str)
+        booster.params = dict(self.params)
+        return booster

@@ -1,0 +1,831 @@
+"""GBDT training driver + DART / GOSS / RF variants.
+
+TPU-native analog of the reference boosting layer (ref: src/boosting/gbdt.cpp,
+dart.hpp, goss.hpp, rf.hpp).  Orchestration (per-iteration bookkeeping, model
+list, bagging index logic, early stopping) runs on host; all O(num_data) math
+— gradients, histograms, tree growth, score updates — runs jit-compiled on
+device.  Semantics follow gbdt.cpp:371 TrainOneIter:
+
+    boost-from-average -> gradients -> bagging -> per-class tree train ->
+    renew leaf outputs -> shrinkage -> score update -> (bias on first iter)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import TpuDataset
+from ..models.learner import FeatureMeta, grow_tree_depthwise, grow_tree_leafwise
+from ..models.tree import HostTree, TreeArrays
+from ..ops.predict import add_tree_score
+from ..ops.split import SplitParams
+from ..utils import log
+
+K_EPSILON = 1e-15
+
+
+def feature_meta_from_dataset(ds: TpuDataset) -> FeatureMeta:
+    default_bins = np.array([ds.mappers[j].default_bin for j in
+                             ds.used_features], np.int32)
+    if ds.monotone_constraints is not None:
+        mono = ds.monotone_constraints[ds.used_features].astype(np.int32)
+    else:
+        mono = np.zeros(ds.num_features, np.int32)
+    return FeatureMeta(
+        num_bin=jnp.asarray(ds.num_bin_per_feat),
+        missing_type=jnp.asarray(ds.missing_types),
+        default_bin=jnp.asarray(default_bins),
+        monotone=jnp.asarray(mono))
+
+
+def split_params_from_config(config: Config) -> SplitParams:
+    return SplitParams(
+        lambda_l1=float(config.lambda_l1),
+        lambda_l2=float(config.lambda_l2),
+        max_delta_step=float(config.max_delta_step),
+        min_data_in_leaf=int(config.min_data_in_leaf),
+        min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+        min_gain_to_split=float(config.min_gain_to_split),
+        path_smooth=float(config.path_smooth),
+        monotone_penalty=float(config.monotone_penalty))
+
+
+class _DeviceTree:
+    """Per-model device arrays for score updates/re-routing (DART)."""
+
+    __slots__ = ("leaf_value", "split_feature", "threshold_bin",
+                 "default_left", "left_child", "right_child", "max_depth",
+                 "num_leaves")
+
+    def __init__(self, host_tree: HostTree, inner_feature: np.ndarray):
+        self.num_leaves = host_tree.num_leaves
+        self.max_depth = (int(host_tree.leaf_depth.max())
+                          if getattr(host_tree, "leaf_depth", None) is not None
+                          and len(host_tree.leaf_depth) else
+                          max(1, host_tree.num_leaves - 1))
+        self.leaf_value = jnp.asarray(host_tree.leaf_value, jnp.float32)
+        self.split_feature = jnp.asarray(inner_feature, jnp.int32)
+        self.threshold_bin = jnp.asarray(host_tree.threshold_bin, jnp.int32)
+        self.default_left = jnp.asarray(
+            (host_tree.decision_type & 2).astype(bool))
+        self.left_child = jnp.asarray(host_tree.left_child, jnp.int32)
+        self.right_child = jnp.asarray(host_tree.right_child, jnp.int32)
+
+
+def _round_up_pow2(n: int) -> int:
+    return 1 << max(1, (n - 1).bit_length())
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver (ref: src/boosting/gbdt.h:35)."""
+
+    name = "gbdt"
+
+    def __init__(self):
+        self.config: Optional[Config] = None
+        self.train_data: Optional[TpuDataset] = None
+        self.objective = None
+        self.models: List[HostTree] = []
+        self.device_trees: List[_DeviceTree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.average_output = False
+
+    # ------------------------------------------------------------------
+    def init(self, config: Config, train_data: TpuDataset, objective,
+             training_metrics: Sequence = ()) -> None:
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.training_metrics = list(training_metrics)
+        self.num_data = train_data.num_data
+        self.num_tree_per_iteration = (objective.num_model_per_iteration
+                                       if objective is not None else
+                                       max(1, int(config.num_class)))
+        self.shrinkage_rate = float(config.learning_rate)
+        self.max_leaves = max(2, int(config.num_leaves))
+        # static padded bin count shared by all jit instances
+        self.max_bins = int(train_data.max_num_bin)
+        self.params = split_params_from_config(config)
+        self.meta = feature_meta_from_dataset(train_data)
+        self.bins_dev = jnp.asarray(train_data.bins)
+        self.grow_policy = {"auto": "leafwise"}.get(config.grow_policy,
+                                                    config.grow_policy)
+
+        md = train_data.metadata
+        k, n = self.num_tree_per_iteration, self.num_data
+        self.has_init_score = md.init_score is not None
+        if self.has_init_score:
+            init = np.asarray(md.init_score, np.float64)
+            if init.size == n * k:
+                scores = init.reshape(k, n, order="C")
+            else:
+                scores = np.tile(init.reshape(1, n), (k, 1))
+            self.scores = jnp.asarray(scores, jnp.float32)
+        else:
+            self.scores = jnp.zeros((k, n), jnp.float32)
+
+        self.valid_data: List[TpuDataset] = []
+        self.valid_bins: List = []
+        self.valid_scores: List = []
+        self.valid_metrics: List[List] = []
+        self.valid_names: List[str] = []
+
+        self.class_need_train = [
+            objective.class_need_train(i) if objective is not None else True
+            for i in range(self.num_tree_per_iteration)]
+
+        # bagging state (ref: gbdt.cpp:686-758 ResetBaggingConfig)
+        self.bag_rng = np.random.RandomState(config.bagging_seed)
+        self.feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        self.balanced_bagging = False
+        self.is_bagging = False
+        if config.bagging_freq > 0:
+            if config.bagging_fraction < 1.0:
+                self.is_bagging = True
+            elif (self.objective is not None
+                  and self.objective.name == "binary"
+                  and (config.pos_bagging_fraction < 1.0
+                       or config.neg_bagging_fraction < 1.0)):
+                self.is_bagging = True
+                self.balanced_bagging = True
+        self.bag_weight = jnp.ones((n,), jnp.float32)  # 1=in bag
+        self.bag_cnt = n
+
+        self.best_score: Dict[Tuple[int, str], float] = {}
+        self.best_iter: Dict[Tuple[int, str], int] = {}
+        self.early_stopping_round = int(config.early_stopping_round)
+        self.es_first_metric_only = bool(config.first_metric_only)
+
+        if config.feature_fraction_bynode < 1.0:
+            log.warning("feature_fraction_bynode is not supported yet on the "
+                        "TPU learner; using per-tree feature_fraction only")
+
+    # ------------------------------------------------------------------
+    def add_valid_data(self, valid_data: TpuDataset, name: str,
+                       metrics: Sequence) -> None:
+        """(ref: gbdt.cpp AddValidDataset)"""
+        self.valid_data.append(valid_data)
+        self.valid_bins.append(jnp.asarray(valid_data.bins))
+        k = self.num_tree_per_iteration
+        n = valid_data.num_data
+        md = valid_data.metadata
+        if md is not None and md.init_score is not None:
+            init = np.asarray(md.init_score, np.float64)
+            if init.size == n * k:
+                s = init.reshape(k, n, order="C")
+            else:
+                s = np.tile(init.reshape(1, n), (k, 1))
+            self.valid_scores.append(jnp.asarray(s, jnp.float32))
+        else:
+            self.valid_scores.append(jnp.zeros((k, n), jnp.float32))
+        self.valid_metrics.append(list(metrics))
+        self.valid_names.append(name)
+        # replay existing model onto the new valid set (continued training)
+        for i, dt in enumerate(self.device_trees):
+            tree_id = i % self.num_tree_per_iteration
+            self.valid_scores[-1] = self._add_tree_to_score(
+                self.valid_scores[-1], self.valid_bins[-1], dt, tree_id)
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        """(ref: gbdt.cpp:346 BoostFromAverage)"""
+        cfg = self.config
+        if (self.models or self.has_init_score or self.objective is None):
+            return 0.0
+        if not (cfg.boost_from_average or self.train_data.num_features == 0):
+            if self.objective.name in ("regression_l1", "quantile", "mape"):
+                log.warning("Disabling boost_from_average in %s may cause the "
+                            "slow convergence", self.objective.name)
+            return 0.0
+        init_score = self.objective.boost_from_score(class_id)
+        if abs(init_score) > K_EPSILON:
+            if update_scorer:
+                self.scores = self.scores.at[class_id].add(init_score)
+                for vi in range(len(self.valid_scores)):
+                    self.valid_scores[vi] = \
+                        self.valid_scores[vi].at[class_id].add(init_score)
+            log.info("Start training from score %f", init_score)
+            return init_score
+        return 0.0
+
+    def _boosting_scores(self):
+        """Scores used for gradient computation (DART overrides)."""
+        return self.scores
+
+    def _get_gradients(self):
+        scores = self._boosting_scores()
+        grad, hess = self.objective.get_gradients(scores)
+        return grad, hess
+
+    # ------------------------------------------------------------------
+    def _bagging(self, it: int, grad, hess):
+        """Recompute the in-bag weight vector (ref: gbdt.cpp:230 Bagging).
+        Returns possibly-modified (grad, hess) (GOSS multiplies)."""
+        cfg = self.config
+        if not self.is_bagging or cfg.bagging_freq <= 0 \
+                or it % cfg.bagging_freq != 0:
+            return grad, hess
+        n = self.num_data
+        if self.balanced_bagging:
+            label = self.train_data.metadata.label
+            frac = np.where(label > 0, cfg.pos_bagging_fraction,
+                            cfg.neg_bagging_fraction)
+            mask = self.bag_rng.random_sample(n) < frac
+        else:
+            mask = self.bag_rng.random_sample(n) < cfg.bagging_fraction
+        self.bag_cnt = int(mask.sum())
+        log.debug("Re-bagging, using %d data to train", self.bag_cnt)
+        self.bag_weight = jnp.asarray(mask.astype(np.float32))
+        return grad, hess
+
+    # ------------------------------------------------------------------
+    def _grow(self, gh):
+        fm = self._feature_mask()
+        if self.grow_policy == "depthwise":
+            return grow_tree_depthwise(
+                self.bins_dev, gh, self.meta, fm, self.params,
+                self.max_leaves, self.max_bins,
+                int(self.config.max_depth),
+                hist_impl=self.config.tpu_histogram_impl)
+        return grow_tree_leafwise(
+            self.bins_dev, gh, self.meta, fm, self.params,
+            self.max_leaves, self.max_bins, int(self.config.max_depth),
+            hist_impl=self.config.tpu_histogram_impl)
+
+    def _feature_mask(self):
+        """Per-tree column sampling (ref: col_sampler.hpp:20)."""
+        F = self.train_data.num_features
+        frac = float(self.config.feature_fraction)
+        if frac >= 1.0:
+            return jnp.ones((F,), bool)
+        k = max(1, int(round(F * frac)))
+        chosen = self.feat_rng.choice(F, size=k, replace=False)
+        mask = np.zeros(F, bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def _to_host_tree(self, tree: TreeArrays, shrinkage: float) -> Tuple[
+            HostTree, np.ndarray, np.ndarray]:
+        """Device TreeArrays -> HostTree with real thresholds.
+
+        Returns (host_tree, inner_split_feature, row_leaf placeholder unused).
+        """
+        ds = self.train_data
+        nl = int(tree.num_leaves)
+        ht = HostTree(nl, shrinkage=1.0)
+        ni = max(0, nl - 1)
+        sf_inner = np.asarray(tree.split_feature)[:ni]
+        tb = np.asarray(tree.threshold_bin)[:ni]
+        dl = np.asarray(tree.default_left)[:ni]
+        ht.split_feature = np.array(
+            [ds.real_feature_index(int(f)) if f >= 0 else 0
+             for f in sf_inner], np.int32)
+        thr = np.zeros(ni, np.float64)
+        dt = np.zeros(ni, np.int32)
+        for i in range(ni):
+            f = int(sf_inner[i])
+            if f < 0:
+                continue
+            m = ds.mappers[ds.real_feature_index(f)]
+            thr[i] = m.bin_to_value(int(tb[i]))
+            dt[i] = HostTree.make_decision_type(
+                False, bool(dl[i]), int(m.missing_type))
+        ht.threshold = thr
+        ht.threshold_bin = tb.astype(np.int32)
+        ht.decision_type = dt
+        ht.left_child = np.asarray(tree.left_child)[:ni].astype(np.int32)
+        ht.right_child = np.asarray(tree.right_child)[:ni].astype(np.int32)
+        ht.split_gain = np.asarray(tree.split_gain)[:ni].astype(np.float64)
+        ht.internal_value = np.asarray(
+            tree.internal_value)[:ni].astype(np.float64)
+        ht.internal_weight = np.asarray(
+            tree.internal_weight)[:ni].astype(np.float64)
+        ht.internal_count = np.asarray(
+            tree.internal_count)[:ni].astype(np.int64)
+        ht.leaf_value = np.asarray(tree.leaf_value)[:nl].astype(np.float64)
+        ht.leaf_weight = np.asarray(tree.leaf_weight)[:nl].astype(np.float64)
+        ht.leaf_count = np.asarray(tree.leaf_count)[:nl].astype(np.int64)
+        ht.leaf_depth = np.asarray(tree.leaf_depth)[:nl].astype(np.int32)
+        return ht, sf_inner
+
+    # ------------------------------------------------------------------
+    def _renew_tree_output(self, ht: HostTree, row_leaf: np.ndarray,
+                           class_id: int) -> None:
+        """Leaf renewal for L1-family objectives (ref:
+        serial_tree_learner.cpp:717 RenewTreeOutput; in-bag rows only)."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output:
+            return
+        label = self.train_data.metadata.label
+        score = np.asarray(self.scores[class_id], np.float64)
+        in_bag = np.asarray(self.bag_weight) > 0
+        residual = label.astype(np.float64) - score
+        for leaf in range(ht.num_leaves):
+            rows = np.nonzero((row_leaf == leaf) & in_bag)[0]
+            if len(rows) == 0:
+                continue
+            new_out = obj.renew_tree_output(ht.leaf_value[leaf],
+                                            residual[rows], rows)
+            ht.leaf_value[leaf] = new_out
+
+    # ------------------------------------------------------------------
+    def _add_tree_to_score(self, score, bins_dev, dt: _DeviceTree,
+                           tree_id: int, scale: float = 1.0):
+        if dt.num_leaves <= 1:
+            return score.at[tree_id].add(float(dt.leaf_value[0]) * scale)
+        steps = _round_up_pow2(dt.max_depth + 1)
+        lv = dt.leaf_value * scale if scale != 1.0 else dt.leaf_value
+        new_row = add_tree_score(
+            score[tree_id], bins_dev, lv, dt.split_feature, dt.threshold_bin,
+            dt.default_left, dt.left_child, dt.right_child,
+            self.meta.num_bin, self.meta.missing_type, self.meta.default_bin,
+            max_steps=steps)
+        return score.at[tree_id].set(new_row)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """One boosting iteration (ref: gbdt.cpp:371 TrainOneIter).
+        Returns True if training should stop."""
+        k, n = self.num_tree_per_iteration, self.num_data
+        init_scores = [0.0] * k
+        if gradients is None or hessians is None:
+            if self.objective is None:
+                log.fatal("Cannot train without an objective: pass a "
+                          "built-in objective or supply gradients via "
+                          "Booster.update(fobj=...)")
+            for tid in range(k):
+                init_scores[tid] = self._boost_from_average(tid, True)
+            grad, hess = self._get_gradients()
+        else:
+            grad = jnp.asarray(gradients, jnp.float32).reshape(k, n)
+            hess = jnp.asarray(hessians, jnp.float32).reshape(k, n)
+
+        grad, hess = self._bagging(self.iter, grad, hess)
+
+        should_continue = False
+        for tid in range(k):
+            if self.class_need_train[tid] and self.train_data.num_features > 0:
+                gh = jnp.stack([grad[tid] * self.bag_weight,
+                                hess[tid] * self.bag_weight,
+                                self.bag_weight], axis=1)
+                tree, row_leaf = self._grow(gh)
+                nl = int(tree.num_leaves)
+            else:
+                nl = 1
+
+            if nl > 1:
+                should_continue = True
+                ht, sf_inner = self._to_host_tree(tree, self.shrinkage_rate)
+                row_leaf_np = None
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    row_leaf_np = np.asarray(row_leaf)
+                    self._renew_tree_output(ht, row_leaf_np, tid)
+                # shrinkage then score update (ref: gbdt.cpp:414-419)
+                ht.apply_shrinkage(self.shrinkage_rate)
+                lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
+                self.scores = self.scores.at[tid].add(
+                    lv_dev[row_leaf])
+                dt = _DeviceTree(ht, sf_inner)
+                for vi in range(len(self.valid_scores)):
+                    self.valid_scores[vi] = self._add_tree_to_score(
+                        self.valid_scores[vi], self.valid_bins[vi], dt, tid)
+                if abs(init_scores[tid]) > K_EPSILON:
+                    ht.add_bias(init_scores[tid])
+                    dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
+                self.models.append(ht)
+                self.device_trees.append(dt)
+            else:
+                # constant tree (ref: gbdt.cpp:422-441)
+                ht = HostTree(1)
+                if len(self.models) < k:
+                    if not self.class_need_train[tid]:
+                        output = (self.objective.boost_from_score(tid)
+                                  if self.objective is not None else 0.0)
+                    else:
+                        output = init_scores[tid]
+                    ht.leaf_value[0] = output
+                    self.scores = self.scores.at[tid].add(output)
+                    for vi in range(len(self.valid_scores)):
+                        self.valid_scores[vi] = \
+                            self.valid_scores[vi].at[tid].add(output)
+                self.models.append(ht)
+                self.device_trees.append(
+                    _DeviceTree(ht, np.zeros(0, np.int32)))
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > k:
+                for _ in range(k):
+                    self.models.pop()
+                    self.device_trees.pop()
+            return True
+        self.iter += 1
+        return False
+
+    # ------------------------------------------------------------------
+    def reset_config(self, config: Config) -> None:
+        """Re-derive training state from an updated config
+        (ref: gbdt.cpp:686-839 ResetConfig/ResetBaggingConfig)."""
+        self.config = config
+        self.shrinkage_rate = float(config.learning_rate)
+        self.max_leaves = max(2, int(config.num_leaves))
+        self.params = split_params_from_config(config)
+        self.grow_policy = {"auto": "leafwise"}.get(config.grow_policy,
+                                                    config.grow_policy)
+        n = self.num_data
+        self.is_bagging = False
+        self.balanced_bagging = False
+        if config.bagging_freq > 0:
+            if config.bagging_fraction < 1.0:
+                self.is_bagging = True
+            elif (self.objective is not None
+                  and self.objective.name == "binary"
+                  and (config.pos_bagging_fraction < 1.0
+                       or config.neg_bagging_fraction < 1.0)):
+                self.is_bagging = True
+                self.balanced_bagging = True
+        if not self.is_bagging:
+            self.bag_weight = jnp.ones((n,), jnp.float32)
+            self.bag_cnt = n
+        self.early_stopping_round = int(config.early_stopping_round)
+        self.es_first_metric_only = bool(config.first_metric_only)
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """(ref: gbdt.cpp:456 RollbackOneIter)"""
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for tid in range(k):
+            idx = len(self.models) - k + tid
+            dt = self.device_trees[idx]
+            self.scores = self._add_tree_to_score(
+                self.scores, self.bins_dev, dt, tid, scale=-1.0)
+            for vi in range(len(self.valid_scores)):
+                self.valid_scores[vi] = self._add_tree_to_score(
+                    self.valid_scores[vi], self.valid_bins[vi], dt, tid,
+                    scale=-1.0)
+        del self.models[-k:]
+        del self.device_trees[-k:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
+        """All (dataset_name, metric_name, value, is_higher_better) tuples."""
+        out = []
+        if self.training_metrics:
+            score = np.asarray(self.scores, np.float64)
+            for m in self.training_metrics:
+                for name, v in zip(m.names, m.eval(score, self.objective)):
+                    out.append(("training", name, v, m.is_bigger_better))
+        for vi, metrics in enumerate(self.valid_metrics):
+            score = np.asarray(self.valid_scores[vi], np.float64)
+            for m in metrics:
+                for name, v in zip(m.names, m.eval(score, self.objective)):
+                    out.append((self.valid_names[vi], name, v,
+                                m.is_bigger_better))
+        return out
+
+    def output_metric(self, it: int) -> bool:
+        """Print metrics and run early stopping (ref: gbdt.cpp:519
+        OutputMetric).  Returns True if early stopping fired."""
+        results = self.eval_metrics()
+        if it % self.config.metric_freq == 0:
+            for ds_name, name, v, _ in results:
+                log.info("Iteration:%d, %s %s : %g", it, ds_name, name, v)
+        if self.early_stopping_round <= 0:
+            return False
+        stop = False
+        first = True
+        for ds_name, name, v, bigger in results:
+            if ds_name == "training":
+                continue
+            if self.es_first_metric_only and not first:
+                break
+            key = (ds_name, name)
+            cmp = v if bigger else -v
+            if key not in self.best_score or cmp > self.best_score[key]:
+                self.best_score[key] = cmp
+                self.best_iter[key] = it
+            elif it - self.best_iter[key] >= self.early_stopping_round:
+                stop = True
+            first = False
+        return stop
+
+    def train(self) -> None:
+        """Full training loop (ref: gbdt.cpp:266 Train)."""
+        for it in range(self.iter, int(self.config.num_iterations)):
+            finished = self.train_one_iter()
+            if not finished:
+                finished = self.output_metric(self.iter)
+                if finished:
+                    best = min(self.best_iter.values()) \
+                        if self.best_iter else self.iter
+                    log.info("Early stopping at iteration %d, the best "
+                             "iteration round is %d", self.iter, best)
+                    # drop trees after the best iteration
+                    extra = (self.iter - best) * self.num_tree_per_iteration
+                    for _ in range(extra):
+                        self.models.pop()
+                        self.device_trees.pop()
+                    self.iter = best
+            if finished:
+                break
+
+    # ------------------------------------------------------------------
+    @property
+    def num_iterations_trained(self) -> int:
+        return len(self.models) // max(1, self.num_tree_per_iteration)
+
+
+class DART(GBDT):
+    """DART dropout boosting (ref: src/boosting/dart.hpp:23)."""
+
+    name = "dart"
+
+    def init(self, config, train_data, objective, training_metrics=()):
+        super().init(config, train_data, objective, training_metrics)
+        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+
+    def _boosting_scores(self):
+        # drop trees then compute gradients on the reduced score
+        # (ref: dart.hpp:77-86 GetTrainingScore → DroppingTrees)
+        self._dropping_trees()
+        return self.scores
+
+    def _dropping_trees(self):
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self.drop_rng.random_sample() < cfg.skip_drop
+        if not is_skip:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        cfg.max_drop * inv_avg
+                                        / self.sum_weight)
+                    for i in range(self.iter):
+                        if (self.drop_rng.random_sample()
+                                < drop_rate * self.tree_weight[i] * inv_avg):
+                            self.drop_index.append(self.num_init_iteration + i)
+                            if len(self.drop_index) >= cfg.max_drop > 0:
+                                break
+            else:
+                if cfg.max_drop > 0 and self.iter > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter)
+                for i in range(self.iter):
+                    if self.drop_rng.random_sample() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        # remove dropped trees from the training score (ref: dart.hpp:131-137)
+        k = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for tid in range(k):
+                dt = self.device_trees[i * k + tid]
+                self.scores = self._add_tree_to_score(
+                    self.scores, self.bins_dev, dt, tid, scale=-1.0)
+        nd = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + nd)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if nd == 0 else
+                                   cfg.learning_rate
+                                   / (cfg.learning_rate + nd))
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    def _normalize(self):
+        """(ref: dart.hpp:150-199 Normalize)"""
+        cfg = self.config
+        nd = len(self.drop_index)
+        if nd == 0:
+            return
+        k = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for tid in range(k):
+                idx = i * k + tid
+                ht = self.models[idx]
+                dt = self.device_trees[idx]
+                if not cfg.xgboost_dart_mode:
+                    # dropped tree rescaled to k/(k+1) of its old weight
+                    ht.apply_shrinkage(nd / (nd + 1.0))
+                    # valid score gets -1/(k+1) of old; train gets +k/(k+1)
+                    for vi in range(len(self.valid_scores)):
+                        self.valid_scores[vi] = self._add_tree_to_score(
+                            self.valid_scores[vi], self.valid_bins[vi], dt,
+                            tid, scale=-1.0 / (nd + 1.0))
+                    self.scores = self._add_tree_to_score(
+                        self.scores, self.bins_dev, dt, tid,
+                        scale=nd / (nd + 1.0))
+                else:
+                    lr = cfg.learning_rate
+                    factor = nd / (nd + lr)
+                    ht.apply_shrinkage(factor)
+                    for vi in range(len(self.valid_scores)):
+                        self.valid_scores[vi] = self._add_tree_to_score(
+                            self.valid_scores[vi], self.valid_bins[vi], dt,
+                            tid, scale=-(1.0 - factor))
+                    self.scores = self._add_tree_to_score(
+                        self.scores, self.bins_dev, dt, tid, scale=factor)
+                dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
+            if not cfg.uniform_drop:
+                j = i - self.num_init_iteration
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[j] / (nd + 1.0)
+                    self.tree_weight[j] *= nd / (nd + 1.0)
+                else:
+                    # (ref: dart.hpp:191-194)
+                    lr = cfg.learning_rate
+                    self.sum_weight -= self.tree_weight[j] / (nd + lr)
+                    self.tree_weight[j] *= nd / (nd + lr)
+
+    def output_metric(self, it):
+        # DART never early-stops (ref: dart.hpp:90-93)
+        super().output_metric(it)
+        return False
+
+
+class GOSS(GBDT):
+    """Gradient-based One-Side Sampling (ref: src/boosting/goss.hpp:25)."""
+
+    name = "goss"
+
+    def init(self, config, train_data, objective, training_metrics=()):
+        super().init(config, train_data, objective, training_metrics)
+        if config.top_rate + config.other_rate > 1.0:
+            log.fatal("top_rate + other_rate cannot be larger than 1.0 in GOSS")
+        if config.top_rate <= 0 or config.other_rate <= 0:
+            log.fatal("top_rate and other_rate should be positive in GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        log.info("Using GOSS")
+        self.is_bagging = False
+
+    def _bagging(self, it, grad, hess):
+        """(ref: goss.hpp:103-159 BaggingHelper/Bagging)"""
+        cfg = self.config
+        n = self.num_data
+        # no subsampling in the first 1/learning_rate iterations
+        if it < int(1.0 / cfg.learning_rate):
+            self.bag_weight = jnp.ones((n,), jnp.float32)
+            self.bag_cnt = n
+            return grad, hess
+        g_np = np.asarray(jnp.sum(jnp.abs(grad * hess), axis=0))
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        threshold = np.partition(g_np, n - top_k)[n - top_k]
+        multiply = (n - top_k) / other_k
+        is_top = g_np >= threshold
+        rest = ~is_top
+        rest_idx = np.nonzero(rest)[0]
+        n_rest = len(rest_idx)
+        if n_rest > 0:
+            take = min(other_k, n_rest)
+            sampled = self.bag_rng.choice(rest_idx, size=take, replace=False)
+        else:
+            sampled = np.zeros(0, np.int64)
+        mask = is_top.copy()
+        mask[sampled] = True
+        mult = np.ones(n, np.float32)
+        mult[sampled] = multiply
+        self.bag_cnt = int(mask.sum())
+        self.bag_weight = jnp.asarray(mask.astype(np.float32))
+        mult_dev = jnp.asarray(mult)[None, :]
+        return grad * mult_dev, hess * mult_dev
+
+
+class RF(GBDT):
+    """Random forest mode (ref: src/boosting/rf.hpp:25).
+
+    No shrinkage; gradients always taken at the constant init score; the
+    stored prediction is the average over trees."""
+
+    name = "rf"
+
+    def init(self, config, train_data, objective, training_metrics=()):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction
+                < 1.0):
+            log.fatal("RF mode requires bagging "
+                      "(bagging_freq > 0, bagging_fraction in (0,1))")
+        super().init(config, train_data, objective, training_metrics)
+        self.shrinkage_rate = 1.0
+        self.average_output = True
+        if objective is None:
+            log.fatal("RF mode do not support custom objective function, "
+                      "please use built-in objectives.")
+        # gradients fixed at the init score (ref: rf.hpp:82-100 Boosting)
+        self.init_scores = [self._rf_init_score(tid)
+                            for tid in range(self.num_tree_per_iteration)]
+        base = jnp.asarray(np.tile(
+            np.asarray(self.init_scores, np.float32)[:, None],
+            (1, self.num_data)))
+        self._fixed_grad, self._fixed_hess = objective.get_gradients(base)
+
+    def _rf_init_score(self, tid):
+        cfg = self.config
+        if self.has_init_score or not cfg.boost_from_average:
+            return 0.0
+        return self.objective.boost_from_score(tid)
+
+    def _boost_from_average(self, class_id, update_scorer):
+        return 0.0
+
+    def _get_gradients(self):
+        return self._fixed_grad, self._fixed_hess
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        k = self.num_tree_per_iteration
+        grad, hess = (self._get_gradients() if gradients is None
+                      else (jnp.asarray(gradients).reshape(k, self.num_data),
+                            jnp.asarray(hessians).reshape(k, self.num_data)))
+        grad, hess = self._bagging(self.iter, grad, hess)
+        should_continue = False
+        for tid in range(k):
+            gh = jnp.stack([grad[tid] * self.bag_weight,
+                            hess[tid] * self.bag_weight,
+                            self.bag_weight], axis=1)
+            tree, row_leaf = self._grow(gh)
+            nl = int(tree.num_leaves)
+            if nl > 1:
+                should_continue = True
+                ht, sf_inner = self._to_host_tree(tree, 1.0)
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    self._renew_tree_output_rf(ht, np.asarray(row_leaf), tid)
+                # bias folded into every tree; the averaged score then
+                # carries it once (ref: rf.hpp:136-138 AddBias)
+                if abs(self.init_scores[tid]) > K_EPSILON:
+                    ht.add_bias(self.init_scores[tid])
+                lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
+                # scores accumulate the SUM; prediction averages
+                self.scores = self.scores.at[tid].add(lv_dev[row_leaf])
+                dt = _DeviceTree(ht, sf_inner)
+                for vi in range(len(self.valid_scores)):
+                    self.valid_scores[vi] = self._add_tree_to_score(
+                        self.valid_scores[vi], self.valid_bins[vi], dt, tid)
+                self.models.append(ht)
+                self.device_trees.append(dt)
+            else:
+                ht = HostTree(1)
+                self.models.append(ht)
+                self.device_trees.append(_DeviceTree(ht,
+                                                     np.zeros(0, np.int32)))
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > k:
+                for _ in range(k):
+                    self.models.pop()
+                    self.device_trees.pop()
+            return True
+        self.iter += 1
+        return False
+
+    def _renew_tree_output_rf(self, ht, row_leaf, tid):
+        # residual against the constant init score (ref: rf.hpp:135-139)
+        label = self.train_data.metadata.label
+        in_bag = np.asarray(self.bag_weight) > 0
+        residual = label.astype(np.float64) - self.init_scores[tid]
+        for leaf in range(ht.num_leaves):
+            rows = np.nonzero((row_leaf == leaf) & in_bag)[0]
+            if len(rows):
+                ht.leaf_value[leaf] = self.objective.renew_tree_output(
+                    ht.leaf_value[leaf], residual[rows], rows)
+
+    def eval_metrics(self):
+        """Metrics see the AVERAGED score in RF mode."""
+        it = max(1, self.num_iterations_trained)
+        out = []
+        if self.training_metrics:
+            score = np.asarray(self.scores, np.float64) / it
+            for m in self.training_metrics:
+                for name, v in zip(m.names, m.eval(score, self.objective)):
+                    out.append(("training", name, v, m.is_bigger_better))
+        for vi, metrics in enumerate(self.valid_metrics):
+            score = np.asarray(self.valid_scores[vi], np.float64) / it
+            for m in metrics:
+                for name, v in zip(m.names, m.eval(score, self.objective)):
+                    out.append((self.valid_names[vi], name, v,
+                                m.is_bigger_better))
+        return out

@@ -1,0 +1,192 @@
+"""Training callbacks.
+
+Behavioral analog of ref: python-package/lightgbm/callback.py (log_evaluation
+:65, record_evaluation :96, reset_parameter :147, early_stopping :187).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Union
+
+from .utils import log
+
+
+class EarlyStopException(Exception):
+    """(ref: callback.py:14)"""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True):
+    """(ref: callback.py:65)"""
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(
+                f"{name}'s {metric}: {value:g}"
+                for name, metric, value, _ in env.evaluation_result_list)
+            log.info("[%d]\t%s", env.iteration + 1, result)
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]):
+    """(ref: callback.py:96)"""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for name, metric, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict()) \
+                .setdefault(metric, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for name, metric, value, _ in env.evaluation_result_list:
+            eval_result[name][metric].append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Union[list, Callable[[int], Any]]):
+    """Reset parameters on schedule, e.g.
+    ``reset_parameter(learning_rate=lambda i: 0.1 * 0.99 ** i)``
+    (ref: callback.py:147)."""
+    def _callback(env: CallbackEnv) -> None:
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal to "
+                        "'num_boost_round'.")
+                new_param = value[env.iteration - env.begin_iteration]
+            else:
+                new_param = value(env.iteration - env.begin_iteration)
+            if new_param != env.params.get(key, None):
+                new_parameters[key] = new_param
+        if new_parameters:
+            env.model.reset_parameter(new_parameters)
+            env.params.update(new_parameters)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: Union[float, list] = 0.0):
+    """(ref: callback.py:187)"""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[list] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = not any(
+            env.params.get(alias, "") == "dart"
+            for alias in ("boosting", "boosting_type", "boost"))
+        if not enabled[0]:
+            log.warning("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric is "
+                "required for evaluation")
+        if stopping_rounds <= 0:
+            raise ValueError("stopping_rounds should be greater than zero.")
+        if verbose:
+            log.info("Training until validation scores don't improve for %d "
+                     "rounds", stopping_rounds)
+
+        n_metrics = len({m[1] for m in env.evaluation_result_list})
+        n_datasets = len(env.evaluation_result_list) // max(1, n_metrics)
+        if isinstance(min_delta, list):
+            if not all(t >= 0 for t in min_delta):
+                raise ValueError(
+                    "Values for early stopping min_delta must be non-negative")
+            if len(min_delta) == 0:
+                deltas = [0.0] * n_datasets * n_metrics
+            elif len(min_delta) == 1:
+                deltas = min_delta * n_datasets * n_metrics
+            else:
+                if len(min_delta) != n_metrics:
+                    raise ValueError(
+                        "Must provide a single value for min_delta or as many "
+                        "as metrics")
+                if first_metric_only and verbose:
+                    log.info("Using only %s for early stopping", min_delta[0])
+                deltas = min_delta * n_datasets
+        else:
+            if min_delta < 0:
+                raise ValueError(
+                    "Early stopping min_delta must be non-negative")
+            deltas = [min_delta] * n_datasets * n_metrics
+
+        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
+        for eval_ret, delta in zip(env.evaluation_result_list, deltas):
+            best_iter.append(0)
+            best_score_list.append(None)
+            if eval_ret[3]:  # is_higher_better
+                best_score.append(float("-inf"))
+                cmp_op.append(
+                    lambda new, best, d=delta: new > best + d)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(
+                    lambda new, best, d=delta: new < best - d)
+
+    def _final_iteration_check(env, eval_name_splitted, i):
+        if env.iteration == env.end_iteration - 1:
+            if verbose:
+                best = "\t".join(
+                    f"{n}'s {m}: {v:g}" for n, m, v, _ in best_score_list[i])
+                log.info("Did not meet early stopping. Best iteration is:"
+                         "\n[%d]\t%s", best_iter[i] + 1, best)
+                if first_metric_only:
+                    log.info("Evaluated only: %s", eval_name_splitted[-1])
+            raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not cmp_op:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, (name, metric, value, _) in \
+                enumerate(env.evaluation_result_list):
+            if best_score_list[i] is None or cmp_op[i](value, best_score[i]):
+                best_score[i] = value
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            eval_name_splitted = metric.split(" ")
+            if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
+                continue
+            if name == "training":
+                _final_iteration_check(env, eval_name_splitted, i)
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    best = "\t".join(
+                        f"{n}'s {m}: {v:g}"
+                        for n, m, v, _ in best_score_list[i])
+                    log.info("Early stopping, best iteration is:\n[%d]\t%s",
+                             best_iter[i] + 1, best)
+                    if first_metric_only:
+                        log.info("Evaluated only: %s",
+                                 eval_name_splitted[-1])
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            _final_iteration_check(env, eval_name_splitted, i)
+    _callback.order = 30
+    return _callback

@@ -289,6 +289,7 @@ class TpuDataset:
     def subset(self, row_indices: np.ndarray) -> "TpuDataset":
         """Row subset sharing mappers (ref: dataset.cpp CopySubrow — used by
         cv folds and bagging-subset paths)."""
+        row_indices = np.asarray(row_indices)
         out = TpuDataset()
         out.bins = self.bins[row_indices]
         out.mappers = self.mappers
@@ -304,7 +305,28 @@ class TpuDataset:
             if md.weight is not None:
                 out.metadata.set_weight(md.weight[row_indices])
             if md.init_score is not None:
-                out.metadata.set_init_score(md.init_score[row_indices])
+                init = md.init_score
+                if init.size == self.num_data:
+                    out.metadata.set_init_score(init[row_indices])
+                else:
+                    # flat [n*k] class-major init score: subset per class
+                    k = init.size // self.num_data
+                    sub = init.reshape(k, self.num_data)[:, row_indices]
+                    out.metadata.set_init_score(sub.reshape(-1))
+            if md.query_boundaries is not None:
+                # rebuild query sizes over the kept rows (fold selections
+                # keep whole queries; partial queries shrink consistently)
+                # run-length encode query ids IN ROW ORDER so group sizes
+                # stay aligned with the (possibly unsorted) subset rows
+                qb = md.query_boundaries
+                row_query = np.searchsorted(qb, row_indices, side="right") - 1
+                if len(row_query):
+                    change = np.concatenate(
+                        [[True], row_query[1:] != row_query[:-1]])
+                    starts = np.nonzero(change)[0]
+                    sizes = np.diff(np.concatenate([starts,
+                                                    [len(row_query)]]))
+                    out.metadata.set_group(sizes)
         out._finalize_feature_arrays()
         out.monotone_constraints = self.monotone_constraints
         return out

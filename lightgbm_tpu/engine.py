@@ -1,0 +1,295 @@
+"""Training engine: train() and cv().
+
+Behavioral analog of ref: python-package/lightgbm/engine.py (train :25,
+cv :399, CVBooster :285, _make_n_folds :323).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config
+from .utils import log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+_ROUND_ALIASES = ("num_iterations", "num_iteration", "n_iter", "num_tree",
+                  "num_trees", "num_round", "num_rounds", "nrounds",
+                  "num_boost_round", "n_estimators", "max_iter")
+_ES_ALIASES = ("early_stopping_round", "early_stopping_rounds",
+               "early_stopping", "n_iter_no_change")
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model: Optional[Union[str, Booster]] = None,
+          feature_name="auto", categorical_feature="auto",
+          keep_training_booster: bool = False,
+          callbacks: Optional[List] = None) -> Booster:
+    """Train a booster (ref: engine.py:25)."""
+    params = dict(params) if params else {}
+    # resolve num_boost_round / early stopping aliases (params win)
+    for alias in _ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    params["num_iterations"] = num_boost_round
+    first_metric_only = bool(params.get("first_metric_only", False))
+    early_stopping_round = None
+    for alias in _ES_ALIASES:
+        if alias in params:
+            early_stopping_round = int(params[alias])
+
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    # continued training: init model's raw predictions become init scores
+    predictor = None
+    if isinstance(init_model, str):
+        predictor = Booster(model_file=init_model)
+    elif isinstance(init_model, Booster):
+        predictor = Booster(model_str=init_model.model_to_string())
+    if predictor is not None and train_set.init_score is None:
+        raw = predictor.predict(train_set.data, raw_score=True)
+        train_set.set_init_score(np.asarray(raw).reshape(-1, order="F"))
+
+    # train_set appearing in valid_sets enables training metrics
+    # (ref: engine.py train_data_name handling)
+    if valid_sets is not None:
+        vs_list = valid_sets if isinstance(valid_sets, list) else [valid_sets]
+        if any(vs is train_set for vs in vs_list):
+            params.setdefault("is_provide_training_metric", True)
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets is not None:
+        if not isinstance(valid_sets, list):
+            valid_sets = [valid_sets]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                name = "training"
+            elif valid_names is not None and i < len(valid_names):
+                name = valid_names[i]
+            else:
+                name = f"valid_{i}"
+            if vs is not train_set:
+                if predictor is not None and vs.init_score is None:
+                    raw = predictor.predict(vs.data, raw_score=True)
+                    vs.set_init_score(np.asarray(raw).reshape(-1, order="F"))
+                booster.add_valid(vs, name)
+    train_in_valid = valid_sets is not None and any(
+        vs is train_set for vs in valid_sets)
+
+    callbacks = list(callbacks) if callbacks else []
+    if early_stopping_round is not None and early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            early_stopping_round, first_metric_only, verbose=True))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    # main loop (ref: engine.py:260-283)
+    evaluation_result_list: List = []
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        finished = booster.update()
+
+        evaluation_result_list = []
+        if valid_sets is not None or feval is not None:
+            if train_in_valid or (feval is not None
+                                  and booster._gbdt.training_metrics):
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            evaluation_result_list = es.best_score
+            break
+        if finished:
+            break
+
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for name, metric, value, _ in (evaluation_result_list or []):
+        booster.best_score[name][metric] = value
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (ref: engine.py:285)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    """(ref: engine.py:323)"""
+    full_data = full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_field("group")
+            if group_info is not None:
+                group_sizes = np.diff(group_info)
+                flattened = np.repeat(np.arange(len(group_sizes)),
+                                      group_sizes)
+            else:
+                flattened = None
+            folds = folds.split(X=np.empty(num_data), y=full_data.get_label(),
+                                groups=flattened)
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    if stratified:
+        label = np.asarray(full_data.get_label())
+        classes = np.unique(label)
+        test_folds = np.zeros(num_data, np.int32)
+        for c in classes:
+            idx = np.nonzero(label == c)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            test_folds[idx] = np.arange(len(idx)) % nfold
+        return [(np.nonzero(test_folds != f)[0], np.nonzero(test_folds == f)[0])
+                for f in range(nfold)]
+    group_info = full_data.get_field("group")
+    if group_info is not None:
+        # fold by whole queries (ref: engine.py group-aware kfold)
+        num_groups = len(group_info) - 1
+        gidx = np.arange(num_groups)
+        if shuffle:
+            rng.shuffle(gidx)
+        splits = np.array_split(gidx, nfold)
+        boundaries = np.asarray(group_info)
+        out = []
+        for f in range(nfold):
+            test_groups = set(splits[f].tolist())
+            test_mask = np.zeros(num_data, bool)
+            for g in test_groups:
+                test_mask[boundaries[g]:boundaries[g + 1]] = True
+            out.append((np.nonzero(~test_mask)[0], np.nonzero(test_mask)[0]))
+        return out
+    idx = np.arange(num_data)
+    if shuffle:
+        rng.shuffle(idx)
+    splits = np.array_split(idx, nfold)
+    return [(np.concatenate([splits[j] for j in range(nfold) if j != f]),
+             splits[f]) for f in range(nfold)]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True, metrics=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """Cross-validation (ref: engine.py:399)."""
+    params = dict(params) if params else {}
+    for alias in _ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    if metrics is not None:
+        params["metric"] = metrics
+    obj = str(params.get("objective", "regression"))
+    if stratified and not obj.startswith(("binary", "multiclass")):
+        stratified = False
+
+    train_set.construct()
+    fold_splits = _make_n_folds(train_set, folds, nfold, params, seed,
+                                stratified, shuffle)
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in fold_splits:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx, )
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, dict(params))
+        booster = Booster(params=dict(params), train_set=tr)
+        booster.add_valid(te, "valid")
+        if eval_train_metric:
+            booster._gbdt.training_metrics = booster._make_metrics(tr._inner)
+        cvbooster._append(booster)
+        fold_data.append((tr, te))
+
+    callbacks = list(callbacks) if callbacks else []
+    es_round = None
+    for alias in _ES_ALIASES:
+        if alias in params:
+            es_round = int(params[alias])
+    if es_round is not None and es_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            es_round, bool(params.get("first_metric_only", False)),
+            verbose=False))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+
+    results = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        agg: Dict[str, List[float]] = collections.defaultdict(list)
+        bigger: Dict[str, bool] = {}
+        for booster in cvbooster.boosters:
+            booster.update()
+            for name, metric, value, hb in (booster.eval_train(feval)
+                                            if eval_train_metric else []) \
+                    + booster.eval_valid(feval):
+                agg[f"{name} {metric}"].append(value)
+                bigger[f"{name} {metric}"] = hb
+        res_list = []
+        for key, vals in agg.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+            res_list.append(("cv_agg", key, mean, bigger[key]))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=res_list))
+        except callback_mod.EarlyStopException as es:
+            cvbooster.best_iteration = es.best_iteration + 1
+            for key in list(results):
+                results[key] = results[key][:cvbooster.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

@@ -1,0 +1,159 @@
+"""TreeSHAP feature contributions.
+
+Analog of ref: include/LightGBM/tree.h:437 PredictContrib (PathElement
+recursion from the TreeSHAP paper).  Exact polynomial-time algorithm over the
+host trees.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+def _tree_shap_row(tree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Exact TreeSHAP for one row of one tree (ref: tree.cpp TreeSHAP)."""
+    # unique path entries: (feature_index, zero_fraction, one_fraction, pweight)
+    def decision(node: int) -> bool:
+        f = int(tree.split_feature[node])
+        v = x[f]
+        d = int(tree.decision_type[node])
+        cat = bool(d & 1)
+        dl = bool(d & 2)
+        mt = (d >> 2) & 3
+        if np.isnan(v):
+            if mt == 2:
+                return dl
+            v = 0.0
+        if cat:
+            iv = int(v) if v >= 0 else -1
+            if iv < 0:
+                return False
+            cat_idx = int(tree.threshold[node])
+            lo = tree.cat_boundaries[cat_idx]
+            hi = tree.cat_boundaries[cat_idx + 1]
+            word, bit = divmod(iv, 32)
+            return (word < hi - lo
+                    and (tree.cat_threshold[lo + word] >> bit) & 1 == 1)
+        if mt == 1 and abs(v) <= 1e-35:
+            return dl
+        return v <= tree.threshold[node]
+
+    def node_count(node: int) -> float:
+        if node < 0:
+            return max(float(tree.leaf_count[~node]), 1.0)
+        return max(float(tree.internal_count[node]), 1.0)
+
+    def extend(path, zero_fraction, one_fraction, feature_index):
+        # deep-copy rows: sibling recursions must not see our pweight edits
+        path = [row[:] for row in path] \
+            + [[feature_index, zero_fraction, one_fraction,
+                1.0 if len(path) == 0 else 0.0]]
+        n = len(path) - 1
+        for i in range(n - 1, -1, -1):
+            path[i + 1][3] += one_fraction * path[i][3] * (i + 1) / (n + 1)
+            path[i][3] = zero_fraction * path[i][3] * (n - i) / (n + 1)
+        return path
+
+    def unwind(path, i):
+        n = len(path) - 1
+        one_fraction = path[i][2]
+        zero_fraction = path[i][1]
+        next_one_portion = path[n][3]
+        out = [row[:] for row in path]
+        for j in range(n - 1, -1, -1):
+            if one_fraction != 0:
+                tmp = out[j][3]
+                out[j][3] = next_one_portion * (n + 1) / ((j + 1)
+                                                          * one_fraction)
+                next_one_portion = tmp - out[j][3] * zero_fraction \
+                    * (n - j) / (n + 1)
+            else:
+                out[j][3] = out[j][3] * (n + 1) / (zero_fraction * (n - j))
+        for j in range(i, n):
+            out[j][0] = out[j + 1][0]
+            out[j][1] = out[j + 1][1]
+            out[j][2] = out[j + 1][2]
+        return out[:n]
+
+    def unwound_sum(path, i):
+        n = len(path) - 1
+        one_fraction = path[i][2]
+        zero_fraction = path[i][1]
+        next_one_portion = path[n][3]
+        total = 0.0
+        for j in range(n - 1, -1, -1):
+            if one_fraction != 0:
+                tmp = next_one_portion * (n + 1) / ((j + 1) * one_fraction)
+                total += tmp
+                next_one_portion = path[j][3] - tmp * zero_fraction \
+                    * (n - j) / (n + 1)
+            else:
+                total += path[j][3] / (zero_fraction * (n - j) / (n + 1))
+        return total
+
+    def recurse(node, path, zero_fraction, one_fraction, feature_index):
+        path = extend(path, zero_fraction, one_fraction, feature_index)
+        if node < 0:
+            leaf = ~node
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                phi[path[i][0]] += w * (path[i][2] - path[i][1]) \
+                    * tree.leaf_value[leaf]
+            return
+        f = int(tree.split_feature[node])
+        go_left = decision(node)
+        hot = int(tree.left_child[node]) if go_left \
+            else int(tree.right_child[node])
+        cold = int(tree.right_child[node]) if go_left \
+            else int(tree.left_child[node])
+        w = node_count(node)
+        hot_frac = node_count(hot) / w
+        cold_frac = node_count(cold) / w
+        incoming_zero = 1.0
+        incoming_one = 1.0
+        # undo previous split on the same feature
+        for i in range(1, len(path)):
+            if path[i][0] == f:
+                incoming_zero = path[i][1]
+                incoming_one = path[i][2]
+                path = unwind(path, i)
+                break
+        recurse(hot, path, hot_frac * incoming_zero, incoming_one, f)
+        recurse(cold, path, cold_frac * incoming_zero, 0.0, f)
+
+    if tree.num_leaves <= 1:
+        return
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def _expected_value(tree) -> float:
+    if tree.num_leaves <= 1:
+        return float(tree.leaf_value[0])
+    total = max(float(tree.internal_count[0]), 1.0)
+    ev = 0.0
+    for leaf in range(tree.num_leaves):
+        ev += float(tree.leaf_value[leaf]) \
+            * max(float(tree.leaf_count[leaf]), 1.0) / total
+    return ev
+
+
+def predict_contrib(booster, X: np.ndarray, lo: int, hi: int) -> np.ndarray:
+    """Per-feature SHAP contributions + expected value in the last column
+    (ref: c_api predict contrib; output shape [n, (F+1)*k])."""
+    n, _ = X.shape
+    F = booster.max_feature_idx + 1
+    k = booster.num_tree_per_iteration
+    out = np.zeros((n, (F + 1) * k))
+    for i, tree in enumerate(booster.models[lo:hi]):
+        tid = (lo + i) % k
+        base = tid * (F + 1)
+        ev = _expected_value(tree)
+        out[:, base + F] += ev
+        if tree.num_leaves <= 1:
+            continue
+        for r in range(n):
+            phi = np.zeros(F + 1)
+            _tree_shap_row(tree, X[r], phi)
+            out[r, base:base + F] += phi[:F]
+    return out

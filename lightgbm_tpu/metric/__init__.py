@@ -1,0 +1,594 @@
+"""Evaluation metrics.
+
+TPU-native analog of the reference metric layer (ref: src/metric/metric.cpp:17
+CreateMetric factory; regression/binary/multiclass/rank/xentropy hpp families).
+Scores arrive as host numpy (they're already synced back each eval round, like
+the reference); every metric is vectorized numpy, not a row loop.
+
+Each metric exposes: ``init(metadata, num_data)``, ``names`` (list),
+``is_bigger_better``, and ``eval(score, objective) -> list[float]`` where
+``score`` is ``[k, n]`` raw scores (k = num predictions per row).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..utils import dcg, log
+
+K_EPSILON = 1e-15
+
+# metric-name aliases (ref: config.cpp ParseMetrics + docs/Parameters.rst)
+METRIC_ALIASES = {
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2",
+    "regression": "l2", "regression_l2": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1",
+    "regression_l1": "l1",
+    "quantile": "quantile", "huber": "huber", "fair": "fair",
+    "poisson": "poisson",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc", "average_precision": "average_precision",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss",
+    "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kullback_leibler", "kldiv": "kullback_leibler",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg",
+    "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+}
+
+
+class Metric:
+    """Base metric (ref: include/LightGBM/metric.h:28)."""
+
+    names: List[str] = []
+    is_bigger_better = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+        self.query_boundaries = metadata.query_boundaries
+        if self.weight is not None:
+            self.sum_weights = float(np.sum(self.weight))
+        else:
+            self.sum_weights = float(num_data)
+
+    def eval(self, score: np.ndarray, objective) -> List[float]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Regression metrics (ref: src/metric/regression_metric.hpp)
+# ---------------------------------------------------------------------------
+class _RegressionMetric(Metric):
+    """Weighted pointwise loss averaged over rows
+    (ref: regression_metric.hpp:22-113)."""
+
+    convert = True  # run objective.convert_output on scores first
+
+    def loss(self, label, score):
+        raise NotImplementedError
+
+    def average(self, sum_loss, sum_weights):
+        return sum_loss / sum_weights
+
+    def eval(self, score, objective):
+        s = score[0]
+        if self.convert and objective is not None:
+            s = objective.convert_output(s)
+        pt = self.loss(self.label, s)
+        if self.weight is not None:
+            sum_loss = float(np.sum(pt * self.weight))
+        else:
+            sum_loss = float(np.sum(pt))
+        return [self.average(sum_loss, self.sum_weights)]
+
+
+class L2Metric(_RegressionMetric):
+    names = ["l2"]
+
+    def loss(self, label, score):
+        d = score - label
+        return d * d
+
+
+class RMSEMetric(L2Metric):
+    names = ["rmse"]
+
+    def average(self, sum_loss, sum_weights):
+        return float(np.sqrt(sum_loss / sum_weights))
+
+
+class L1Metric(_RegressionMetric):
+    names = ["l1"]
+
+    def loss(self, label, score):
+        return np.abs(score - label)
+
+
+class QuantileMetric(_RegressionMetric):
+    names = ["quantile"]
+
+    def loss(self, label, score):
+        delta = label - score
+        a = self.config.alpha
+        return np.where(delta < 0, (a - 1.0) * delta, a * delta)
+
+
+class HuberLossMetric(_RegressionMetric):
+    names = ["huber"]
+
+    def loss(self, label, score):
+        diff = score - label
+        a = self.config.alpha
+        return np.where(np.abs(diff) <= a, 0.5 * diff * diff,
+                        a * (np.abs(diff) - 0.5 * a))
+
+
+class FairLossMetric(_RegressionMetric):
+    names = ["fair"]
+
+    def loss(self, label, score):
+        x = np.abs(score - label)
+        c = self.config.fair_c
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_RegressionMetric):
+    names = ["poisson"]
+
+    def loss(self, label, score):
+        s = np.maximum(score, 1e-10)
+        return s - label * np.log(s)
+
+
+class MAPEMetric(_RegressionMetric):
+    names = ["mape"]
+
+    def loss(self, label, score):
+        return np.abs(label - score) / np.maximum(1.0, np.abs(label))
+
+
+class GammaMetric(_RegressionMetric):
+    names = ["gamma"]
+
+    def loss(self, label, score):
+        # ref: regression_metric.hpp:261-272 (negative gamma log-likelihood)
+        psi = 1.0
+        theta = -1.0 / np.maximum(score, 1e-300)
+        b = -np.log(np.maximum(-theta, 1e-300))
+        c = (1.0 / psi * np.log(np.maximum(label / psi, 1e-300))
+             - np.log(np.maximum(label, 1e-300)))
+        return -((label * theta - b) / psi + c)
+
+
+class GammaDevianceMetric(_RegressionMetric):
+    names = ["gamma_deviance"]
+
+    def loss(self, label, score):
+        tmp = label / (score + 1e-9)
+        return tmp - np.log(np.maximum(tmp, 1e-300)) - 1.0
+
+    def average(self, sum_loss, sum_weights):
+        return sum_loss * 2.0
+
+
+class TweedieMetric(_RegressionMetric):
+    names = ["tweedie"]
+
+    def loss(self, label, score):
+        rho = self.config.tweedie_variance_power
+        s = np.maximum(score, 1e-10)
+        a = label * np.exp((1.0 - rho) * np.log(s)) / (1.0 - rho)
+        b = np.exp((2.0 - rho) * np.log(s)) / (2.0 - rho)
+        return -a + b
+
+
+# ---------------------------------------------------------------------------
+# Binary metrics (ref: src/metric/binary_metric.hpp)
+# ---------------------------------------------------------------------------
+class _BinaryMetric(Metric):
+    def loss(self, label, prob):
+        raise NotImplementedError
+
+    def eval(self, score, objective):
+        s = score[0]
+        if objective is not None:
+            s = objective.convert_output(s)
+        pt = self.loss(self.label, s)
+        if self.weight is not None:
+            sum_loss = float(np.sum(pt * self.weight))
+        else:
+            sum_loss = float(np.sum(pt))
+        return [sum_loss / self.sum_weights]
+
+
+class BinaryLoglossMetric(_BinaryMetric):
+    names = ["binary_logloss"]
+
+    def loss(self, label, prob):
+        # ref: binary_metric.hpp:119-130
+        p = np.clip(np.where(label > 0, prob, 1.0 - prob), K_EPSILON, None)
+        return -np.log(p)
+
+
+class BinaryErrorMetric(_BinaryMetric):
+    names = ["binary_error"]
+
+    def loss(self, label, prob):
+        # ref: binary_metric.hpp:143-149
+        return np.where(prob <= 0.5, (label > 0), (label <= 0)) \
+            .astype(np.float64)
+
+
+def _weighted_auc(label: np.ndarray, score: np.ndarray,
+                  weight: Optional[np.ndarray]) -> float:
+    """AUC with tie handling (ref: binary_metric.hpp:159-268 AUCMetric::Eval
+    — trapezoid accumulation over score-sorted groups)."""
+    pos = (label > 0).astype(np.float64)
+    w = weight.astype(np.float64) if weight is not None else \
+        np.ones_like(pos)
+    order = np.argsort(-score, kind="stable")
+    sp = pos[order]
+    sw = w[order]
+    ss = score[order]
+    # group boundaries at distinct scores
+    new_group = np.concatenate([[True], ss[1:] != ss[:-1]])
+    gid = np.cumsum(new_group) - 1
+    n_groups = gid[-1] + 1 if len(gid) else 0
+    g_pos = np.zeros(n_groups)
+    g_all = np.zeros(n_groups)
+    np.add.at(g_pos, gid, sp * sw)
+    np.add.at(g_all, gid, sw)
+    g_neg = g_all - g_pos
+    cum_pos_before = np.concatenate([[0.0], np.cumsum(g_pos)[:-1]])
+    # ties contribute half
+    s_area = np.sum(g_neg * (cum_pos_before + 0.5 * g_pos))
+    total_pos = float(np.sum(sp * sw))
+    total_neg = float(np.sum(sw)) - total_pos
+    if total_pos <= 0 or total_neg <= 0:
+        log.warning("AUC is undefined with only one class present")
+        return 1.0
+    return float(s_area / (total_pos * total_neg))
+
+
+class AUCMetric(Metric):
+    names = ["auc"]
+    is_bigger_better = True
+
+    def eval(self, score, objective):
+        return [_weighted_auc(self.label, score[0], self.weight)]
+
+
+class AveragePrecisionMetric(Metric):
+    """ref: binary_metric.hpp:270-380 (weighted average precision)."""
+
+    names = ["average_precision"]
+    is_bigger_better = True
+
+    def eval(self, score, objective):
+        w = (self.weight.astype(np.float64) if self.weight is not None
+             else np.ones(self.num_data))
+        pos = (self.label > 0).astype(np.float64)
+        order = np.argsort(-score[0], kind="stable")
+        sp = pos[order] * w[order]
+        sw = w[order]
+        ss = score[0][order]
+        new_group = np.concatenate([[True], ss[1:] != ss[:-1]])
+        gid = np.cumsum(new_group) - 1
+        n_groups = gid[-1] + 1
+        g_pos = np.zeros(n_groups)
+        g_all = np.zeros(n_groups)
+        np.add.at(g_pos, gid, sp)
+        np.add.at(g_all, gid, sw)
+        cum_pos = np.cumsum(g_pos)
+        cum_all = np.cumsum(g_all)
+        total_pos = cum_pos[-1]
+        if total_pos <= 0:
+            log.warning("Average precision is undefined with no positives")
+            return [1.0]
+        precision = cum_pos / cum_all
+        recall_delta = g_pos / total_pos
+        return [float(np.sum(precision * recall_delta))]
+
+
+# ---------------------------------------------------------------------------
+# Multiclass metrics (ref: src/metric/multiclass_metric.hpp)
+# ---------------------------------------------------------------------------
+class MultiSoftmaxLoglossMetric(Metric):
+    names = ["multi_logloss"]
+
+    def eval(self, score, objective):
+        # score: [num_class, n]; convert via objective softmax if present
+        k, n = score.shape
+        if objective is not None:
+            probs = objective.convert_output(score.T)  # [n, k]
+        else:
+            m = score - np.max(score, axis=0, keepdims=True)
+            e = np.exp(m)
+            probs = (e / np.sum(e, axis=0, keepdims=True)).T
+        li = self.label.astype(np.int64)
+        p = np.clip(probs[np.arange(n), li], K_EPSILON, None)
+        pt = -np.log(p)
+        if self.weight is not None:
+            return [float(np.sum(pt * self.weight) / self.sum_weights)]
+        return [float(np.sum(pt) / self.sum_weights)]
+
+
+class MultiErrorMetric(Metric):
+    names = ["multi_error"]
+
+    def eval(self, score, objective):
+        k, n = score.shape
+        li = self.label.astype(np.int64)
+        top_k = int(self.config.multi_error_top_k)
+        # correct if true-class score is within the top k (ties count,
+        # ref: multiclass_metric.hpp:143-153)
+        true_score = score[li, np.arange(n)]
+        # ties count against (ref: multiclass_metric.hpp:142-151 uses >=,
+        # self included, error iff num_larger > top_k)
+        num_larger = np.sum(score >= true_score[None, :], axis=0)
+        err = (num_larger > top_k).astype(np.float64)
+        if self.weight is not None:
+            return [float(np.sum(err * self.weight) / self.sum_weights)]
+        return [float(np.sum(err) / self.sum_weights)]
+
+
+class AucMuMetric(Metric):
+    """AUC-mu for multiclass (ref: multiclass_metric.hpp:183-337).
+
+    Pairwise class separability averaged over all class pairs, using the
+    auc_mu_weights decision matrix when provided."""
+
+    names = ["auc_mu"]
+    is_bigger_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.num_class = int(self.config.num_class)
+        aw = self.config.auc_mu_weights
+        nc = self.num_class
+        if aw:
+            W = np.asarray(aw, dtype=np.float64).reshape(nc, nc)
+        else:
+            W = np.ones((nc, nc)) - np.eye(nc)
+        self.W = W
+
+    def eval(self, score, objective):
+        nc, n = score.shape
+        li = self.label.astype(np.int64)
+        w = (self.weight.astype(np.float64) if self.weight is not None
+             else np.ones(n))
+        total = 0.0
+        cnt = 0
+        for i in range(nc):
+            for j in range(i + 1, nc):
+                mask = (li == i) | (li == j)
+                if not mask.any() or not ((li == i).any()
+                                          and (li == j).any()):
+                    cnt += 1
+                    continue
+                # partition by decision value v·(a_row) using weight-matrix
+                # difference row (ref: :252-276)
+                v = self.W[i, j] * score[j, mask] - self.W[j, i] * score[i, mask]
+                lab = (li[mask] == i).astype(np.float64)  # class i = "pos"
+                # class i should score lower v; AUC of (-v) vs pos
+                total += _weighted_auc(lab, -v, w[mask])
+                cnt += 1
+        return [total / max(cnt, 1)]
+
+
+# ---------------------------------------------------------------------------
+# Rank metrics (ref: src/metric/rank_metric.hpp, map_metric.hpp)
+# ---------------------------------------------------------------------------
+class NDCGMetric(Metric):
+    is_bigger_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = [int(k) for k in (config.eval_at or [1, 2, 3, 4, 5])]
+        self.names = [f"ndcg@{k}" for k in self.eval_at]
+        self.label_gain = dcg.default_label_gain(config.label_gain)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("The NDCG metric requires query information")
+        dcg.check_label(self.label, len(self.label_gain))
+        qb = self.query_boundaries
+        self.num_queries = len(qb) - 1
+        # per-query ideal DCGs
+        self.inv_max_dcgs = np.zeros((self.num_queries, len(self.eval_at)))
+        for q in range(self.num_queries):
+            lab = self.label[qb[q]:qb[q + 1]]
+            for ki, k in enumerate(self.eval_at):
+                m = dcg.max_dcg_at_k(k, lab, self.label_gain)
+                self.inv_max_dcgs[q, ki] = 1.0 / m if m > 0 else -1.0
+
+    def eval(self, score, objective):
+        qb = self.query_boundaries
+        result = np.zeros(len(self.eval_at))
+        for q in range(self.num_queries):
+            lab = self.label[qb[q]:qb[q + 1]]
+            sc = score[0][qb[q]:qb[q + 1]]
+            for ki, k in enumerate(self.eval_at):
+                if self.inv_max_dcgs[q, ki] <= 0:
+                    # all-zero-label query counts as perfect (ref: :88-92)
+                    result[ki] += 1.0
+                else:
+                    d = dcg.dcg_at_k([k], lab, sc, self.label_gain)[0]
+                    result[ki] += d * self.inv_max_dcgs[q, ki]
+        return list(result / self.num_queries)
+
+
+class MapMetric(Metric):
+    """MAP@k (ref: src/metric/map_metric.hpp)."""
+
+    is_bigger_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = [int(k) for k in (config.eval_at or [1, 2, 3, 4, 5])]
+        self.names = [f"map@{k}" for k in self.eval_at]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("The MAP metric requires query information")
+        self.num_queries = len(self.query_boundaries) - 1
+
+    def eval(self, score, objective):
+        qb = self.query_boundaries
+        result = np.zeros(len(self.eval_at))
+        for q in range(self.num_queries):
+            lab = (self.label[qb[q]:qb[q + 1]] > 0).astype(np.float64)
+            sc = score[0][qb[q]:qb[q + 1]]
+            order = np.argsort(-sc, kind="stable")
+            rel = lab[order]
+            cum_rel = np.cumsum(rel)
+            pos = np.arange(1, len(rel) + 1)
+            prec = cum_rel / pos
+            for ki, k in enumerate(self.eval_at):
+                kk = min(k, len(rel))
+                n_rel = cum_rel[kk - 1] if kk > 0 else 0
+                if n_rel > 0:
+                    result[ki] += float(np.sum((prec * rel)[:kk]) / n_rel)
+                else:
+                    result[ki] += 0.0
+        return list(result / self.num_queries)
+
+
+# ---------------------------------------------------------------------------
+# Cross-entropy metrics (ref: src/metric/xentropy_metric.hpp)
+# ---------------------------------------------------------------------------
+def _xent(label, prob):
+    # handles soft labels in [0, 1] (ref: xentropy_metric.hpp:33 XentLoss)
+    p = np.clip(prob, K_EPSILON, 1.0 - K_EPSILON)
+    return -(label * np.log(p) + (1.0 - label) * np.log(1.0 - p))
+
+
+class CrossEntropyMetric(Metric):
+    names = ["cross_entropy"]
+
+    def eval(self, score, objective):
+        s = score[0]
+        sig = 1.0 / (1.0 + np.exp(-s))
+        pt = _xent(self.label, sig)
+        if self.weight is not None:
+            return [float(np.sum(pt * self.weight) / self.sum_weights)]
+        return [float(np.sum(pt) / self.sum_weights)]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    names = ["cross_entropy_lambda"]
+
+    def eval(self, score, objective):
+        # ref: xentropy_metric.hpp:196-226 — loss in the lambda parameterization
+        s = score[0]
+        w = self.weight if self.weight is not None else 1.0
+        hhat = np.log1p(np.exp(s))
+        z = 1.0 - np.exp(-w * hhat)
+        z = np.clip(z, K_EPSILON, 1.0 - K_EPSILON)
+        pt = _xent(self.label, z)
+        return [float(np.sum(pt) / self.num_data)]
+
+
+class KullbackLeiblerDivergence(Metric):
+    """KL(label || sigmoid(score)) = xentropy minus label entropy
+    (ref: xentropy_metric.hpp:249-320)."""
+
+    names = ["kullback_leibler"]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.clip(self.label, K_EPSILON, 1.0 - K_EPSILON)
+        ent = -(self.label * np.log(lab)
+                + (1.0 - self.label) * np.log(1.0 - lab))
+        # entropy is zero for hard 0/1 labels
+        ent = np.where((self.label <= 0.0) | (self.label >= 1.0), 0.0, ent)
+        if self.weight is not None:
+            self.presum_label_entropy = float(np.sum(ent * self.weight)
+                                              / self.sum_weights)
+        else:
+            self.presum_label_entropy = float(np.mean(ent))
+
+    def eval(self, score, objective):
+        s = score[0]
+        sig = 1.0 / (1.0 + np.exp(-s))
+        pt = _xent(self.label, sig)
+        if self.weight is not None:
+            xent = float(np.sum(pt * self.weight) / self.sum_weights)
+        else:
+            xent = float(np.mean(pt))
+        return [xent - self.presum_label_entropy]
+
+
+# ---------------------------------------------------------------------------
+_REGISTRY = {
+    "l2": L2Metric, "rmse": RMSEMetric, "l1": L1Metric,
+    "quantile": QuantileMetric, "huber": HuberLossMetric,
+    "fair": FairLossMetric, "poisson": PoissonMetric, "mape": MAPEMetric,
+    "gamma": GammaMetric, "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
+    "auc_mu": AucMuMetric,
+    "multi_logloss": MultiSoftmaxLoglossMetric, "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric, "map": MapMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KullbackLeiblerDivergence,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    """Factory (ref: src/metric/metric.cpp:17 Metric::CreateMetric)."""
+    raw = name.strip().lower()
+    if raw in ("", "none", "null", "na", "custom"):
+        return None
+    # "ndcg@5" / "map@3" forms set eval_at inline
+    if "@" in raw:
+        base, ks = raw.split("@", 1)
+        base = METRIC_ALIASES.get(base, base)
+        if base in ("ndcg", "map"):
+            cfg = Config(dict(config.to_dict()))
+            cfg._values["eval_at"] = [int(k) for k in ks.split(",")]
+            return _REGISTRY[base](cfg)
+    resolved = METRIC_ALIASES.get(raw, raw)
+    cls = _REGISTRY.get(resolved)
+    if cls is None:
+        log.fatal("Unknown metric type name: %s", name)
+    return cls(config)
+
+
+def default_metric_for_objective(objective_name: str) -> str:
+    """Objective's eponymous metric (ref: config.cpp objective->metric map)."""
+    mapping = {
+        "regression": "l2", "regression_l1": "l1", "huber": "huber",
+        "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+        "mape": "mape", "gamma": "gamma", "tweedie": "tweedie",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
+        "cross_entropy": "cross_entropy",
+        "cross_entropy_lambda": "cross_entropy_lambda",
+        "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    }
+    return mapping.get(objective_name, "")

@@ -83,13 +83,20 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl"))
+                     "hist_impl", "psum_axis"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
-                       hist_impl: str = "auto",
+                       hist_impl: str = "auto", psum_axis: str = None,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
+
+    With ``psum_axis`` set (running under shard_map over a row-sharded mesh),
+    every histogram is allreduced over that mesh axis so all shards see
+    GLOBAL counts and make identical split decisions — the TPU formulation
+    of the reference's data-parallel learner (ref:
+    src/treelearner/data_parallel_tree_learner.cpp:155-189 reduce-scatter +
+    SyncUpGlobalBestSplit, collapsed into one psum over ICI).
 
     Returns (tree arrays, final row→leaf assignment).
     """
@@ -97,13 +104,16 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     L = num_leaves
     B = max_bins
 
+    def _psum(h):
+        return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
+
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
 
     # root histogram: every row targets slot 0
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
-    root_hist = build_histograms(bins, gh, row_leaf, num_slots=1,
-                                 num_bins=B, impl=hist_impl)
+    root_hist = _psum(build_histograms(bins, gh, row_leaf, num_slots=1,
+                                       num_bins=B, impl=hist_impl))
     pool = pool.at[0].set(root_hist[0])
 
     root_g = jnp.sum(root_hist[0, 0, :, 0])
@@ -186,8 +196,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             target_is_left = best.left_count[l] <= best.right_count[l]
             target_leaf = jnp.where(target_is_left, l, new)
             slot = jnp.where(row_leaf2 == target_leaf, 0, -1)
-            hist_t = build_histograms(bins, gh, slot, num_slots=1,
-                                      num_bins=B, impl=hist_impl)[0]
+            hist_t = _psum(build_histograms(bins, gh, slot, num_slots=1,
+                                            num_bins=B, impl=hist_impl))[0]
             hist_sib = pool[l] - hist_t
             pool2 = pool.at[l].set(jnp.where(target_is_left, hist_t, hist_sib))
             pool2 = pool2.at[new].set(jnp.where(target_is_left, hist_sib,
@@ -215,17 +225,20 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl"))
+                     "hist_impl", "psum_axis"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
-                        hist_impl: str = "segment",
+                        hist_impl: str = "segment", psum_axis: str = None,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
     Each level: one masked histogram pass builds all left-child histograms at
     once (slots via ``leaf_to_slot``), siblings come from subtraction, and all
     frontier leaves whose gain survives the num_leaves budget split together.
+
+    ``psum_axis``: see grow_tree_leafwise — data-parallel allreduce of the
+    per-level histogram batch over the mesh axis.
     """
     R, F = bins.shape
     L = num_leaves
@@ -234,11 +247,14 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     # a level can at most double the leaves; cap levels at L-1 splits total
     n_levels = min(n_levels, L - 1)
 
+    def _psum(h):
+        return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
+
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
-    root_hist = build_histograms(bins, gh, row_leaf, num_slots=1,
-                                 num_bins=B, impl=hist_impl)
+    root_hist = _psum(build_histograms(bins, gh, row_leaf, num_slots=1,
+                                       num_bins=B, impl=hist_impl))
     pool = pool.at[0].set(root_hist[0])
     root_g = jnp.sum(root_hist[0, 0, :, 0])
     root_h = jnp.sum(root_hist[0, 0, :, 1])
@@ -339,8 +355,9 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             leaf_to_slot = jnp.where(selected, k_of_leaf, -1)
             row_slot = jnp.where(sel_row & (row_leaf2 == row_leaf),
                                  leaf_to_slot[l_row], -1)
-            hist_left = build_histograms(bins, gh, row_slot, num_slots=L,
-                                         num_bins=B, impl=hist_impl)
+            hist_left = _psum(build_histograms(bins, gh, row_slot,
+                                               num_slots=L, num_bins=B,
+                                               impl=hist_impl))
 
             # scatter: pool[l] = left hist, pool[new] = parent - left
             gathered_left = hist_left[jnp.where(selected, k_of_leaf, 0)]

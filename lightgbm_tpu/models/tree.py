@@ -84,6 +84,7 @@ class HostTree:
         self.leaf_count: np.ndarray = np.zeros(1, np.int64)
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
+        self.leaf_depth: np.ndarray = np.zeros(1, np.int32)
         self.is_linear = False
 
     # decision_type bitfield (ref: tree.h:166-186): bit0 categorical,

@@ -1,0 +1,65 @@
+"""On-device tree routing over binned features.
+
+Used for validation-score updates during training (the reference walks
+pointer trees per row on the host, gbdt.cpp UpdateScore /
+score_updater.hpp:88; here the whole valid set advances one tree level per
+fused pass — no host round trips).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _route_left(b, t, default_left, nb, mt, db):
+    """Split decision on bin values with missing routing
+    (ref: src/io/dense_bin.hpp Split)."""
+    missing = (((mt == 1) & (b == db)) | ((mt == 2) & (b == nb - 1)))
+    return jnp.where(missing, default_left, b <= t)
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
+                         threshold_bin: jax.Array, default_left: jax.Array,
+                         left_child: jax.Array, right_child: jax.Array,
+                         num_bin: jax.Array, missing_type: jax.Array,
+                         default_bin: jax.Array, max_steps: int) -> jax.Array:
+    """Leaf index per row for one tree (arrays follow the TreeArrays
+    convention: child >= 0 internal node, child < 0 means ~leaf).
+
+    ``max_steps`` must be >= tree depth.  Single-leaf trees (no node 0)
+    are handled by the caller (leaf 0 for every row).
+    """
+    R = bins.shape[0]
+    node = jnp.zeros((R,), jnp.int32)
+
+    def body(_, node):
+        is_internal = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]
+        b = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
+                                axis=1)[:, 0].astype(jnp.int32)
+        go_left = _route_left(b, threshold_bin[nd], default_left[nd],
+                              num_bin[f], missing_type[f], default_bin[f])
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        return jnp.where(is_internal, nxt, node)
+
+    node = jax.lax.fori_loop(0, max_steps, body, node)
+    return jnp.where(node < 0, ~node, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def add_tree_score(score: jax.Array, bins: jax.Array, leaf_value: jax.Array,
+                   split_feature: jax.Array, threshold_bin: jax.Array,
+                   default_left: jax.Array, left_child: jax.Array,
+                   right_child: jax.Array, num_bin: jax.Array,
+                   missing_type: jax.Array, default_bin: jax.Array,
+                   max_steps: int) -> jax.Array:
+    """score += leaf_value[route(row)] in one fused pass."""
+    leaves = route_rows_to_leaves(bins, split_feature, threshold_bin,
+                                  default_left, left_child, right_child,
+                                  num_bin, missing_type, default_bin,
+                                  max_steps)
+    return score + leaf_value[leaves]

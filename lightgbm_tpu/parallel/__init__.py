@@ -1,0 +1,25 @@
+"""Distributed tree learning over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's network layer + parallel tree
+learners (ref: src/network/*, src/treelearner/{data,feature,voting}_parallel
+_tree_learner.cpp).  The socket/MPI collectives collapse into XLA collectives
+over ICI/DCN (SURVEY.md §2.3):
+
+- data-parallel:    rows sharded; histogram allreduce (`psum`) replaces the
+                    reduce-scatter + SyncUpGlobalBestSplit exchange
+                    (data_parallel_tree_learner.cpp:155-189,260).
+- voting-parallel:  data-parallel + per-shard top-k feature voting caps the
+                    allreduced payload (voting_parallel_tree_learner.cpp:151).
+- feature-parallel: rows replicated, feature slices per shard; only 48-byte
+                    best-split records are exchanged
+                    (feature_parallel_tree_learner.cpp:60-77).
+"""
+from .mesh import make_mesh, replicate, shard_rows
+from .data_parallel import (grow_tree_data_parallel, make_sharded_grow_fn,
+                            train_step_data_parallel)
+
+__all__ = [
+    "make_mesh", "replicate", "shard_rows",
+    "grow_tree_data_parallel", "make_sharded_grow_fn",
+    "train_step_data_parallel",
+]

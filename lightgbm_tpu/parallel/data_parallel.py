@@ -1,0 +1,98 @@
+"""Data-parallel tree growth: rows sharded, histograms allreduced.
+
+TPU-native analog of ref: src/treelearner/data_parallel_tree_learner.cpp.
+The reference reduce-scatters byte-laid-out histograms so each rank owns the
+globally-summed histograms of a feature subset, finds its best split, then
+allreduce-maxes 48-byte SplitInfo records (:155-260).  On an ICI mesh the
+whole exchange is one `psum` of the histogram tensor inside the jit-compiled
+grow loop — each shard then computes the identical global argmax locally, so
+no second sync is needed (split decisions are replicated by construction).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..models.learner import (FeatureMeta, grow_tree_depthwise,
+                              grow_tree_leafwise)
+from ..models.tree import TreeArrays
+from ..ops.split import SplitParams
+from .mesh import DATA_AXIS
+
+
+def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
+                         max_bins: int, max_depth: int = -1,
+                         policy: str = "leafwise", hist_impl: str = "auto",
+                         axis_name: str = DATA_AXIS):
+    """shard_map-wrapped tree growth: bins/gh row-sharded in, replicated tree
+    + row-sharded leaf assignment out."""
+    grow = grow_tree_leafwise if policy == "leafwise" else grow_tree_depthwise
+
+    def per_shard(bins, gh, meta, feature_mask):
+        return grow(bins, gh, meta, feature_mask, params, num_leaves,
+                    max_bins, max_depth, hist_impl=hist_impl,
+                    psum_axis=axis_name)
+
+    sharded = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
+        out_specs=(P(), P(axis_name)),
+        check_rep=False)
+    return jax.jit(sharded)
+
+
+def grow_tree_data_parallel(mesh: Mesh, bins, gh, meta: FeatureMeta,
+                            feature_mask, params: SplitParams,
+                            num_leaves: int, max_bins: int,
+                            max_depth: int = -1, policy: str = "leafwise",
+                            hist_impl: str = "auto",
+                            ) -> Tuple[TreeArrays, jax.Array]:
+    """One-shot helper (the GBDT driver caches make_sharded_grow_fn)."""
+    fn = make_sharded_grow_fn(mesh, params, num_leaves, max_bins, max_depth,
+                              policy, hist_impl)
+    return fn(bins, gh, meta, feature_mask)
+
+
+def train_step_data_parallel(mesh: Mesh, params: SplitParams,
+                             num_leaves: int, max_bins: int,
+                             axis_name: str = DATA_AXIS,
+                             policy: str = "depthwise"):
+    """A FULL jit-compiled data-parallel boosting step: binary-logloss
+    gradients -> sharded tree growth (histogram psum over the mesh) -> score
+    update.  This is the flagship multi-chip path the driver dry-runs
+    (ref call stack being replaced: gbdt.cpp:371 TrainOneIter +
+    data_parallel_tree_learner.cpp FindBestSplits).
+
+    Returns a jitted fn: (bins[R,F] sharded, label[R] sharded,
+    valid[R] sharded, score[R] sharded, meta, feature_mask) ->
+    (new_score, tree arrays).  ``valid`` is 1.0 for real rows, 0.0 for
+    shard_rows padding — padded rows must carry zero histogram weight.
+    """
+    grow = grow_tree_leafwise if policy == "leafwise" else grow_tree_depthwise
+
+    def per_shard(bins, label, valid, score, meta, feature_mask):
+        # gradients: binary logloss (ref: binary_objective.hpp:107)
+        lv = jnp.where(label > 0, 1.0, -1.0)
+        response = -lv / (1.0 + jnp.exp(lv * score))
+        grad = response * valid
+        hess = jnp.abs(response) * (1.0 - jnp.abs(response)) * valid
+        gh = jnp.stack([grad, hess, valid], axis=1)
+        tree, row_leaf = grow(bins, gh, meta, feature_mask, params,
+                              num_leaves, max_bins, -1,
+                              hist_impl="segment", psum_axis=axis_name)
+        new_score = score + 0.1 * tree.leaf_value[row_leaf]
+        return new_score, tree
+
+    sharded = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
+                  P(axis_name), P(), P()),
+        out_specs=(P(axis_name), P()),
+        check_rep=False)
+    return jax.jit(sharded)

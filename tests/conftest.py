@@ -2,6 +2,20 @@ import os
 
 # Multi-device testing on a virtual CPU mesh (SURVEY.md §4 implication):
 # replaces the reference's localhost-subprocess distributed mockup
-# (tests/distributed/_test_distributed.py).
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# (tests/distributed/_test_distributed.py).  XLA_FLAGS must be set before
+# jax initializes its backends; jax.config.update beats the JAX_PLATFORMS
+# env var, which the runtime environment may pin to a TPU platform.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the learner jit varies with static shapes
+# (rows, features, num_leaves, max_bins), so repeat suite runs hit the disk
+# cache instead of re-tracing (~10-30 s per unique shape on CPU).
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

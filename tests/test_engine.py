@@ -1,0 +1,267 @@
+"""End-to-end behavioral tests (the analog of the reference's
+tests/python_package_test/test_engine.py tier)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.RandomState(42)
+    X = rng.randn(3000, 20)
+    logit = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] + rng.randn(3000) * 0.3
+    y = (logit > 0.5).astype(np.float64)
+    return X[:2400], y[:2400], X[2400:], y[2400:]
+
+
+def test_binary_auc(binary_data):
+    from sklearn.metrics import roc_auc_score
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), 50)
+    auc = roc_auc_score(yte, bst.predict(Xte))
+    assert auc > 0.98
+
+
+def test_regression_vs_sklearn():
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 10)
+    y = X[:, 0] * 3 + np.sin(X[:, 1] * 2) + rng.randn(3000) * 0.1
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    lgb.Dataset(X[:2400], label=y[:2400]), 100)
+    mse = np.mean((bst.predict(X[2400:]) - y[2400:]) ** 2)
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    sk = HistGradientBoostingRegressor(max_iter=100).fit(X[:2400], y[:2400])
+    sk_mse = np.mean((sk.predict(X[2400:]) - y[2400:]) ** 2)
+    assert mse < sk_mse * 1.5
+
+
+def test_missing_values_routed(binary_data):
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.RandomState(1)
+    Xtr, ytr, Xte, yte = binary_data
+    Xtr = Xtr.copy()
+    Xte = Xte.copy()
+    Xtr[rng.rand(*Xtr.shape) < 0.2] = np.nan
+    Xte[rng.rand(*Xte.shape) < 0.2] = np.nan
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), 50)
+    assert roc_auc_score(yte, bst.predict(Xte)) > 0.9
+
+
+def test_multiclass_softmax_and_ova():
+    rng = np.random.RandomState(2)
+    X = rng.randn(2000, 10)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    for obj in ("multiclass", "multiclassova"):
+        bst = lgb.train({"objective": obj, "num_class": 3, "verbose": -1},
+                        lgb.Dataset(X, label=y), 30)
+        pred = bst.predict(X)
+        assert pred.shape == (2000, 3)
+        assert (pred.argmax(1) == y).mean() > 0.9
+
+
+def test_early_stopping_fires():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 5)
+    y = X[:, 0] + rng.randn(2000)
+    dtrain = lgb.Dataset(X[:1500], label=y[:1500])
+    dvalid = lgb.Dataset(X[1500:], label=y[1500:], reference=dtrain)
+    bst = lgb.train({"objective": "regression", "verbose": -1}, dtrain, 500,
+                    valid_sets=[dvalid],
+                    callbacks=[lgb.early_stopping(10, verbose=False)])
+    assert 0 < bst.best_iteration < 500
+
+
+def test_model_io_bit_identical(binary_data, tmp_path):
+    Xtr, ytr, Xte, _ = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), 20)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_array_equal(bst.predict(Xte), bst2.predict(Xte))
+    # raw score path too
+    np.testing.assert_array_equal(bst.predict(Xte, raw_score=True),
+                                  bst2.predict(Xte, raw_score=True))
+
+
+def test_continued_training(binary_data):
+    # reference semantics: the continued booster holds only the NEW trees;
+    # the init model enters through init_score (ref: engine.py:174-185
+    # _set_predictor -> _set_init_score_by_predictor)
+    from sklearn.metrics import log_loss
+    Xtr, ytr, Xte, yte = binary_data
+    b1 = lgb.train({"objective": "binary", "verbose": -1},
+                   lgb.Dataset(Xtr, label=ytr), 10)
+    l1 = log_loss(yte, b1.predict(Xte))
+    b2 = lgb.train({"objective": "binary", "verbose": -1},
+                   lgb.Dataset(Xtr, label=ytr), 10, init_model=b1)
+    combined_raw = b1.predict(Xte, raw_score=True) \
+        + b2.predict(Xte, raw_score=True)
+    l2 = log_loss(yte, 1.0 / (1.0 + np.exp(-combined_raw)))
+    assert l2 < l1
+
+
+def test_custom_objective(binary_data):
+    from sklearn.metrics import roc_auc_score
+    Xtr, ytr, Xte, yte = binary_data
+
+    def logloss_obj(preds, dataset):
+        y = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - y, p * (1 - p)
+
+    # custom objective via update loop
+    ds2 = lgb.Dataset(Xtr, label=ytr)
+    bst2 = lgb.Booster(params={"objective": "none", "verbose": -1},
+                       train_set=ds2)
+    for _ in range(30):
+        bst2.update(fobj=logloss_obj)
+    auc = roc_auc_score(yte, bst2.predict(Xte, raw_score=True))
+    assert auc > 0.97
+
+
+def test_custom_feval(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    dvalid = lgb.Dataset(Xte, label=yte, reference=dtrain)
+    seen = {}
+
+    def my_metric(preds, dataset):
+        return ("my_err", float(np.mean((preds > 0) != dataset.get_label())),
+                False)
+
+    record = {}
+    lgb.train({"objective": "binary", "verbose": -1, "metric": "None"},
+              dtrain, 5, valid_sets=[dvalid], feval=my_metric,
+              callbacks=[lgb.record_evaluation(record)])
+    assert "my_err" in record["valid_0"]
+    assert len(record["valid_0"]["my_err"]) == 5
+
+
+def test_weights_change_model():
+    rng = np.random.RandomState(4)
+    X = rng.randn(1000, 5)
+    y = X[:, 0] + rng.randn(1000) * 0.1
+    w = np.abs(rng.randn(1000)) + 0.01
+    b1 = lgb.train({"objective": "regression", "verbose": -1},
+                   lgb.Dataset(X, label=y), 10)
+    b2 = lgb.train({"objective": "regression", "verbose": -1},
+                   lgb.Dataset(X, label=y, weight=w), 10)
+    assert np.abs(b1.predict(X) - b2.predict(X)).max() > 1e-6
+
+
+def test_bagging_and_feature_fraction():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 20)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "bagging_freq": 1,
+                     "bagging_fraction": 0.5, "feature_fraction": 0.5,
+                     "verbose": -1}, lgb.Dataset(X, label=y), 30)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_goss_dart_rf_run():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.RandomState(6)
+    X = rng.randn(2000, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    for boosting, extra in [("goss", {}), ("dart", {}),
+                            ("rf", {"bagging_freq": 1,
+                                    "bagging_fraction": 0.7})]:
+        params = {"objective": "binary", "boosting": boosting,
+                  "verbose": -1, **extra}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 30)
+        auc = roc_auc_score(y, bst.predict(X))
+        assert auc > 0.9, f"{boosting} AUC {auc}"
+
+
+def test_lambdarank_improves_ndcg():
+    rng = np.random.RandomState(7)
+    n_q = 100
+    sizes = rng.randint(5, 20, n_q)
+    n = sizes.sum()
+    X = rng.randn(n, 10)
+    y = np.clip((X[:, 0] * 2 + rng.randn(n) * 0.5).astype(int), 0, 4)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    record = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg", "eval_at": [5],
+               "verbose": -1}, ds, 30,
+              valid_sets=[ds], valid_names=["training"],
+              callbacks=[lgb.record_evaluation(record)])
+    ndcgs = record["training"]["ndcg@5"]
+    assert ndcgs[-1] > ndcgs[0]
+    assert ndcgs[-1] > 0.75
+
+
+def test_cv_returns_results():
+    rng = np.random.RandomState(8)
+    X = rng.randn(1000, 5)
+    y = (X[:, 0] > 0).astype(float)
+    res = lgb.cv({"objective": "binary", "verbose": -1,
+                  "metric": "binary_logloss"},
+                 lgb.Dataset(X, label=y), num_boost_round=10, nfold=3)
+    assert len(res["valid binary_logloss-mean"]) == 10
+    assert res["valid binary_logloss-mean"][-1] < \
+        res["valid binary_logloss-mean"][0]
+
+
+def test_invalid_params_raise():
+    X = np.random.RandomState(9).randn(100, 3)
+    y = X[:, 0]
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "binary", "num_leaves": 1, "verbose": -1},
+                  lgb.Dataset(X, label=y), 1)
+
+
+def test_reset_parameter_callback():
+    rng = np.random.RandomState(10)
+    X = rng.randn(500, 5)
+    y = X[:, 0] + rng.randn(500) * 0.1
+    lrs = []
+
+    def spy(env):
+        lrs.append(env.model.config.learning_rate)
+    spy.order = 100
+    lgb.train({"objective": "regression", "verbose": -1},
+              lgb.Dataset(X, label=y), 5,
+              callbacks=[lgb.reset_parameter(
+                  learning_rate=lambda i: 0.1 * (0.5 ** i)), spy])
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.1 * 0.5 ** 4)
+
+
+def test_feature_importance(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), 20)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.sum() > 0
+    # informative features dominate
+    assert imp_gain[:3].sum() > imp_gain[3:].sum()
+
+
+def test_pred_leaf_and_contrib(binary_data):
+    Xtr, ytr, Xte, _ = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                    lgb.Dataset(Xtr, label=ytr), 5)
+    leaves = bst.predict(Xte[:50], pred_leaf=True)
+    assert leaves.shape == (50, 5)
+    assert leaves.max() < 7
+    contrib = bst.predict(Xte[:10], pred_contrib=True)
+    assert contrib.shape == (10, Xtr.shape[1] + 1)
+    raw = bst.predict(Xte[:10], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_depthwise_policy_quality(binary_data):
+    from sklearn.metrics import roc_auc_score
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.train({"objective": "binary", "grow_policy": "depthwise",
+                     "verbose": -1}, lgb.Dataset(Xtr, label=ytr), 30)
+    assert roc_auc_score(yte, bst.predict(Xte)) > 0.97

@@ -1,0 +1,133 @@
+"""Metric tests vs sklearn/numpy oracles (ref: src/metric/)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.metric import create_metric
+
+
+def setup_metric(name, label, params=None, weight=None, group=None):
+    cfg = Config(dict(params or {}))
+    m = create_metric(name, cfg)
+    md = Metadata(len(label))
+    md.set_label(np.asarray(label, np.float32))
+    if weight is not None:
+        md.set_weight(weight)
+    if group is not None:
+        md.set_group(group)
+    m.init(md, len(label))
+    return m
+
+
+def test_l2_rmse_l1():
+    rng = np.random.RandomState(0)
+    y = rng.randn(100)
+    s = rng.randn(1, 100)
+    assert setup_metric("l2", y).eval(s, None)[0] == \
+        pytest.approx(np.mean((s[0] - y) ** 2))
+    assert setup_metric("rmse", y).eval(s, None)[0] == \
+        pytest.approx(np.sqrt(np.mean((s[0] - y) ** 2)))
+    assert setup_metric("l1", y).eval(s, None)[0] == \
+        pytest.approx(np.mean(np.abs(s[0] - y)))
+
+
+def test_weighted_l2():
+    y = np.array([0.0, 0.0])
+    s = np.array([[1.0, 2.0]])
+    w = np.array([3.0, 1.0], np.float32)
+    assert setup_metric("l2", y, weight=w).eval(s, None)[0] == \
+        pytest.approx((3 * 1 + 1 * 4) / 4)
+
+
+def test_auc_vs_sklearn():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.RandomState(1)
+    y = (rng.rand(500) > 0.5).astype(float)
+    s = rng.randn(1, 500) + y * 0.8
+    ours = setup_metric("auc", y).eval(s, None)[0]
+    assert ours == pytest.approx(roc_auc_score(y, s[0]), abs=1e-9)
+
+
+def test_weighted_auc_vs_sklearn():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.RandomState(2)
+    y = (rng.rand(300) > 0.4).astype(float)
+    s = rng.randn(1, 300) + y
+    w = rng.rand(300).astype(np.float32) + 0.1
+    ours = setup_metric("auc", y, weight=w).eval(s, None)[0]
+    assert ours == pytest.approx(
+        roc_auc_score(y, s[0], sample_weight=w), abs=1e-6)
+
+
+def test_auc_with_ties():
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    s = np.array([[0.5, 0.5, 0.5, 0.5]])
+    assert setup_metric("auc", y).eval(s, None)[0] == pytest.approx(0.5)
+
+
+def test_binary_logloss():
+    from sklearn.metrics import log_loss
+    rng = np.random.RandomState(3)
+    y = (rng.rand(200) > 0.5).astype(float)
+    raw = rng.randn(1, 200)
+
+    class FakeObj:
+        @staticmethod
+        def convert_output(r):
+            return 1 / (1 + np.exp(-r))
+    ours = setup_metric("binary_logloss", y).eval(raw, FakeObj)[0]
+    assert ours == pytest.approx(log_loss(y, 1 / (1 + np.exp(-raw[0]))),
+                                 rel=1e-6)
+
+
+def test_multi_error_ties_count_as_errors():
+    y = np.array([0.0, 1.0])
+    s = np.array([[0.5, 0.5], [0.5, 0.5]])  # all tied
+    cfg_err = setup_metric("multi_error", y, {"num_class": 2})
+    assert cfg_err.eval(s, None)[0] == pytest.approx(1.0)
+
+
+def test_average_precision_vs_sklearn():
+    from sklearn.metrics import average_precision_score
+    rng = np.random.RandomState(4)
+    y = (rng.rand(300) > 0.6).astype(float)
+    s = rng.randn(1, 300) + y * 0.7
+    ours = setup_metric("average_precision", y).eval(s, None)[0]
+    assert ours == pytest.approx(average_precision_score(y, s[0]), abs=1e-6)
+
+
+def test_ndcg_perfect_ranking_is_one():
+    y = np.array([3.0, 2.0, 1.0, 0.0] * 3)
+    s = np.tile(np.array([4.0, 3.0, 2.0, 1.0]), 3)[None, :]
+    m = setup_metric("ndcg", y, {"eval_at": [4]}, group=[4, 4, 4])
+    assert m.eval(s, None)[0] == pytest.approx(1.0)
+
+
+def test_ndcg_at_k_form():
+    m = setup_metric("ndcg@2", np.array([1.0, 0.0]), group=[2])
+    assert m.names == ["ndcg@2"]
+
+
+def test_map_simple():
+    # one query: relevant docs ranked 1st and 3rd -> AP@3 = (1 + 2/3)/2
+    y = np.array([1.0, 0.0, 1.0])
+    s = np.array([[3.0, 2.0, 1.0]])
+    m = setup_metric("map", y, {"eval_at": [3]}, group=[3])
+    assert m.eval(s, None)[0] == pytest.approx((1.0 + 2.0 / 3.0) / 2.0)
+
+
+def test_kullback_leibler_zero_for_perfect():
+    y = np.array([1.0, 0.0, 1.0])
+    s = np.array([[100.0, -100.0, 100.0]])
+    m = setup_metric("kullback_leibler", y)
+    assert m.eval(s, None)[0] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_metric_aliases():
+    y = np.random.RandomState(5).randn(50)
+    s = np.random.RandomState(6).randn(1, 50)
+    assert setup_metric("mse", y).eval(s, None) == \
+        setup_metric("l2", y).eval(s, None)
+    assert setup_metric("mae", y).eval(s, None) == \
+        setup_metric("l1", y).eval(s, None)

@@ -1,0 +1,153 @@
+"""Split finder unit tests vs a numpy oracle
+(behavior mirrors ref: src/treelearner/feature_histogram.hpp)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops.split import (SplitParams, best_numerical_split,
+                                    calculate_leaf_output, leaf_gain)
+
+
+def brute_force_best(hist, num_bin, missing_type, default_bin, p):
+    """Oracle: try every (feature, threshold, direction) by direct partition."""
+    S, F, B, _ = hist.shape
+    best = []
+    for s in range(S):
+        best_gain, best_f, best_t = -np.inf, -1, -1
+        tot_g = hist[s, 0, :, 0].sum()
+        tot_h = hist[s, 0, :, 1].sum()
+        tot_c = hist[s, 0, :, 2].sum()
+        shift = (max(abs(tot_g) - p.lambda_l1, 0.0) * np.sign(tot_g)) ** 2 \
+            / (tot_h + p.lambda_l2)
+        for f in range(F):
+            nb = num_bin[f]
+            mt = missing_type[f]
+            db = default_bin[f]
+            for t in range(nb - 1):
+                for miss_left in ([True, False] if mt else [True]):
+                    g = hist[s, f, :nb, 0].copy()
+                    h = hist[s, f, :nb, 1].copy()
+                    c = hist[s, f, :nb, 2].copy()
+                    left = np.arange(nb) <= t
+                    if mt == 2:  # NaN rides the missing direction (last bin)
+                        left[nb - 1] = miss_left
+                    if mt == 1:  # zero bin rides the missing direction
+                        left[db] = miss_left
+                    lg, lh, lc = g[left].sum(), h[left].sum(), c[left].sum()
+                    rg, rh, rc = g[~left].sum(), h[~left].sum(), c[~left].sum()
+                    if lc < p.min_data_in_leaf or rc < p.min_data_in_leaf:
+                        continue
+                    if lh < p.min_sum_hessian_in_leaf \
+                            or rh < p.min_sum_hessian_in_leaf:
+                        continue
+                    def lgain(sg, sh):
+                        tg = max(abs(sg) - p.lambda_l1, 0.0) * np.sign(sg)
+                        return tg * tg / (sh + p.lambda_l2)
+                    gain = lgain(lg, lh) + lgain(rg, rh)
+                    if gain > best_gain + 1e-10 and gain > shift \
+                            + p.min_gain_to_split:
+                        best_gain, best_f, best_t = gain, f, t
+        best.append((best_f, best_t, best_gain - shift))
+    return best
+
+
+def make_hist(rng, S=1, F=4, B=16, num_bin=None):
+    hist = rng.rand(S, F, B, 3).astype(np.float32)
+    hist[..., 1] += 0.1
+    hist[..., 2] = (hist[..., 2] * 30).astype(np.int32)
+    nb = num_bin if num_bin is not None else np.full(F, B, np.int32)
+    for f in range(F):
+        hist[:, f, nb[f]:, :] = 0.0
+    # all features must share per-slot totals (they bin the same rows);
+    # rescale feature 0's totals onto the others
+    for s in range(S):
+        tg = hist[s, 0, :, 0].sum()
+        th = hist[s, 0, :, 1].sum()
+        tc = hist[s, 0, :, 2].sum()
+        for f in range(1, F):
+            cg = hist[s, f, :nb[f], 0].sum()
+            hist[s, f, :nb[f], 0] *= tg / cg if cg != 0 else 0
+            hist[s, f, :nb[f], 1] *= th / hist[s, f, :nb[f], 1].sum()
+            c = hist[s, f, :nb[f], 2]
+            # adjust counts to match total by dumping remainder in bin 0
+            diff = tc - c.sum()
+            c[0] += diff
+    return hist, nb
+
+
+def test_matches_bruteforce_no_missing():
+    rng = np.random.RandomState(0)
+    p = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0)
+    hist, nb = make_hist(rng, S=2, F=4, B=16)
+    mt = np.zeros(4, np.int32)
+    db = np.zeros(4, np.int32)
+    res = best_numerical_split(
+        jnp.asarray(hist), jnp.asarray(nb), jnp.asarray(mt), jnp.asarray(db),
+        jnp.ones(4, bool), jnp.zeros(4, jnp.int32), p, jnp.zeros(2))
+    oracle = brute_force_best(hist.astype(np.float64), nb, mt, db, p)
+    for s in range(2):
+        of, ot, og = oracle[s]
+        assert int(res.feature[s]) == of
+        assert int(res.threshold[s]) == ot
+        assert float(res.gain[s]) == pytest.approx(og, rel=1e-4)
+
+
+def test_l1_l2_regularization_gains():
+    rng = np.random.RandomState(1)
+    p = SplitParams(lambda_l1=0.5, lambda_l2=2.0, min_data_in_leaf=1,
+                    min_sum_hessian_in_leaf=0.0)
+    hist, nb = make_hist(rng, S=1, F=3, B=8)
+    mt = np.zeros(3, np.int32)
+    db = np.zeros(3, np.int32)
+    res = best_numerical_split(
+        jnp.asarray(hist), jnp.asarray(nb), jnp.asarray(mt), jnp.asarray(db),
+        jnp.ones(3, bool), jnp.zeros(3, jnp.int32), p, jnp.zeros(1))
+    oracle = brute_force_best(hist.astype(np.float64), nb, mt, db, p)
+    assert int(res.feature[0]) == oracle[0][0]
+    assert float(res.gain[0]) == pytest.approx(oracle[0][2], rel=1e-4)
+
+
+def test_min_data_in_leaf_blocks_splits():
+    rng = np.random.RandomState(2)
+    hist, nb = make_hist(rng, S=1, F=2, B=4)
+    hist[..., 2] = 1.0  # 4 data per feature total
+    p = SplitParams(min_data_in_leaf=100)
+    res = best_numerical_split(
+        jnp.asarray(hist), jnp.asarray(nb), jnp.zeros(2, jnp.int32),
+        jnp.zeros(2, jnp.int32), jnp.ones(2, bool), jnp.zeros(2, jnp.int32),
+        p, jnp.zeros(1))
+    assert int(res.feature[0]) == -1
+    assert not np.isfinite(float(res.gain[0]))
+
+
+def test_feature_mask_excludes():
+    rng = np.random.RandomState(3)
+    p = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0)
+    hist, nb = make_hist(rng, S=1, F=3, B=8)
+    mt = np.zeros(3, np.int32)
+    db = np.zeros(3, np.int32)
+    full = best_numerical_split(
+        jnp.asarray(hist), jnp.asarray(nb), jnp.asarray(mt), jnp.asarray(db),
+        jnp.ones(3, bool), jnp.zeros(3, jnp.int32), p, jnp.zeros(1))
+    f0 = int(full.feature[0])
+    mask = np.ones(3, bool)
+    mask[f0] = False
+    res = best_numerical_split(
+        jnp.asarray(hist), jnp.asarray(nb), jnp.asarray(mt), jnp.asarray(db),
+        jnp.asarray(mask), jnp.zeros(3, jnp.int32), p, jnp.zeros(1))
+    assert int(res.feature[0]) != f0
+
+
+def test_leaf_output_formula():
+    p = SplitParams(lambda_l1=0.0, lambda_l2=1.0)
+    out = calculate_leaf_output(jnp.float32(10.0), jnp.float32(4.0), p)
+    assert float(out) == pytest.approx(-10.0 / 5.0)
+    p1 = SplitParams(lambda_l1=2.0, lambda_l2=0.0)
+    out = calculate_leaf_output(jnp.float32(10.0), jnp.float32(4.0), p1)
+    assert float(out) == pytest.approx(-8.0 / 4.0)
+
+
+def test_max_delta_step_clips():
+    p = SplitParams(max_delta_step=0.5)
+    out = calculate_leaf_output(jnp.float32(100.0), jnp.float32(1.0), p)
+    assert float(out) == pytest.approx(-0.5)
