@@ -5,14 +5,15 @@ Mirrors the reference's headline benchmark configuration
 lr=0.1; docs/Experiments.rst:113 — CPU LightGBM trains Higgs 10.5M×28 in
 130.094 s / 500 iterations = 0.2602 s/iter on 2×E5-2690v4).
 
-Prints ONE JSON line:
+Drives the full product path (lightgbm_tpu.train -> GBDT driver -> frontier
+Pallas grower on TPU) on a Higgs-shaped synthetic matrix and prints ONE JSON
+line:
   {"metric": "higgs_sec_per_iter_10.5M_rows", "value": ..., "unit": "s",
    "vs_baseline": baseline/ours (>1 means faster than reference CPU)}
 
-The synthetic matrix is Higgs-shaped (N×28 dense float features with
-correlated signal); time is measured per boosting iteration after warmup and
-scaled linearly to 10.5M rows (histogram construction, the dominant cost, is
-linear in rows — ref: dense_bin.hpp ConstructHistogram).
+Time is measured per boosting iteration after a warmup iteration (histogram
+construction, the dominant cost, is linear in rows — ref: dense_bin.hpp
+ConstructHistogram), scaled linearly from BENCH_ROWS to 10.5M rows.
 """
 from __future__ import annotations
 
@@ -25,20 +26,15 @@ import numpy as np
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_CACHE_DIR",
+                                     "/tmp/lgbm_tpu_jax_cache_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    from lightgbm_tpu.boosting.gbdt import (feature_meta_from_dataset,
-                                            split_params_from_config)
-    from lightgbm_tpu.config import Config
-    from lightgbm_tpu.dataset import TpuDataset
-    from lightgbm_tpu.models.learner import grow_tree_depthwise
+    import lightgbm_tpu as lgb
 
-    # Higgs shape: 28 features; rows sized to fit comfortably in HBM,
-    # result scaled to the reference's 10.5M rows.
     n_rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
     n_feat = 28
-    num_leaves = 255
-    max_bin = 63
     n_iters = int(os.environ.get("BENCH_ITERS", 10))
     baseline_sec_per_iter = 130.094 / 500  # ref: docs/Experiments.rst:113
 
@@ -47,41 +43,18 @@ def main() -> None:
     w = rng.randn(n_feat).astype(np.float32)
     y = (X @ w + 0.5 * rng.randn(n_rows) > 0).astype(np.float32)
 
-    cfg = Config({"max_bin": max_bin, "num_leaves": num_leaves,
-                  "verbose": -1})
-    ds = TpuDataset.from_data(X, cfg)
-    ds.metadata.set_label(y)
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 1e-3, "verbose": -1,
+              "metric": "None"}
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    booster = lgb.Booster(params=params, train_set=ds)
     del X
 
-    meta = feature_meta_from_dataset(ds)
-    params = split_params_from_config(cfg)
-    B = int(ds.max_num_bin)
-    F = ds.num_features
-    bins = jnp.asarray(ds.bins)
-    label = jnp.asarray(y)
-    feature_mask = jnp.ones((F,), bool)
-
-    @jax.jit
-    def boost_iter(score):
-        lv = jnp.where(label > 0, 1.0, -1.0)
-        response = -lv / (1.0 + jnp.exp(lv * score))
-        grad = response
-        hess = jnp.abs(response) * (1.0 - jnp.abs(response))
-        gh = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1)
-        tree, row_leaf = grow_tree_depthwise(
-            bins, gh, meta, feature_mask, params, num_leaves, B,
-            hist_impl="segment")
-        return score + 0.1 * tree.leaf_value[row_leaf], tree
-
-    score = jnp.zeros((n_rows,), jnp.float32)
-    # warmup/compile
-    score, tree = boost_iter(score)
-    jax.block_until_ready(score)
-
+    booster.update()  # warmup: compile + first tree
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        score, tree = boost_iter(score)
-    jax.block_until_ready(score)
+        booster.update()
     elapsed = time.perf_counter() - t0
 
     sec_per_iter = elapsed / n_iters

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -112,8 +113,19 @@ class GBDT:
         self.params = split_params_from_config(config)
         self.meta = feature_meta_from_dataset(train_data)
         self.bins_dev = jnp.asarray(train_data.bins)
-        self.grow_policy = {"auto": "leafwise"}.get(config.grow_policy,
-                                                    config.grow_policy)
+        # the frontier/Pallas path is the TPU throughput mode; leafwise is
+        # the exact reference-parity mode (and the CPU default)
+        from ..ops.pallas_histogram import HAS_PALLAS
+        self.on_tpu = jax.default_backend() == "tpu"
+        self.use_frontier = self.on_tpu and HAS_PALLAS \
+            and config.tpu_histogram_impl in ("auto", "pallas")
+        default_policy = "depthwise" if self.use_frontier else "leafwise"
+        self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
+                                                        config.grow_policy)
+        if self.use_frontier and self.grow_policy == "depthwise":
+            self._init_frontier(train_data)
+        else:
+            self.use_frontier = False
 
         md = train_data.metadata
         k, n = self.num_tree_per_iteration, self.num_data
@@ -163,6 +175,32 @@ class GBDT:
         if config.feature_fraction_bynode < 1.0:
             log.warning("feature_fraction_bynode is not supported yet on the "
                         "TPU learner; using per-tree feature_fraction only")
+
+    # ------------------------------------------------------------------
+    def _init_frontier(self, train_data: TpuDataset) -> None:
+        """Feature-padded int32 row-major + transposed bin matrices for the
+        Pallas kernel and column-load routing (models/frontier.py)."""
+        from ..ops.pallas_histogram import pad_feature_layout
+        F = train_data.num_features
+        Fp, Bp = pad_feature_layout(F, self.max_bins)
+        self.frontier_Fp = Fp
+        self.frontier_Bp = Bp
+        bins = np.asarray(train_data.bins)
+        bins_i32 = np.zeros((self.num_data, Fp), np.int32)
+        bins_i32[:, :F] = bins
+        self.bins_i32_dev = jnp.asarray(bins_i32)
+        self.bins_T_dev = jnp.asarray(bins_i32.T.copy())
+        # padded feature meta: pad features are trivial and never selected
+        nb = np.full(Fp, 2, np.int32)
+        nb[:F] = np.asarray(self.meta.num_bin)
+        mt = np.zeros(Fp, np.int32)
+        mt[:F] = np.asarray(self.meta.missing_type)
+        db = np.zeros(Fp, np.int32)
+        db[:F] = np.asarray(self.meta.default_bin)
+        mono = np.zeros(Fp, np.int32)
+        mono[:F] = np.asarray(self.meta.monotone)
+        self.frontier_meta = FeatureMeta(jnp.asarray(nb), jnp.asarray(mt),
+                                         jnp.asarray(db), jnp.asarray(mono))
 
     # ------------------------------------------------------------------
     def add_valid_data(self, valid_data: TpuDataset, name: str,
@@ -243,18 +281,90 @@ class GBDT:
         return grad, hess
 
     # ------------------------------------------------------------------
+    def _make_fused_step(self):
+        """One jit-compiled dispatch per tree: bagging fold-in + growth.
+        Eager per-op dispatch latency dominates otherwise (each jnp op is a
+        separate device round trip on remote-attached TPUs)."""
+        if self.use_frontier:
+            from ..models.frontier import grow_tree_frontier
+            Fp = self.frontier_Fp
+
+            @jax.jit
+            def step(grad_row, hess_row, bag_weight, fm_pad):
+                gh = jnp.stack([grad_row * bag_weight,
+                                hess_row * bag_weight, bag_weight], axis=1)
+                return grow_tree_frontier(
+                    self.bins_i32_dev, self.bins_T_dev, gh,
+                    self.frontier_meta, fm_pad, self.params,
+                    self.max_leaves, self.frontier_Bp,
+                    int(self.config.max_depth), hist_impl="pallas")
+            return step
+
+        grow = (grow_tree_depthwise if self.grow_policy == "depthwise"
+                else grow_tree_leafwise)
+
+        @jax.jit
+        def step(grad_row, hess_row, bag_weight, fm):
+            gh = jnp.stack([grad_row * bag_weight,
+                            hess_row * bag_weight, bag_weight], axis=1)
+            return grow(self.bins_dev, gh, self.meta, fm, self.params,
+                        self.max_leaves, self.max_bins,
+                        int(self.config.max_depth),
+                        hist_impl=self._xla_hist_impl())
+        return step
+
+    def _fused_step(self, grad_row, hess_row):
+        if getattr(self, "_fused_step_fn", None) is None:
+            self._fused_step_fn = self._make_fused_step()
+            self._score_add_fn = self._make_score_add()
+        fm = self._feature_mask()
+        if self.use_frontier:
+            Fp = self.frontier_Fp
+            fm = jnp.zeros((Fp,), bool).at[:fm.shape[0]].set(fm)
+        return self._fused_step_fn(grad_row, hess_row, self.bag_weight, fm)
+
+    def _make_score_add(self):
+        L = self.max_leaves
+        if self.use_frontier:
+            from ..models.frontier import leaf_value_lookup
+
+            @jax.jit
+            def add(scores, tid, leaf_value, row_leaf):
+                return scores.at[tid].add(
+                    leaf_value_lookup(leaf_value, row_leaf, L))
+            return add
+
+        @jax.jit
+        def add(scores, tid, leaf_value, row_leaf):
+            return scores.at[tid].add(leaf_value[row_leaf])
+        return add
+
+    # ------------------------------------------------------------------
     def _grow(self, gh):
         fm = self._feature_mask()
+        if self.use_frontier:
+            from ..models.frontier import grow_tree_frontier
+            Fp = self.frontier_Fp
+            fm_pad = jnp.zeros((Fp,), bool).at[:fm.shape[0]].set(fm)
+            return grow_tree_frontier(
+                self.bins_i32_dev, self.bins_T_dev, gh,
+                self.frontier_meta, fm_pad, self.params,
+                self.max_leaves, self.frontier_Bp,
+                int(self.config.max_depth), hist_impl="pallas")
         if self.grow_policy == "depthwise":
             return grow_tree_depthwise(
                 self.bins_dev, gh, self.meta, fm, self.params,
                 self.max_leaves, self.max_bins,
                 int(self.config.max_depth),
-                hist_impl=self.config.tpu_histogram_impl)
+                hist_impl=self._xla_hist_impl())
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
-            hist_impl=self.config.tpu_histogram_impl)
+            hist_impl=self._xla_hist_impl())
+
+    def _xla_hist_impl(self) -> str:
+        impl = self.config.tpu_histogram_impl
+        return "auto" if impl in ("auto", "pallas") else impl
 
     def _feature_mask(self):
         """Per-tree column sampling (ref: col_sampler.hpp:20)."""
@@ -276,6 +386,9 @@ class GBDT:
         Returns (host_tree, inner_split_feature, row_leaf placeholder unused).
         """
         ds = self.train_data
+        # single host round trip for the whole tree struct (per-field
+        # np.asarray costs one D2H transfer each)
+        tree = jax.device_get(tree)
         nl = int(tree.num_leaves)
         ht = HostTree(nl, shrinkage=1.0)
         ni = max(0, nl - 1)
@@ -389,8 +502,14 @@ class GBDT:
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
                 lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
-                self.scores = self.scores.at[tid].add(
-                    lv_dev[row_leaf])
+                if self.use_frontier:
+                    # per-row gathers are slow on TPU; use the where-chain
+                    from ..models.frontier import leaf_value_lookup
+                    delta = leaf_value_lookup(lv_dev, row_leaf,
+                                              self.max_leaves)
+                else:
+                    delta = lv_dev[row_leaf]
+                self.scores = self.scores.at[tid].add(delta)
                 dt = _DeviceTree(ht, sf_inner)
                 for vi in range(len(self.valid_scores)):
                     self.valid_scores[vi] = self._add_tree_to_score(

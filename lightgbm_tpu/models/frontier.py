@@ -1,0 +1,285 @@
+"""Frontier-batched tree growth — the TPU performance path.
+
+Replaces the hot part of the reference serial/GPU tree learners (ref:
+src/treelearner/serial_tree_learner.cpp:159-453, gpu_tree_learner.cpp:953)
+with a fully on-device, level-unrolled grower:
+
+- levels are unrolled in Python so every level gets a jit-specialized slot
+  count S_d = min(2^d, L): early levels cost almost nothing instead of
+  paying the num_leaves-sized histogram of the scan-based formulation;
+- histograms come from the Pallas kernel (ops/pallas_histogram.py) on TPU,
+  falling back to the XLA one-hot/segment formulations elsewhere;
+- per-level state is channel-major ([3, L, F, B] histogram pool as separate
+  planes) — TPU relayouts of channel-minor [..., 3] arrays proved ~100x more
+  expensive than the arithmetic they feed;
+- the smaller child of each split is histogrammed, the sibling comes from
+  parent - child (ref: serial_tree_learner.cpp:423-425 subtraction trick);
+- routing reads feature columns from a transposed [F, R] copy of the bin
+  matrix (contiguous column loads instead of per-row gathers).
+
+Tree bookkeeping (node arrays) mirrors models/learner.py's depthwise grower.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import build_histograms
+from ..ops.pallas_histogram import HAS_PALLAS, build_histograms_pallas_cm
+from ..ops.split import BestSplit, SplitParams, best_numerical_split_cm, \
+    calculate_leaf_output
+from .learner import FeatureMeta, NEG_INF, _masked_gain, _masked_scatter
+from .tree import TreeArrays, empty_tree
+
+
+def _hist_level(bins_i32, gh3, row_slot, S, Bp, impl, psum_axis):
+    """[3, S, F, B] channel-major histogram planes for one level."""
+    if impl == "pallas":
+        g, h, c = build_histograms_pallas_cm(bins_i32, gh3, row_slot,
+                                             num_slots=S, num_bins=Bp)
+    else:
+        hist = build_histograms(bins_i32.astype(jnp.int32), gh3, row_slot,
+                                num_slots=S, num_bins=Bp, impl=impl)
+        g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    if psum_axis is not None:
+        g = jax.lax.psum(g, psum_axis)
+        h = jax.lax.psum(h, psum_axis)
+        c = jax.lax.psum(c, psum_axis)
+    return g, h, c
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "num_leaves", "max_bins", "max_depth",
+                     "hist_impl", "psum_axis", "slot_cap"))
+def grow_tree_frontier(bins_i32: jax.Array, bins_T: jax.Array,
+                       gh3: jax.Array, meta: FeatureMeta,
+                       feature_mask: jax.Array, params: SplitParams,
+                       num_leaves: int, max_bins: int, max_depth: int = -1,
+                       hist_impl: str = "pallas", psum_axis: str = None,
+                       slot_cap: int = 64,
+                       ) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree level by level (lax.scan over a uniform body).
+
+    ``slot_cap`` bounds how many leaves split per level pass (the per-level
+    Pallas slot count); num_leaves > slot_cap just takes extra passes.
+    Fully specializing each level's slot count compiles faster kernels but
+    blows up XLA program size at 255 leaves — the scanned uniform body is
+    the robust middle ground.
+
+    Args:
+      bins_i32: [R, Fp] int32 binned rows (feature-padded for the kernel).
+      bins_T: [Fp, R] int32 transposed copy (fast column loads for routing).
+      gh3: [R, 3] float32 (grad, hess, weight).
+
+    Returns (TreeArrays, row_leaf).
+    """
+    R, Fp = bins_i32.shape
+    L = num_leaves
+    B = max_bins
+    S_cap = min(slot_cap, L)
+    n_levels = max_depth if max_depth > 0 else max(1, (L - 1).bit_length() + 1)
+    n_levels = min(n_levels, L - 1)
+    # slot_cap < frontier width means one level of the balanced tree can
+    # need several passes
+    extra = max(0, (L - 1 + S_cap - 1) // S_cap - n_levels)
+    n_levels = n_levels + extra
+
+    tree = empty_tree(L, B)
+    row_leaf = jnp.zeros((R,), jnp.int32)
+    pool_g = jnp.zeros((L, Fp, B), jnp.float32)
+    pool_h = jnp.zeros((L, Fp, B), jnp.float32)
+    pool_c = jnp.zeros((L, Fp, B), jnp.float32)
+
+    g0, h0, c0 = _hist_level(bins_i32, gh3, row_leaf, 8, B, hist_impl,
+                             psum_axis)
+    pool_g = pool_g.at[0].set(g0[0])
+    pool_h = pool_h.at[0].set(h0[0])
+    pool_c = pool_c.at[0].set(c0[0])
+    root_g = jnp.sum(g0[0, 0, :])
+    root_h = jnp.sum(h0[0, 0, :])
+    root_c = jnp.sum(c0[0, 0, :])
+    root_out = calculate_leaf_output(root_g, root_h, params, root_c, 0.0)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(root_out),
+        leaf_count=tree.leaf_count.at[0].set(root_c),
+        leaf_weight=tree.leaf_weight.at[0].set(root_h))
+
+    def all_best(pg, ph, pc, tree):
+        return best_numerical_split_cm(
+            pg, ph, pc, meta.num_bin, meta.missing_type, meta.default_bin,
+            feature_mask, meta.monotone, params, tree.leaf_value)
+
+    best = all_best(pool_g, pool_h, pool_c, tree)
+    best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
+                                        NEG_INF))
+    lpn = jnp.full((L,), -1, jnp.int32)
+    lil = jnp.zeros((L,), bool)
+    num_nodes = jnp.int32(0)
+
+    state = (tree, row_leaf, pool_g, pool_h, pool_c, best, lpn, lil,
+             num_nodes)
+
+    def level_step(state, _):
+        return _one_level(state, bins_i32, bins_T, gh3, meta, feature_mask,
+                          params, all_best, L, B, S_cap, max_depth,
+                          hist_impl, psum_axis), None
+
+    state, _ = jax.lax.scan(level_step, state, None, length=n_levels)
+    tree, row_leaf = state[0], state[1]
+    return tree, row_leaf
+
+
+def leaf_value_lookup(leaf_value: jax.Array, row_leaf: jax.Array,
+                      num_leaves: int) -> jax.Array:
+    """score contribution per row WITHOUT a per-row gather: a where-chain
+    over the (small) leaf table — ~100x faster than jnp.take on TPU for
+    [R]-from-[L] lookups."""
+    def body(l, out):
+        return jnp.where(row_leaf == l, leaf_value[l], out)
+    init = jnp.zeros(row_leaf.shape, leaf_value.dtype)
+    return jax.lax.fori_loop(0, num_leaves, body, init)
+
+
+def _one_level(state, bins_i32, bins_T, gh3, meta, feature_mask, params,
+               all_best, L, B, S_d, max_depth, hist_impl, psum_axis):
+    tree, row_leaf, pool_g, pool_h, pool_c, best, lpn, lil, num_nodes = state
+    R = row_leaf.shape[0]
+    gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves, max_depth, L)
+    budget = L - tree.num_leaves
+    order = jnp.argsort(-gains)
+    rank = jnp.zeros((L,), jnp.int32).at[order].set(
+        jnp.arange(L, dtype=jnp.int32))
+    selected = (gains > 0.0) & (rank < budget) \
+        & (rank < S_d)  # cap splits at this level's slot budget
+    n_sel = jnp.sum(selected.astype(jnp.int32))
+
+    def do_level(op):
+        (tree, row_leaf, pool_g, pool_h, pool_c, best, lpn, lil,
+         num_nodes) = op
+        sel_i32 = selected.astype(jnp.int32)
+        k_of_leaf = jnp.cumsum(sel_i32) - sel_i32
+        new_of_leaf = jnp.where(selected, tree.num_leaves + k_of_leaf, -1)
+        node_of_leaf = jnp.where(selected, num_nodes + k_of_leaf, -1)
+
+        slots = jnp.arange(L)
+        f_l = best.feature
+        t_l = best.threshold
+        dl_l = best.default_left
+        new_depth = tree.leaf_depth + 1
+
+        def w(arr, vals):
+            return _masked_scatter(arr, node_of_leaf, vals, selected)
+        sf = w(tree.split_feature, f_l)
+        tb = w(tree.threshold_bin, t_l)
+        dfl = w(tree.default_left, dl_l)
+        sg = w(tree.split_gain, best.gain)
+        iv = w(tree.internal_value, tree.leaf_value)
+        ic = w(tree.internal_count, tree.leaf_count)
+        iw = w(tree.internal_weight, tree.leaf_weight)
+        lc = w(tree.left_child, -slots - 1)
+        rc = w(tree.right_child, -new_of_leaf - 1)
+        wl = selected & (lpn >= 0) & lil
+        wr = selected & (lpn >= 0) & ~lil
+        lc = _masked_scatter(lc, lpn, node_of_leaf, wl)
+        rc = _masked_scatter(rc, lpn, node_of_leaf, wr)
+        lpn2 = jnp.where(selected, node_of_leaf, lpn)
+        lil2 = jnp.where(selected, True, lil)
+        lpn2 = _masked_scatter(lpn2, new_of_leaf, node_of_leaf, selected)
+        lil2 = _masked_scatter(lil2, new_of_leaf, jnp.zeros((L,), bool),
+                               selected)
+        tree2 = tree._replace(
+            split_feature=sf, threshold_bin=tb, default_left=dfl,
+            split_gain=sg, internal_value=iv, internal_count=ic,
+            internal_weight=iw, left_child=lc, right_child=rc)
+
+        # ---- routing + per-level slot assignment in ONE loop over slots.
+        # All [R]-from-[L] table lookups become scalar reads inside the loop
+        # (per-row gathers run at ~30 ns/row on TPU — the loop's contiguous
+        # column loads + wheres are ~100x cheaper).
+        left_smaller = best.left_count <= best.right_count     # [L]
+        leaf_of_slot = _masked_scatter(
+            jnp.zeros((S_d,), jnp.int32),
+            jnp.minimum(k_of_leaf, S_d - 1), slots.astype(jnp.int32),
+            selected & (k_of_leaf < S_d))
+
+        def route_one(k, carry):
+            row_leaf2, row_slot = carry
+            leaf = leaf_of_slot[k]
+            feat = jnp.maximum(f_l[leaf], 0)
+            col = jax.lax.dynamic_index_in_dim(bins_T, feat, axis=0,
+                                               keepdims=False)  # [R]
+            t = t_l[leaf]
+            dl = dl_l[leaf]
+            nb = meta.num_bin[feat]
+            mt = meta.missing_type[feat]
+            db = meta.default_bin[feat]
+            b = col.astype(jnp.int32)
+            missing = (((mt == 1) & (b == db)) | ((mt == 2) & (b == nb - 1)))
+            left = jnp.where(missing, dl, b <= t)
+            on_leaf = (row_leaf == leaf) & (k < n_sel)
+            new_id = new_of_leaf[leaf]
+            row_leaf2 = jnp.where(on_leaf & ~left, new_id, row_leaf2)
+            # smaller child of this split gets histogram slot k
+            small_is_left = left_smaller[leaf]
+            is_small = jnp.where(small_is_left, left, ~left)
+            row_slot = jnp.where(on_leaf & is_small, k, row_slot)
+            return row_leaf2, row_slot
+
+        row_leaf2, row_slot = jax.lax.fori_loop(
+            0, S_d, route_one,
+            (row_leaf, jnp.full((R,), -1, jnp.int32)))
+
+        # ---- histogram the SMALLER child per split; sibling by subtraction
+        hg, hh, hc = _hist_level(bins_i32, gh3, row_slot, S_d, B,
+                                 hist_impl, psum_axis)
+
+        # pool updates: small child gets fresh hist, sibling = parent - small
+        k_safe = jnp.minimum(k_of_leaf, S_d - 1)
+        got_g = hg[k_safe]
+        got_h = hh[k_safe]
+        got_c = hc[k_safe]
+        par_g = pool_g[jnp.where(selected, slots, 0)]
+        par_h = pool_h[jnp.where(selected, slots, 0)]
+        par_c = pool_c[jnp.where(selected, slots, 0)]
+        sib_g = par_g - got_g
+        sib_h = par_h - got_h
+        sib_c = par_c - got_c
+        # left child keeps the old leaf id; right child gets new id
+        left_g = jnp.where(left_smaller[:, None, None], got_g, sib_g)
+        left_h = jnp.where(left_smaller[:, None, None], got_h, sib_h)
+        left_c = jnp.where(left_smaller[:, None, None], got_c, sib_c)
+        right_g = jnp.where(left_smaller[:, None, None], sib_g, got_g)
+        right_h = jnp.where(left_smaller[:, None, None], sib_h, got_h)
+        right_c = jnp.where(left_smaller[:, None, None], sib_c, got_c)
+        pool_g2 = _masked_scatter(pool_g, slots, left_g, selected)
+        pool_g2 = _masked_scatter(pool_g2, new_of_leaf, right_g, selected)
+        pool_h2 = _masked_scatter(pool_h, slots, left_h, selected)
+        pool_h2 = _masked_scatter(pool_h2, new_of_leaf, right_h, selected)
+        pool_c2 = _masked_scatter(pool_c, slots, left_c, selected)
+        pool_c2 = _masked_scatter(pool_c2, new_of_leaf, right_c, selected)
+
+        def upd2(arr, lv, rv):
+            arr = _masked_scatter(arr, slots, lv, selected)
+            return _masked_scatter(arr, new_of_leaf, rv, selected)
+        tree2 = tree2._replace(
+            num_leaves=tree.num_leaves + n_sel,
+            leaf_value=upd2(tree2.leaf_value, best.left_output,
+                            best.right_output),
+            leaf_count=upd2(tree2.leaf_count, best.left_count,
+                            best.right_count),
+            leaf_weight=upd2(tree2.leaf_weight, best.left_sum_hess,
+                             best.right_sum_hess),
+            leaf_depth=upd2(tree2.leaf_depth, new_depth, new_depth),
+        )
+
+        best2 = all_best(pool_g2, pool_h2, pool_c2, tree2)
+        active = jnp.arange(L) < tree2.num_leaves
+        best2 = best2._replace(gain=jnp.where(active, best2.gain, NEG_INF))
+        return (tree2, row_leaf2, pool_g2, pool_h2, pool_c2, best2, lpn2,
+                lil2, num_nodes + n_sel)
+
+    return jax.lax.cond(n_sel > 0, do_level, lambda op: op, state)

@@ -112,10 +112,31 @@ def best_numerical_split(hist: jax.Array, num_bin_per_feat: jax.Array,
                          feature_mask: jax.Array, monotone: jax.Array,
                          params: SplitParams,
                          parent_output: jax.Array) -> BestSplit:
-    """Best numerical split per slot.
+    """Best numerical split per slot from a channel-minor histogram.
 
     Args:
       hist: ``[S, F, B, 3]`` float32 (grad, hess, count).
+      (see best_numerical_split_cm for the remaining args)
+    """
+    return best_numerical_split_cm(
+        hist[..., 0], hist[..., 1], hist[..., 2], num_bin_per_feat,
+        missing_type, default_bin, feature_mask, monotone, params,
+        parent_output)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
+                            cnt: jax.Array, num_bin_per_feat: jax.Array,
+                            missing_type: jax.Array, default_bin: jax.Array,
+                            feature_mask: jax.Array, monotone: jax.Array,
+                            params: SplitParams,
+                            parent_output: jax.Array) -> BestSplit:
+    """Best numerical split per slot (channel-major inputs — TPU relayouts
+    of channel-minor ``[..., 3]`` arrays are expensive, so the hot path keeps
+    grad/hess/count as separate ``[S, F, B]`` planes).
+
+    Args:
+      grad/hess/cnt: ``[S, F, B]`` float32 histogram planes.
       num_bin_per_feat: ``[F]`` int32 actual bin counts (rest is padding).
       missing_type: ``[F]`` int32 (0 none / 1 zero / 2 nan).
       default_bin: ``[F]`` int32 (bin of value 0; the zero-missing bin).
@@ -125,11 +146,8 @@ def best_numerical_split(hist: jax.Array, num_bin_per_feat: jax.Array,
 
     Returns a ``BestSplit`` with per-slot winners.
     """
-    S, F, B, _ = hist.shape
+    S, F, B = grad.shape
     p = params
-    grad = hist[..., 0]
-    hess = hist[..., 1]
-    cnt = hist[..., 2]
 
     t_iota = jnp.arange(B, dtype=jnp.int32)[None, None, :]
     nb = num_bin_per_feat[None, :, None]          # [1,F,1]
