@@ -1,0 +1,325 @@
+"""Fused per-level Pallas kernel: route + histogram in ONE pass over rows.
+
+This is the round-2 hot path, replacing ops/pallas_histogram.py +
+the per-slot routing loop of models/frontier.py. It replaces the
+reference's hottest loops (ref: src/io/dense_bin.hpp ConstructHistogram,
+src/treelearner/serial_tree_learner.cpp:355-453, ocl/histogram256.cl) with
+a single streaming kernel per tree level.
+
+Design (all measured on the attached TPU, see PROFILE.md):
+
+- Layout is TRANSPOSED vs round 1: rows ride the 128-wide lane dimension,
+  features/bins/slots ride sublanes. The bin one-hot build then uses only
+  native sublane broadcasts (no per-feature lane broadcast / int8 sublane
+  extraction, which cost 2-3x in round 1's kernel).
+- The one-hot ``oh[f*B+b, r] = (bins[f, r] == b)`` is built ONCE per row
+  tile with a bulk int8->int32 convert + ``jnp.repeat`` + one compare, then
+  feeds BOTH matmuls:
+    * routing:   ``D = W @ oh``            -> [S, C]  (W encodes this
+      level's split thresholds + missing routing per slot)
+    * histogram: ``hist += oh @ ghs^T``    -> [FB, nch*S]
+  so routing costs one extra MXU pass instead of a separate O(S*R)
+  column-load loop over HBM (round 1's dominant cost).
+- All gh channels are packed into ONE dot (N = nch*S): measured MXU
+  efficiency rises sharply with N (45 TF/s at N=192 -> 83 TF/s at N=384).
+- Channels (``nch=5``, default): g_hi, g_lo, h_hi, h_lo, w — grad/hess are
+  split into two bfloat16 halves (hi + exact residual) so the accumulated
+  histogram carries ~fp32 input precision, matching the reference GPU
+  precision contract (ref: docs/GPU-Performance.rst:130-160) instead of
+  round 1's raw-bf16 rounding. ``nch=3`` (g, h, w single-bf16) is the fast
+  mode.
+- The grid is sequential on a TPU core, so the [FB, nch*S] output block
+  accumulates across row tiles race-free; the updated row->leaf vector is
+  emitted per-tile alongside.
+- The ROOT pass needs no special kernel: tables with leaf_of_slot=[0],
+  W[0, 0:B] = 1 (every row "goes left" on feature 0) and small_is_left=1
+  make slot 0 collect the full-data histogram.
+
+The smaller child of each split is histogrammed (caller puts the smaller
+side in the slot tables); the sibling is reconstructed outside by
+subtraction (ref: serial_tree_learner.cpp:423-425).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - exotic backends fall back to interpret mode
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+NCH_PRECISE = 5   # g_hi, g_lo, h_hi, h_lo, w
+NCH_FAST = 3      # g, h, w
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def default_tile_rows(Sp: int) -> int:
+    """Row-tile width: the [FB, C] one-hot scratch + [FB, nch*Sp] VMEM
+    accumulator must fit the ~16 MB VMEM budget, so wide slot counts halve
+    the tile."""
+    return 1024 if Sp <= 64 else 512
+
+
+def feature_layout(num_features: int, max_bin: int) -> Tuple[int, int]:
+    """(F_oh, B) such that B = pow2 >= max_bin and (F_oh * B) % 128 == 0.
+
+    F_oh is the one-hot feature count (>= num_features); padded features
+    must carry bin 0 everywhere and be masked out of the split scan.
+    """
+    B = max(8, _next_pow2(max_bin))
+    quota = max(1, 128 // min(B, 128))
+    F_oh = _round_up(max(num_features, 1), quota)
+    return F_oh, B
+
+
+def pack_gh(grad: jax.Array, hess: jax.Array, weight: jax.Array,
+            nch: int) -> jax.Array:
+    """[8, R] bfloat16 channel block for the kernel.
+
+    nch=5: g_hi, g_lo, h_hi, h_lo, w  (hi/lo bf16 split => fp32-grade sums)
+    nch=3: g, h, w
+    Rows beyond nch are zero padding (the sublane block is 8 tall anyway).
+    """
+    R = grad.shape[-1]
+    z = jnp.zeros((R,), jnp.bfloat16)
+    if nch == NCH_PRECISE:
+        g_hi = grad.astype(jnp.bfloat16)
+        g_lo = (grad - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        h_hi = hess.astype(jnp.bfloat16)
+        h_lo = (hess - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        rows = [g_hi, g_lo, h_hi, h_lo, weight.astype(jnp.bfloat16), z, z, z]
+    else:
+        rows = [grad.astype(jnp.bfloat16), hess.astype(jnp.bfloat16),
+                weight.astype(jnp.bfloat16), z, z, z, z, z]
+    return jnp.stack(rows, axis=0)
+
+
+def hist_planes(hist: jax.Array, nch: int, Sp: int, F_oh: int, B: int):
+    """[FB, nch*Sp] kernel output -> (grad, hess, cnt) planes [Sp, F_oh, B]
+    in float32 (hi/lo recombined when nch=5)."""
+    def plane(c):
+        return hist[:, c * Sp:(c + 1) * Sp]
+    if nch == NCH_PRECISE:
+        g = plane(0) + plane(1)
+        h = plane(2) + plane(3)
+        c = plane(4)
+    else:
+        g, h, c = plane(0), plane(1), plane(2)
+    to = lambda x: x.T.reshape(Sp, F_oh, B)
+    return to(g), to(h), to(c)
+
+
+def build_route_table(feature: jax.Array, threshold: jax.Array,
+                      default_left: jax.Array, num_bin: jax.Array,
+                      missing_type: jax.Array, default_bin: jax.Array,
+                      Sp: int, F_oh: int, B: int,
+                      cat_flag: jax.Array = None,
+                      cat_mask: jax.Array = None) -> jax.Array:
+    """W [Sp, F_oh*B] bfloat16: W[k, f*B+b] = 1 iff a row with bin b of
+    feature f goes LEFT under slot k's split. Missing-bin routing follows
+    default_left (ref: src/io/dense_bin.hpp Split: zero/NaN bins ride the
+    default direction). feature=-1 rows are all-zero (inactive slot).
+
+    Args are per-slot [Sp] (feature/threshold/default_left, and optionally
+    cat_flag [Sp] + cat_mask [Sp, B] for categorical splits where "left"
+    membership is an explicit bin set) and per-feature [F] metadata.
+    """
+    F = num_bin.shape[0]
+    f_iota = jnp.arange(F_oh, dtype=jnp.int32)[None, :, None]      # [1,Foh,1]
+    b_iota = jnp.arange(B, dtype=jnp.int32)[None, None, :]         # [1,1,B]
+    nb = jnp.zeros((F_oh,), jnp.int32).at[:F].set(num_bin)
+    mt = jnp.zeros((F_oh,), jnp.int32).at[:F].set(missing_type)
+    db = jnp.zeros((F_oh,), jnp.int32).at[:F].set(default_bin)
+    nb = nb[None, :, None]
+    mt = mt[None, :, None]
+    db = db[None, :, None]
+
+    feat = feature[:, None, None]                                  # [Sp,1,1]
+    thr = threshold[:, None, None]
+    dl = default_left[:, None, None]
+
+    is_missing = (((mt == 1) & (b_iota == db))
+                  | ((mt == 2) & (b_iota == nb - 1)))
+    numeric_left = jnp.where(is_missing, dl, b_iota <= thr)
+    if cat_flag is not None:
+        cat_left = cat_mask[:, None, :]                            # [Sp,1,B]
+        go_left = jnp.where(cat_flag[:, None, None], cat_left, numeric_left)
+    else:
+        go_left = numeric_left
+    w = (f_iota == feat) & go_left & (feat >= 0)
+    return w.reshape(Sp, F_oh * B).astype(jnp.bfloat16)
+
+
+def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
+                  hist_ref, newleaf_ref, oh_ref, *,
+                  B: int, F_oh: int, Sp: int, nch: int):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    C = bins_ref.shape[1]
+    FB = F_oh * B
+
+    # ---- bin one-hot [FB, C]: bulk int8->int32 unpack once, sublane
+    # repeat, one compare (measured fastest variant; see PROFILE.md)
+    bins_val = bins_ref[:].astype(jnp.int32)                   # [Fp, C]
+    big = jnp.repeat(bins_val[:F_oh], B, axis=0)               # [FB, C]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
+    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+
+    leafb = leaf_ref[:]                                        # [1, C] i32
+
+    # ---- routing: D[k, r] = 1 iff row r goes left under slot k's split
+    oh = oh_ref[:]
+    D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [Sp, C]
+    left = D > 0.5
+
+    # ---- slot membership
+    leaf_of_slot = tbl_ref[:, 0:1]                             # [Sp, 1]
+    right_delta = tbl_ref[:, 1:2]
+    small_left = tbl_ref[:, 2:3] > 0
+    P = jnp.broadcast_to(leafb, (Sp, C)) == leaf_of_slot       # [Sp, C]
+    in_small = P & (left == small_left)
+
+    # ---- histogram: one wide-N dot, all channels packed
+    chans = []
+    for ch in range(nch):
+        g = gh_ref[ch:ch + 1, :]                               # [1, C] bf16
+        chans.append(jnp.where(in_small, jnp.broadcast_to(g, (Sp, C)),
+                               jnp.bfloat16(0.0)))
+    ghs = jnp.concatenate(chans, axis=0)                       # [nch*Sp, C]
+    hist_ref[:] += jax.lax.dot_general(
+        oh, ghs, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                    # [FB, nch*Sp]
+
+    # ---- row->leaf update: right-child rows move to their new leaf id
+    go_right = P & ~left
+    delta = jnp.sum(jnp.where(go_right,
+                              jnp.broadcast_to(right_delta, (Sp, C)), 0),
+                    axis=0, keepdims=True)                     # [1, C] i32
+    newleaf_ref[:] = leafb + delta
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_slots", "num_bins", "f_oh", "nch", "tile_rows",
+                     "interpret"))
+def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
+               W: jax.Array, tbl: jax.Array, *, num_slots: int,
+               num_bins: int, f_oh: int, nch: int = NCH_PRECISE,
+               tile_rows: int = 0, interpret: bool = False):
+    """One fused route+histogram pass over all rows.
+
+    Args:
+      bins_T: [Fp, R] int8 binned matrix, transposed (Fp >= f_oh; padded
+        feature rows all-zero). R must be a multiple of the tile size
+        (pad rows carry leaf_T = -1 so they contribute nothing).
+      leaf_T: [1, R] int32 row->leaf ids (-1 = inactive/padding row).
+      gh_T: [8, R] bfloat16 channel block from pack_gh().
+      W: [Sp, f_oh*num_bins] bfloat16 route table (build_route_table).
+      tbl: [Sp, 128] int32; col 0 leaf_of_slot (-1 = inactive slot),
+        col 1 right_delta (new_leaf_id - leaf_id), col 2 small_is_left.
+
+    Returns:
+      hist: [f_oh*num_bins, nch*Sp] float32 smaller-child histograms.
+      new_leaf: [1, R] int32 updated assignment.
+    """
+    if not HAS_PALLAS:
+        raise ImportError("jax.experimental.pallas is unavailable on this "
+                          "backend; use the XLA histogram path instead")
+    Fp, R = bins_T.shape
+    B = num_bins
+    FB = f_oh * B
+    Sp = tbl.shape[0]
+    C = tile_rows or default_tile_rows(Sp)
+    assert R % C == 0, f"rows {R} not padded to tile {C}"
+    T = R // C
+
+    kernel = functools.partial(_level_kernel, B=B, F_oh=f_oh, Sp=Sp, nch=nch)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((Fp, C), lambda t: (0, t)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((8, C), lambda t: (0, t)),
+            pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
+            pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((FB, nch * Sp), lambda t: (0, 0)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+        ],
+        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
+    )
+    hist, new_leaf = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((FB, nch * Sp), jnp.float32),
+            jax.ShapeDtypeStruct((1, R), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(bins_T, leaf_T, gh_T, W, tbl)
+    return hist, new_leaf
+
+
+def _lookup_kernel(idx_ref, tbl_ref, out_ref, *, Lp: int):
+    C = idx_ref.shape[1]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)
+    P = jnp.broadcast_to(idx_ref[:], (Lp, C)) == iota_l
+    vals = jnp.broadcast_to(tbl_ref[:, 0:1], (Lp, C))
+    out_ref[:] = jnp.sum(jnp.where(P, vals, 0.0), axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_rows", "interpret"))
+def table_lookup(idx_T: jax.Array, table: jax.Array, *,
+                 tile_rows: int = 2048, interpret: bool = False) -> jax.Array:
+    """out[0, r] = table[idx_T[0, r]] for a SMALL table, without the
+    ~30 ns/row random-gather penalty of XLA's [R]-from-[L] gather on TPU:
+    one streaming pass with a sublane one-hot reduction.
+
+    idx values outside [0, len(table)) return 0. Used for per-row leaf-value
+    score updates (ref: src/boosting/score_updater.hpp:88 AddScore).
+    """
+    (_, R) = idx_T.shape
+    L = table.shape[0]
+    Lp = _round_up(max(L, 8), 8)
+    C = min(tile_rows, _round_up(R, 128))
+    Rp = _round_up(R, C)
+    if Rp != R:
+        idx_T = jnp.pad(idx_T, ((0, 0), (0, Rp - R)), constant_values=-1)
+    tblp = jnp.zeros((Lp, 128), table.dtype).at[:L, 0].set(table)
+    kernel = functools.partial(_lookup_kernel, Lp=Lp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Rp // C,),
+        in_specs=[
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((Lp, 128), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((1, Rp), table.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(idx_T, tblp)
+    return out[:, :R]

@@ -1,0 +1,117 @@
+"""Micro-benchmarks of the primitives that bound GBDT training on TPU.
+
+Every op is chained N times inside ONE jit-compiled loop so the measurement
+is device throughput, not dispatch/tunnel latency. Run on the real chip:
+
+    python scripts/profile_micro.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, reps=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def chain(body, n):
+    """Run body n times sequentially inside one jit (data-dependent)."""
+    @jax.jit
+    def run(*args):
+        def step(i, carry):
+            return body(i, carry, *args[1:])
+        return jax.lax.fori_loop(0, n, step, args[0])
+    return run
+
+
+def main():
+    R = 2_000_000
+    F = 28
+    Fp = 32
+    B = 64
+    N = 10
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 63, size=(R, Fp)).astype(np.int32))
+    bins_u8 = jnp.asarray(np.asarray(bins).astype(np.uint8))
+    gh = jnp.asarray(rng.randn(R, 3).astype(np.float32))
+    perm = jnp.asarray(rng.permutation(R).astype(np.int32))
+    slot = jnp.asarray(rng.randint(0, 64, size=R).astype(np.int32))
+
+    results = {}
+
+    # 0. raw MXU throughput (chained, data-dependent)
+    a = jnp.asarray(rng.randn(4096, 4096).astype(np.float32)).astype(
+        jnp.bfloat16)
+    f = chain(lambda i, x, a: (x @ a), N)
+    t = timeit(f, a, a) / N
+    results["matmul_4096_bf16_tflops"] = 2 * 4096**3 / t / 1e12
+
+    # 1. HBM r/w bandwidth (chained adds)
+    big = jnp.zeros((R, Fp), jnp.float32)
+    f = chain(lambda i, x: x + 1.0, N)
+    t = timeit(f, big) / N
+    results["hbm_rw_f32_GBps"] = 2 * R * Fp * 4 / t / 1e9
+
+    # 2. random row gather [R, Fp] uint8 (index fed by previous gather so
+    # the chain cannot be elided)
+    f = chain(lambda i, p, x: (p + x[p][:, 0].astype(jnp.int32)) % R, N)
+    t = timeit(f, perm, bins_u8) / N
+    results["row_gather_u8_ns_per_row"] = t / R * 1e9
+    t = timeit(f, perm, bins) / N
+    results["row_gather_i32_ns_per_row"] = t / R * 1e9
+
+    # 2b. 1-D gather / scatter
+    f = chain(lambda i, p, x: (p + x[p]) % R, N)
+    t = timeit(f, perm, slot) / N
+    results["gather_1d_ns_per_elem"] = t / R * 1e9
+    f = chain(lambda i, p, x: (p + jnp.zeros_like(x).at[p].set(x)) % R, N)
+    t = timeit(f, perm, slot) / N
+    results["scatter_1d_unique_ns_per_elem"] = t / R * 1e9
+
+    # 3. sort (key,payload)
+    f = chain(lambda i, k, v: jax.lax.sort(((k * 7919 + 13) % R, v),
+                                           num_keys=1)[0], N)
+    t = timeit(f, slot, perm) / N
+    results["sort_kv_2M_ms"] = t * 1e3
+
+    # 4. cumsum
+    f = chain(lambda i, x: jnp.cumsum(x) % 1000, N)
+    t = timeit(f, slot) / N
+    results["cumsum_2M_ms"] = t * 1e3
+
+    # 5. current pallas histogram, jit-compiled, per-pass
+    from lightgbm_tpu.ops.pallas_histogram import build_histograms_pallas_cm
+
+    for S in (8, 64):
+        @functools.partial(jax.jit, static_argnames=())
+        def hist_loop(bins, gh, slot, _S=S):
+            def step(i, acc):
+                g, h, c = build_histograms_pallas_cm(
+                    bins, gh, (slot + i) % _S, num_slots=_S, num_bins=B)
+                return acc + g[0, 0, 0]
+            return jax.lax.fori_loop(0, N, step, 0.0)
+        t = timeit(hist_loop, bins, gh, slot) / N
+        results[f"pallas_hist_S{S}_ms"] = t * 1e3
+
+    for k, v in results.items():
+        print(f"{k:36s} {v if isinstance(v, str) else round(v, 3)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Fused route+histogram level kernel vs numpy oracle (interpret mode).
+
+Covers the round-2 hot path (ops/fused_level.py): root histogram, mid-tree
+routing + smaller-child histograms with missing-bin routing, categorical
+route tables, hi/lo bf16 precision recombination, and the table_lookup
+score-update kernel. Oracle is plain numpy over the same tables
+(ref semantics: src/io/dense_bin.hpp Split + ConstructHistogram).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.fused_level import (NCH_FAST, NCH_PRECISE,
+                                          build_route_table, feature_layout,
+                                          hist_planes, level_pass, pack_gh,
+                                          table_lookup)
+
+
+def _np_route_left(b, thr, dl, nb, mt, db):
+    missing = ((mt == 1) & (b == db)) | ((mt == 2) & (b == nb - 1))
+    return np.where(missing, dl, b <= thr)
+
+
+def _oracle(bins, leaf, grad, hess, w, slots, meta, F, B):
+    """Per-slot smaller-child histograms + new leaf ids, in numpy."""
+    nb, mt, db = meta
+    R = bins.shape[0]
+    Sp = len(slots)
+    hist = np.zeros((Sp, F, B, 3), np.float64)
+    new_leaf = leaf.copy()
+    for k, (lf, feat, thr, dl, delta, small_left) in enumerate(slots):
+        if lf < 0:
+            continue
+        on = leaf == lf
+        b = bins[:, feat]
+        left = _np_route_left(b, thr, dl, nb[feat], mt[feat], db[feat])
+        go_right = on & ~left
+        new_leaf = np.where(go_right, leaf + delta, new_leaf)
+        in_small = on & (left == bool(small_left))
+        for f in range(F):
+            np.add.at(hist[k, f, :, 0], bins[in_small, f], grad[in_small])
+            np.add.at(hist[k, f, :, 1], bins[in_small, f], hess[in_small])
+            np.add.at(hist[k, f, :, 2], bins[in_small, f], w[in_small])
+    return hist, new_leaf
+
+
+def _setup(R=1024, F=5, B=16, seed=0):
+    rng = np.random.RandomState(seed)
+    nb = np.array([B, B - 3, B, 7, B], np.int32)[:F]
+    mt = np.array([0, 1, 2, 0, 2], np.int32)[:F]
+    db = np.array([0, 4, 0, 0, 0], np.int32)[:F]
+    bins = np.stack([rng.randint(0, nb[f], size=R) for f in range(F)],
+                    axis=1).astype(np.int8)
+    grad = rng.randn(R).astype(np.float32)
+    hess = np.abs(rng.randn(R)).astype(np.float32) + 0.1
+    w = np.ones(R, np.float32)
+    return bins, grad, hess, w, (nb, mt, db)
+
+
+def _run_level(bins, leaf, grad, hess, w, slots, meta, F, B, nch):
+    nb, mt, db = meta
+    F_oh, Bp = feature_layout(F, B)
+    assert Bp == B
+    R = bins.shape[0]
+    C = 256
+    Rp = ((R + C - 1) // C) * C
+    Fp = max(F_oh, 8)
+    bins_T = np.zeros((Fp, Rp), np.int8)
+    bins_T[:F, :R] = bins.T
+    leaf_T = np.full((1, Rp), -1, np.int32)
+    leaf_T[0, :R] = leaf
+    gpad = np.zeros(Rp, np.float32)
+    gpad[:R] = grad
+    hpad = np.zeros(Rp, np.float32)
+    hpad[:R] = hess
+    wpad = np.zeros(Rp, np.float32)
+    wpad[:R] = w
+
+    Sp = len(slots)
+    feat = jnp.asarray([s[1] if s[0] >= 0 else -1 for s in slots], jnp.int32)
+    thr = jnp.asarray([s[2] for s in slots], jnp.int32)
+    dl = jnp.asarray([bool(s[3]) for s in slots])
+    W = build_route_table(feat, thr, dl, jnp.asarray(nb), jnp.asarray(mt),
+                          jnp.asarray(db), Sp, F_oh, B)
+    tbl = np.zeros((Sp, 128), np.int32)
+    for k, (lf, _, _, _, delta, small_left) in enumerate(slots):
+        tbl[k, 0] = lf
+        tbl[k, 1] = delta
+        tbl[k, 2] = int(small_left)
+
+    gh_T = pack_gh(jnp.asarray(gpad), jnp.asarray(hpad), jnp.asarray(wpad),
+                   nch)
+    hist, new_leaf = level_pass(
+        jnp.asarray(bins_T), jnp.asarray(leaf_T), gh_T, W,
+        jnp.asarray(tbl), num_slots=Sp, num_bins=B, f_oh=F_oh, nch=nch,
+        tile_rows=C, interpret=True)
+    g, h, c = hist_planes(hist, nch, Sp, F_oh, B)
+    got = np.stack([np.asarray(g), np.asarray(h), np.asarray(c)],
+                   axis=-1)[:, :F]
+    return got, np.asarray(new_leaf)[0, :R]
+
+
+def test_root_histogram():
+    bins, grad, hess, w, meta = _setup()
+    F, B = 5, 16
+    leaf = np.zeros(bins.shape[0], np.int32)
+    # root: slot 0 collects everything (W row routes all rows left)
+    slots = [(0, 0, B - 1, True, 0, 1)] + [(-1, 0, 0, 0, 0, 0)] * 7
+    got, new_leaf = _run_level(bins, leaf, grad, hess, w, slots, meta, F, B,
+                               NCH_PRECISE)
+    want, want_leaf = _oracle(bins, leaf, grad, hess, w, slots, meta, F, B)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(new_leaf, want_leaf)
+
+
+@pytest.mark.parametrize("nch", [NCH_PRECISE, NCH_FAST])
+def test_mid_level_route_and_hist(nch):
+    bins, grad, hess, w, meta = _setup(R=2048)
+    F, B = 5, 16
+    rng = np.random.RandomState(1)
+    leaf = rng.randint(0, 3, size=bins.shape[0]).astype(np.int32)
+    # three active slots splitting leaves 0,1,2 on different features,
+    # exercising zero- and nan-missing routing + both small sides
+    slots = [
+        (0, 1, 5, True, 3, 1),    # feature 1: zero-missing, default left
+        (1, 2, 7, False, 3, 0),   # feature 2: nan-missing, default right
+        (2, 3, 2, True, 3, 1),    # feature 3: 7 bins
+    ] + [(-1, 0, 0, 0, 0, 0)] * 5
+    got, new_leaf = _run_level(bins, leaf, grad, hess, w, slots, meta, F, B,
+                               nch)
+    want, want_leaf = _oracle(bins, leaf, grad, hess, w, slots, meta, F, B)
+    tol = 1e-4 if nch == NCH_PRECISE else 2e-2
+    np.testing.assert_allclose(got, want, rtol=tol, atol=float(tol))
+    np.testing.assert_array_equal(new_leaf, want_leaf)
+
+
+def test_precision_hi_lo_beats_bf16():
+    """The hi/lo split must recover ~fp32 sums where raw bf16 drifts."""
+    bins, grad, hess, w, meta = _setup(R=4096, seed=3)
+    F, B = 5, 16
+    leaf = np.zeros(bins.shape[0], np.int32)
+    slots = [(0, 0, B - 1, True, 0, 1)] + [(-1, 0, 0, 0, 0, 0)] * 7
+    want, _ = _oracle(bins, leaf, grad, hess, w, slots, meta, F, B)
+    got5, _ = _run_level(bins, leaf, grad, hess, w, slots, meta, F, B,
+                         NCH_PRECISE)
+    got3, _ = _run_level(bins, leaf, grad, hess, w, slots, meta, F, B,
+                         NCH_FAST)
+    err5 = np.abs(got5[..., 0] - want[..., 0]).max()
+    err3 = np.abs(got3[..., 0] - want[..., 0]).max()
+    assert err5 < 1e-3
+    assert err5 < err3 / 4
+
+
+def test_categorical_route_table():
+    bins, grad, hess, w, meta = _setup(R=2048, seed=5)
+    F, B = 5, 16
+    rng = np.random.RandomState(2)
+    leaf = rng.randint(0, 2, size=bins.shape[0]).astype(np.int32)
+    nb, mt, db = meta
+    cat_mask = np.zeros((8, B), bool)
+    cat_mask[0, [1, 3, 4]] = True       # bins {1,3,4} of feature 0 go left
+    slots = [(0, 0, 0, False, 2, 1)] + [(-1, 0, 0, 0, 0, 0)] * 7
+    F_oh, _ = feature_layout(F, B)
+    feat = jnp.asarray([0] + [-1] * 7, jnp.int32)
+    W = build_route_table(
+        feat, jnp.zeros(8, jnp.int32), jnp.zeros(8, bool),
+        jnp.asarray(nb), jnp.asarray(mt), jnp.asarray(db), 8, F_oh, B,
+        cat_flag=jnp.asarray([True] + [False] * 7),
+        cat_mask=jnp.asarray(cat_mask))
+    # numpy oracle with explicit membership
+    on = leaf == 0
+    left = cat_mask[0][bins[:, 0]]
+    want_leaf = np.where(on & ~left, leaf + 2, leaf)
+
+    C = 256
+    R = bins.shape[0]
+    Fp = max(F_oh, 8)
+    bins_T = np.zeros((Fp, R), np.int8)
+    bins_T[:F] = bins.T
+    leaf_T = leaf[None, :].astype(np.int32)
+    tbl = np.zeros((8, 128), np.int32)
+    tbl[0] = 0
+    tbl[0, 1] = 2
+    tbl[0, 2] = 1
+    tbl[1:, 0] = -1
+    gh_T = pack_gh(jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(w),
+                   NCH_FAST)
+    hist, new_leaf = level_pass(
+        jnp.asarray(bins_T), jnp.asarray(leaf_T), gh_T, W, jnp.asarray(tbl),
+        num_slots=8, num_bins=B, f_oh=F_oh, nch=NCH_FAST, tile_rows=C,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(new_leaf)[0], want_leaf)
+    # smaller-child (left side here) grad histogram of feature 0
+    in_small = on & left
+    want_g = np.zeros(B)
+    np.add.at(want_g, bins[in_small, 0], grad[in_small])
+    g, _, _ = hist_planes(hist, NCH_FAST, 8, F_oh, B)
+    np.testing.assert_allclose(np.asarray(g)[0, 0], want_g, rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_table_lookup():
+    rng = np.random.RandomState(0)
+    R, L = 4096, 37
+    idx = rng.randint(-1, L, size=R).astype(np.int32)
+    table = rng.randn(L).astype(np.float32)
+    out = table_lookup(jnp.asarray(idx[None, :]), jnp.asarray(table),
+                       tile_rows=1024, interpret=True)
+    want = np.where(idx >= 0, table[np.clip(idx, 0, L - 1)], 0.0)
+    np.testing.assert_allclose(np.asarray(out)[0], want, rtol=1e-6)
