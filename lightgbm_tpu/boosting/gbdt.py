@@ -113,19 +113,10 @@ class GBDT:
         self.params = split_params_from_config(config)
         self.meta = feature_meta_from_dataset(train_data)
         self.bins_dev = jnp.asarray(train_data.bins)
-        # the frontier/Pallas path is the TPU throughput mode; leafwise is
+        # the fused/Pallas paths are the TPU throughput modes; leafwise is
         # the exact reference-parity mode (and the CPU default)
-        from ..ops.pallas_histogram import HAS_PALLAS
         self.on_tpu = jax.default_backend() == "tpu"
-        self.use_frontier = self.on_tpu and HAS_PALLAS \
-            and config.tpu_histogram_impl in ("auto", "pallas")
-        default_policy = "depthwise" if self.use_frontier else "leafwise"
-        self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
-                                                        config.grow_policy)
-        if self.use_frontier and self.grow_policy == "depthwise":
-            self._init_frontier(train_data)
-        else:
-            self.use_frontier = False
+        self._setup_engine(config)
 
         md = train_data.metadata
         k, n = self.num_tree_per_iteration, self.num_data
@@ -175,6 +166,64 @@ class GBDT:
         if config.feature_fraction_bynode < 1.0:
             log.warning("feature_fraction_bynode is not supported yet on the "
                         "TPU learner; using per-tree feature_fraction only")
+
+    # ------------------------------------------------------------------
+    def _setup_engine(self, config: Config) -> None:
+        """Resolve tpu_engine/grow_policy into the learner flags (called by
+        init and again by reset_config so reset_parameter can switch
+        engines)."""
+        from ..ops.pallas_histogram import HAS_PALLAS
+        engine = config.tpu_engine
+        if engine == "auto":
+            engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
+        self.use_fused = engine == "fused" and HAS_PALLAS
+        self.fused_interpret = self.use_fused and not self.on_tpu
+        self.use_frontier = (engine == "frontier" and self.on_tpu
+                             and HAS_PALLAS
+                             and config.tpu_histogram_impl
+                             in ("auto", "pallas"))
+        default_policy = ("depthwise" if (self.use_fused or self.use_frontier)
+                          else "leafwise")
+        self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
+                                                        config.grow_policy)
+        if self.grow_policy != "depthwise":
+            self.use_fused = self.use_frontier = False
+        if self.use_fused and not hasattr(self, "fused_bins_T"):
+            self._init_fused(self.train_data)
+        elif self.use_frontier and not hasattr(self, "bins_i32_dev"):
+            self._init_frontier(self.train_data)
+
+    # ------------------------------------------------------------------
+    def _init_fused(self, train_data: TpuDataset) -> None:
+        """int8 transposed bin matrix + f_oh-padded metadata for the fused
+        route+histogram level kernel (ops/fused_level.py)."""
+        from ..ops.fused_level import NCH_FAST, NCH_PRECISE, feature_layout
+        F = train_data.num_features
+        F_oh, Bp = feature_layout(F, self.max_bins)
+        R = self.num_data
+        Rp = ((R + 1023) // 1024) * 1024
+        Fp = max(F_oh, 8)
+        # int8 covers bins <= 127; larger max_bin needs int16 (a uint8 bin
+        # index >= 128 would wrap negative in int8 and corrupt the one-hot)
+        dtype = np.int8 if Bp <= 128 else np.int16
+        bins_T = np.zeros((Fp, Rp), dtype)
+        bins_T[:F, :R] = np.asarray(train_data.bins).T
+        self.fused_bins_T = jnp.asarray(bins_T)
+        self.fused_f_oh = F_oh
+        self.fused_Bp = Bp
+        self.fused_Rp = Rp
+        self.fused_nch = (NCH_FAST if self.config.tpu_hist_precision == "bf16"
+                          else NCH_PRECISE)
+        nb = np.zeros(F_oh, np.int32)
+        nb[:F] = np.asarray(self.meta.num_bin)
+        mt = np.zeros(F_oh, np.int32)
+        mt[:F] = np.asarray(self.meta.missing_type)
+        db = np.zeros(F_oh, np.int32)
+        db[:F] = np.asarray(self.meta.default_bin)
+        mono = np.zeros(F_oh, np.int32)
+        mono[:F] = np.asarray(self.meta.monotone)
+        self.fused_meta = FeatureMeta(jnp.asarray(nb), jnp.asarray(mt),
+                                      jnp.asarray(db), jnp.asarray(mono))
 
     # ------------------------------------------------------------------
     def _init_frontier(self, train_data: TpuDataset) -> None:
@@ -342,6 +391,24 @@ class GBDT:
     # ------------------------------------------------------------------
     def _grow(self, gh):
         fm = self._feature_mask()
+        if self.use_fused:
+            from ..models.frontier2 import grow_tree_fused
+            from ..ops.fused_level import pack_gh
+            n = self.num_data
+            pad = self.fused_Rp - n
+            gh_T = pack_gh(jnp.pad(gh[:, 0], (0, pad)),
+                           jnp.pad(gh[:, 1], (0, pad)),
+                           jnp.pad(gh[:, 2], (0, pad)), self.fused_nch)
+            fm_pad = jnp.zeros((self.fused_f_oh,), bool) \
+                .at[:fm.shape[0]].set(fm)
+            tree, row_leaf = grow_tree_fused(
+                self.fused_bins_T, gh_T, self.fused_meta, fm_pad,
+                self.params, self.max_leaves, self.fused_Bp,
+                self.fused_f_oh, num_rows=n, nch=self.fused_nch,
+                max_depth=int(self.config.max_depth),
+                extra_levels=int(self.config.tpu_extra_levels),
+                interpret=self.fused_interpret)
+            return tree, row_leaf[:n]
         if self.use_frontier:
             from ..models.frontier import grow_tree_frontier
             Fp = self.frontier_Fp
@@ -502,7 +569,12 @@ class GBDT:
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
                 lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
-                if self.use_frontier:
+                if self.use_fused:
+                    # per-row gathers are slow on TPU; streaming lookup
+                    from ..ops.fused_level import table_lookup
+                    delta = table_lookup(row_leaf[None, :], lv_dev,
+                                         interpret=self.fused_interpret)[0]
+                elif self.use_frontier:
                     # per-row gathers are slow on TPU; use the where-chain
                     from ..models.frontier import leaf_value_lookup
                     delta = leaf_value_lookup(lv_dev, row_leaf,
@@ -556,8 +628,7 @@ class GBDT:
         self.shrinkage_rate = float(config.learning_rate)
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
-        self.grow_policy = {"auto": "leafwise"}.get(config.grow_policy,
-                                                    config.grow_policy)
+        self._setup_engine(config)
         n = self.num_data
         self.is_bagging = False
         self.balanced_bagging = False

@@ -233,6 +233,15 @@ _PARAMS: List[_Param] = [
     _p("tpu_histogram_impl", str, "auto",
        desc="auto, segment (XLA segment-sum), onehot (one-hot matmul), "
             "pallas (Pallas kernel)"),
+    _p("tpu_engine", str, "auto",
+       desc="auto, fused (fused route+histogram level kernel, fastest), "
+            "frontier (round-1 Pallas path), xla (no Pallas)"),
+    _p("tpu_hist_precision", str, "bf16x2",
+       desc="histogram input precision: bf16x2 (hi/lo split, fp32-grade, "
+            "default) or bf16 (fastest)"),
+    _p("tpu_extra_levels", int, 3, check=(">=", 0),
+       desc="extra fused-level passes after the pow2 frontier levels so "
+            "skewed trees can spend the remaining leaf budget"),
     _p("tpu_rows_per_shard_pad", int, 8,
        desc="pad row count to a multiple of this per mesh shard"),
     _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),

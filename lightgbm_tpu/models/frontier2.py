@@ -32,8 +32,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.fused_level import (NCH_PRECISE, build_route_table, default_tile_rows,
-                               hist_planes, level_pass, table_lookup)
+from ..ops.fused_level import (NCH_PRECISE, build_route_table, hist_planes,
+                               level_pass, max_slot_cap, table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_numerical_split_cm,
                          calculate_leaf_output)
 from .learner import FeatureMeta, NEG_INF, _masked_gain, _masked_scatter
@@ -44,7 +44,10 @@ def level_caps(num_leaves: int, max_depth: int, extra_levels: int,
                slot_cap: int = 128):
     """Static per-level split caps: 1, 2, 4, ... (<= slot_cap) until the
     cumulative cap covers num_leaves-1, then ``extra_levels`` passes of
-    min(64, slot_cap) for skewed growth."""
+    min(64, slot_cap) more. The extras let skewed trees — and trees whose
+    frontier outgrew slot_cap — spend the remaining leaf budget; levels
+    with nothing to split are skipped at runtime (lax.cond), so extras
+    cost compile time only."""
     caps = []
     cum = 0
     d = 0
@@ -55,25 +58,26 @@ def level_caps(num_leaves: int, max_depth: int, extra_levels: int,
         caps.append(c)
         cum += c
         d += 1
-    # extra passes let skewed trees spend leftover budget; with a positive
-    # max_depth they are capped by the depth mask at runtime but still
-    # useful whenever max_depth exceeds the pow2 level count
-    n_extra = extra_levels
-    if max_depth > 0:
-        n_extra = min(extra_levels, max(0, max_depth - len(caps)))
-    caps.extend([min(64, slot_cap, num_leaves - 1)] * n_extra)
+    caps.extend([min(64, slot_cap, num_leaves - 1)] * extra_levels)
     return tuple(caps)
+
+
+def _onehot_dot(sel: jax.Array, mat: jax.Array) -> jax.Array:
+    """sel @ mat with HIGHEST precision: sel is an exact 0/1 one-hot, so the
+    f32-emulated TPU matmul reproduces the selected rows bit-for-bit (the
+    default bf16-input MXU dot would round every pool histogram to ~8
+    mantissa bits each level and wreck the sibling subtraction)."""
+    return jax.lax.dot(sel, mat, precision=jax.lax.Precision.HIGHEST)
 
 
 def _pool_read(pool_plane: jax.Array, leaf_of_slot: jax.Array,
                Sp: int) -> jax.Array:
-    """pool[leaf_of_slot] as a one-hot f32 contraction (exact — one-hot
-    matmul in f32 reproduces the gathered rows bit-for-bit)."""
+    """pool[leaf_of_slot] as an exact one-hot f32 contraction."""
     L = pool_plane.shape[0]
     FB = pool_plane.shape[1] * pool_plane.shape[2]
     sel = (leaf_of_slot[:, None] ==
            jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.float32)
-    out = sel @ pool_plane.reshape(L, FB)
+    out = _onehot_dot(sel, pool_plane.reshape(L, FB))
     return out.reshape((Sp,) + pool_plane.shape[1:])
 
 
@@ -85,7 +89,7 @@ def _pool_write(pool_plane: jax.Array, idx: jax.Array, vals: jax.Array,
     idx_safe = jnp.where(mask, idx, -1)
     sel = (idx_safe[:, None] ==
            jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.float32)
-    upd = sel.T @ vals.reshape(vals.shape[0], F_oh * B)       # [L, FB]
+    upd = _onehot_dot(sel.T, vals.reshape(vals.shape[0], F_oh * B))  # [L,FB]
     hit = jnp.max(sel, axis=0)                                # [L] 0/1
     return (pool_plane * (1.0 - hit)[:, None, None]
             + upd.reshape(L, F_oh, B))
@@ -129,7 +133,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     Fp, Rp = bins_T.shape
     L = num_leaves
     B = max_bins
-    caps = level_caps(L, max_depth, extra_levels)
+    caps = level_caps(L, max_depth, extra_levels,
+                      slot_cap=max_slot_cap(f_oh * B, nch))
 
     R = num_rows or Rp
     # padding rows sit at leaf -1; inactive slots use leaf_of_slot = -2 so

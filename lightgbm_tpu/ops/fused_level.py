@@ -66,11 +66,28 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
-def default_tile_rows(Sp: int) -> int:
-    """Row-tile width: the [FB, C] one-hot scratch + [FB, nch*Sp] VMEM
-    accumulator must fit the ~16 MB VMEM budget, so wide slot counts halve
-    the tile."""
-    return 1024 if Sp <= 64 else 512
+VMEM_BUDGET = 9 * 1024 * 1024  # oh scratch + accumulator; leaves room
+# for W/ghs/D values and pipeline buffers under the ~16 MB VMEM
+
+
+def default_tile_rows(Sp: int, FB: int, nch: int) -> int:
+    """Row-tile width: the [FB, C] bf16 one-hot scratch (2*FB*C bytes,
+    double-buffer-free) plus the [FB, nch*Sp] f32 accumulator must fit the
+    VMEM budget."""
+    acc = FB * nch * Sp * 4
+    avail = max(VMEM_BUDGET - acc, 2 * 1024 * 1024)
+    c = avail // (2 * FB)
+    c = 1 << max(7, (int(c)).bit_length() - 1)      # floor to pow2, >= 128
+    return int(min(1024, c))
+
+
+def max_slot_cap(FB: int, nch: int, budget: int = 4 * 1024 * 1024) -> int:
+    """Largest per-level slot count whose [FB, nch*Sp] f32 accumulator fits
+    in ``budget`` bytes of VMEM (wide-bin datasets get narrower levels and
+    more of them)."""
+    cap = budget // (FB * nch * 4)
+    cap = 1 << max(3, int(cap).bit_length() - 1)
+    return int(min(128, cap))
 
 
 def feature_layout(num_features: int, max_bin: int) -> Tuple[int, int]:
@@ -247,7 +264,7 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
     B = num_bins
     FB = f_oh * B
     Sp = tbl.shape[0]
-    C = tile_rows or default_tile_rows(Sp)
+    C = tile_rows or default_tile_rows(Sp, FB, nch)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
     T = R // C
 

@@ -76,7 +76,9 @@ def _route_rows_np(tree, bins):
 def test_level_caps():
     assert level_caps(255, -1, 3) == (1, 2, 4, 8, 16, 32, 64, 128,
                                       64, 64, 64)
-    assert level_caps(31, 4, 3) == (1, 2, 4, 8)
+    # extras survive a positive max_depth: the runtime depth/gain masks
+    # skip them when nothing can split
+    assert level_caps(31, 4, 3) == (1, 2, 4, 8, 30, 30, 30)
     assert level_caps(2, -1, 0) == (1,)
 
 
