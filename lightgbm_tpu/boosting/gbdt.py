@@ -39,7 +39,8 @@ def feature_meta_from_dataset(ds: TpuDataset) -> FeatureMeta:
         num_bin=jnp.asarray(ds.num_bin_per_feat),
         missing_type=jnp.asarray(ds.missing_types),
         default_bin=jnp.asarray(default_bins),
-        monotone=jnp.asarray(mono))
+        monotone=jnp.asarray(mono),
+        is_cat=jnp.asarray(ds.is_categorical[ds.used_features]))
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -51,7 +52,12 @@ def split_params_from_config(config: Config) -> SplitParams:
         min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
         min_gain_to_split=float(config.min_gain_to_split),
         path_smooth=float(config.path_smooth),
-        monotone_penalty=float(config.monotone_penalty))
+        monotone_penalty=float(config.monotone_penalty),
+        max_cat_to_onehot=int(config.max_cat_to_onehot),
+        max_cat_threshold=int(config.max_cat_threshold),
+        cat_l2=float(config.cat_l2),
+        cat_smooth=float(config.cat_smooth),
+        min_data_per_group=int(config.min_data_per_group))
 
 
 class _DeviceTree:
@@ -59,9 +65,10 @@ class _DeviceTree:
 
     __slots__ = ("leaf_value", "split_feature", "threshold_bin",
                  "default_left", "left_child", "right_child", "max_depth",
-                 "num_leaves")
+                 "num_leaves", "cat_flag", "cat_mask")
 
-    def __init__(self, host_tree: HostTree, inner_feature: np.ndarray):
+    def __init__(self, host_tree: HostTree, inner_feature: np.ndarray,
+                 cat_flag: np.ndarray = None, cat_mask: np.ndarray = None):
         self.num_leaves = host_tree.num_leaves
         self.max_depth = (int(host_tree.leaf_depth.max())
                           if getattr(host_tree, "leaf_depth", None) is not None
@@ -74,6 +81,13 @@ class _DeviceTree:
             (host_tree.decision_type & 2).astype(bool))
         self.left_child = jnp.asarray(host_tree.left_child, jnp.int32)
         self.right_child = jnp.asarray(host_tree.right_child, jnp.int32)
+        # binned-space categorical decisions for on-device valid routing
+        if cat_flag is not None and np.any(cat_flag):
+            self.cat_flag = jnp.asarray(cat_flag.astype(bool))
+            self.cat_mask = jnp.asarray(cat_mask.astype(bool))
+        else:
+            self.cat_flag = None
+            self.cat_mask = None
 
 
 def _round_up_pow2(n: int) -> int:
@@ -94,6 +108,7 @@ class GBDT:
         self.iter = 0
         self.num_init_iteration = 0
         self.average_output = False
+        self._last_cat = None  # host cat arrays from the latest _to_host_tree
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TpuDataset, objective,
@@ -112,6 +127,8 @@ class GBDT:
         self.max_bins = int(train_data.max_num_bin)
         self.params = split_params_from_config(config)
         self.meta = feature_meta_from_dataset(train_data)
+        self.has_cat = bool(np.any(
+            train_data.is_categorical[train_data.used_features]))
         self.bins_dev = jnp.asarray(train_data.bins)
         # the fused/Pallas paths are the TPU throughput modes; leafwise is
         # the exact reference-parity mode (and the CPU default)
@@ -182,14 +199,26 @@ class GBDT:
                              and HAS_PALLAS
                              and config.tpu_histogram_impl
                              in ("auto", "pallas"))
+        if self.use_frontier and self.has_cat:
+            log.warning("tpu_engine=frontier has no categorical support; "
+                        "using the fused engine")
+            self.use_frontier = False
+            self.use_fused = True
+            self.fused_interpret = not self.on_tpu
         default_policy = ("depthwise" if (self.use_fused or self.use_frontier)
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
                                                         config.grow_policy)
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
-        if self.use_fused and not hasattr(self, "fused_bins_T"):
-            self._init_fused(self.train_data)
+        if self.use_fused:
+            if not hasattr(self, "fused_bins_T"):
+                self._init_fused(self.train_data)
+            else:
+                from ..ops.fused_level import NCH_FAST, NCH_PRECISE
+                self.fused_nch = (NCH_FAST
+                                  if config.tpu_hist_precision == "bf16"
+                                  else NCH_PRECISE)
         elif self.use_frontier and not hasattr(self, "bins_i32_dev"):
             self._init_frontier(self.train_data)
 
@@ -222,8 +251,11 @@ class GBDT:
         db[:F] = np.asarray(self.meta.default_bin)
         mono = np.zeros(F_oh, np.int32)
         mono[:F] = np.asarray(self.meta.monotone)
+        ic = np.zeros(F_oh, bool)
+        ic[:F] = np.asarray(self.meta.is_cat)
         self.fused_meta = FeatureMeta(jnp.asarray(nb), jnp.asarray(mt),
-                                      jnp.asarray(db), jnp.asarray(mono))
+                                      jnp.asarray(db), jnp.asarray(mono),
+                                      jnp.asarray(ic))
 
     # ------------------------------------------------------------------
     def _init_frontier(self, train_data: TpuDataset) -> None:
@@ -407,7 +439,7 @@ class GBDT:
                 self.fused_f_oh, num_rows=n, nch=self.fused_nch,
                 max_depth=int(self.config.max_depth),
                 extra_levels=int(self.config.tpu_extra_levels),
-                interpret=self.fused_interpret)
+                has_cat=self.has_cat, interpret=self.fused_interpret)
             return tree, row_leaf[:n]
         if self.use_frontier:
             from ..models.frontier import grow_tree_frontier
@@ -423,11 +455,11 @@ class GBDT:
                 self.bins_dev, gh, self.meta, fm, self.params,
                 self.max_leaves, self.max_bins,
                 int(self.config.max_depth),
-                hist_impl=self._xla_hist_impl())
+                hist_impl=self._xla_hist_impl(), has_cat=self.has_cat)
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
-            hist_impl=self._xla_hist_impl())
+            hist_impl=self._xla_hist_impl(), has_cat=self.has_cat)
 
     def _xla_hist_impl(self) -> str:
         impl = self.config.tpu_histogram_impl
@@ -465,16 +497,40 @@ class GBDT:
         ht.split_feature = np.array(
             [ds.real_feature_index(int(f)) if f >= 0 else 0
              for f in sf_inner], np.int32)
+        cat_flag = np.asarray(tree.cat_flag)[:ni]
+        cat_mask = np.asarray(tree.cat_mask)[:ni]
         thr = np.zeros(ni, np.float64)
         dt = np.zeros(ni, np.int32)
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
         for i in range(ni):
             f = int(sf_inner[i])
             if f < 0:
                 continue
             m = ds.mappers[ds.real_feature_index(f)]
-            thr[i] = m.bin_to_value(int(tb[i]))
-            dt[i] = HostTree.make_decision_type(
-                False, bool(dl[i]), int(m.missing_type))
+            if bool(cat_flag[i]):
+                # bin-space left set -> category-value bitset
+                # (ref: tree.cpp Tree::SplitCategorical cat_boundaries_)
+                cats = [int(m.bin_2_categorical[b])
+                        for b in np.nonzero(cat_mask[i])[0]
+                        if b < len(m.bin_2_categorical)
+                        and m.bin_2_categorical[b] >= 0]
+                n_words = (max(cats) // 32 + 1) if cats else 1
+                words = [0] * n_words
+                for c in cats:
+                    words[c // 32] |= (1 << (c % 32))
+                thr[i] = len(cat_boundaries) - 1  # index into boundaries
+                cat_threshold.extend(words)
+                cat_boundaries.append(len(cat_threshold))
+                dt[i] = HostTree.make_decision_type(
+                    True, False, int(m.missing_type))
+            else:
+                thr[i] = m.bin_to_value(int(tb[i]))
+                dt[i] = HostTree.make_decision_type(
+                    False, bool(dl[i]), int(m.missing_type))
+        if len(cat_boundaries) > 1:
+            ht.cat_boundaries = cat_boundaries
+            ht.cat_threshold = cat_threshold
         ht.threshold = thr
         ht.threshold_bin = tb.astype(np.int32)
         ht.decision_type = dt
@@ -491,6 +547,7 @@ class GBDT:
         ht.leaf_weight = np.asarray(tree.leaf_weight)[:nl].astype(np.float64)
         ht.leaf_count = np.asarray(tree.leaf_count)[:nl].astype(np.int64)
         ht.leaf_depth = np.asarray(tree.leaf_depth)[:nl].astype(np.int32)
+        self._last_cat = (cat_flag, cat_mask) if self.has_cat else None
         return ht, sf_inner
 
     # ------------------------------------------------------------------
@@ -524,7 +581,7 @@ class GBDT:
             score[tree_id], bins_dev, lv, dt.split_feature, dt.threshold_bin,
             dt.default_left, dt.left_child, dt.right_child,
             self.meta.num_bin, self.meta.missing_type, self.meta.default_bin,
-            max_steps=steps)
+            max_steps=steps, cat_flag=dt.cat_flag, cat_mask=dt.cat_mask)
         return score.at[tree_id].set(new_row)
 
     # ------------------------------------------------------------------
@@ -582,7 +639,8 @@ class GBDT:
                 else:
                     delta = lv_dev[row_leaf]
                 self.scores = self.scores.at[tid].add(delta)
-                dt = _DeviceTree(ht, sf_inner)
+                cf, cm = self._last_cat or (None, None)
+                dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
                 for vi in range(len(self.valid_scores)):
                     self.valid_scores[vi] = self._add_tree_to_score(
                         self.valid_scores[vi], self.valid_bins[vi], dt, tid)
@@ -970,7 +1028,8 @@ class RF(GBDT):
                 lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
                 # scores accumulate the SUM; prediction averages
                 self.scores = self.scores.at[tid].add(lv_dev[row_leaf])
-                dt = _DeviceTree(ht, sf_inner)
+                cf, cm = self._last_cat or (None, None)
+                dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
                 for vi in range(len(self.valid_scores)):
                     self.valid_scores[vi] = self._add_tree_to_score(
                         self.valid_scores[vi], self.valid_bins[vi], dt, tid)

@@ -144,13 +144,6 @@ class TpuDataset:
             return self
 
         cat_set = set(int(c) for c in categorical_feature)
-        if cat_set:
-            # the categorical split finder (sorted-subset search) is not wired
-            # into the learner yet; fail loudly rather than silently treating
-            # count-ordered category bins as ordered numerical thresholds
-            log.fatal("categorical_feature is not supported yet by the TPU "
-                      "learner; it is on the roadmap (one-hot + sorted-subset "
-                      "splits)")
         sample_idx = _sample_rows(n, config.bin_construct_sample_cnt,
                                   config.data_random_seed)
         sample = np.asarray(data[sample_idx], dtype=np.float64)

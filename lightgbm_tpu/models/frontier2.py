@@ -34,9 +34,10 @@ import jax.numpy as jnp
 
 from ..ops.fused_level import (NCH_PRECISE, build_route_table, hist_planes,
                                level_pass, max_slot_cap, table_lookup)
-from ..ops.split import (BestSplit, SplitParams, best_numerical_split_cm,
+from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output)
-from .learner import FeatureMeta, NEG_INF, _masked_gain, _masked_scatter
+from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
+                      meta_is_cat)
 from .tree import TreeArrays, empty_tree
 
 
@@ -104,13 +105,14 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "f_oh", "num_rows",
-                     "nch", "max_depth", "extra_levels", "interpret"))
+                     "nch", "max_depth", "extra_levels", "has_cat",
+                     "interpret"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
                     num_rows: int = 0, nch: int = NCH_PRECISE,
                     max_depth: int = -1, extra_levels: int = 3,
-                    interpret: bool = False,
+                    has_cat: bool = False, interpret: bool = False,
                     ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with fused level passes.
 
@@ -171,10 +173,10 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         leaf_count=tree.leaf_count.at[0].set(root_c),
         leaf_weight=tree.leaf_weight.at[0].set(root_h))
 
-    root_best = best_numerical_split_cm(
+    root_best = best_split_cm(
         g0[:1], h0[:1], c0[:1], meta.num_bin, meta.missing_type,
-        meta.default_bin, feature_mask, meta.monotone, params,
-        tree.leaf_value[:1])
+        meta.default_bin, feature_mask, meta_is_cat(meta), meta.monotone,
+        params, tree.leaf_value[:1], has_cat=has_cat)
     best = BestSplit(*[jnp.zeros((L,) + a.shape[1:], a.dtype).at[0].set(a[0])
                        for a in root_best])
     best = best._replace(gain=best.gain.at[1:].set(NEG_INF))
@@ -185,13 +187,14 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil)
     for S_d in caps:
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
-                           L, B, f_oh, S_d, nch, max_depth, interpret)
+                           L, B, f_oh, S_d, nch, max_depth, has_cat,
+                           interpret)
     tree, leaf_T = state[0], state[1]
     return tree, leaf_T[0]
 
 
 def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
-               S_d, nch, max_depth, interpret):
+               S_d, nch, max_depth, has_cat, interpret):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil) = state
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
@@ -224,6 +227,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         feat_s = jnp.where(lof_on, best.feature[lof_safe], -1)
         thr_s = best.threshold[lof_safe]
         dl_s = best.default_left[lof_safe]
+        cf_s = best.cat_flag[lof_safe] & lof_on
+        cm_s = best.cat_mask[lof_safe]
         small_left_s = (best.left_count[lof_safe]
                         <= best.right_count[lof_safe])
         new_s = jnp.where(lof_on, tree.num_leaves + jnp.arange(Sp), 0)
@@ -231,7 +236,9 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
 
         W = build_route_table(feat_s, thr_s, dl_s, meta.num_bin,
                               meta.missing_type, meta.default_bin,
-                              Sp, f_oh, B)
+                              Sp, f_oh, B,
+                              cat_flag=cf_s if has_cat else None,
+                              cat_mask=cm_s if has_cat else None)
         tbl = jnp.zeros((Sp, 128), jnp.int32)
         tbl = tbl.at[:, 0].set(lof)
         tbl = tbl.at[:, 1].set(delta_s)
@@ -273,6 +280,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         sf = w(tree.split_feature, f_l)
         tb = w(tree.threshold_bin, best.threshold)
         dfl = w(tree.default_left, best.default_left)
+        cfw = w(tree.cat_flag, best.cat_flag)
+        cmw = w(tree.cat_mask, best.cat_mask)
         sg = w(tree.split_gain, best.gain)
         iv = w(tree.internal_value, tree.leaf_value)
         ic = w(tree.internal_count, tree.leaf_count)
@@ -295,6 +304,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         tree2 = tree._replace(
             num_leaves=tree.num_leaves + n_sel,
             split_feature=sf, threshold_bin=tb, default_left=dfl,
+            cat_flag=cfw, cat_mask=cmw,
             split_gain=sg, internal_value=iv, internal_count=ic,
             internal_weight=iw, left_child=lc, right_child=rc,
             leaf_value=upd2(tree.leaf_value, best.left_output,
@@ -315,10 +325,11 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         ch_g = jnp.concatenate([left_g, right_g], axis=0)
         ch_h = jnp.concatenate([left_h, right_h], axis=0)
         ch_c = jnp.concatenate([left_c, right_c], axis=0)
-        bs = best_numerical_split_cm(
+        bs = best_split_cm(
             ch_g, ch_h, ch_c, meta.num_bin, meta.missing_type,
-            meta.default_bin, feature_mask, meta.monotone, params,
-            jnp.concatenate([left_out, right_out]))
+            meta.default_bin, feature_mask, meta_is_cat(meta), meta.monotone,
+            params, jnp.concatenate([left_out, right_out]),
+            has_cat=has_cat)
         left_bs = BestSplit(*[a[:Sp] for a in bs])
         right_bs = BestSplit(*[a[Sp:] for a in bs])
         best2 = _merge_best_many(best, lof_safe, left_bs, lof_on)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.histogram import build_histograms
 from ..ops.split import (BestSplit, SplitParams, best_numerical_split,
-                         calculate_leaf_output)
+                         best_split_cm, calculate_leaf_output)
 from .tree import TreeArrays, empty_tree
 
 NEG_INF = -jnp.inf
@@ -40,6 +40,25 @@ class FeatureMeta(NamedTuple):
     missing_type: jax.Array   # int32 [F]
     default_bin: jax.Array    # int32 [F]
     monotone: jax.Array       # int32 [F]
+    is_cat: jax.Array = None  # bool  [F] (None = all numerical)
+
+
+def meta_is_cat(meta: "FeatureMeta") -> jax.Array:
+    if meta.is_cat is None:
+        return jnp.zeros(meta.num_bin.shape, bool)
+    return meta.is_cat
+
+
+def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
+               params: SplitParams, parent_output: jax.Array,
+               has_cat: bool = False) -> BestSplit:
+    """Channel-minor convenience wrapper over the combined numerical +
+    categorical scan (ref: feature_histogram.hpp:85 FindBestThreshold)."""
+    return best_split_cm(
+        hist[..., 0], hist[..., 1], hist[..., 2], meta.num_bin,
+        meta.missing_type, meta.default_bin, feature_mask,
+        meta_is_cat(meta), meta.monotone, params, parent_output,
+        has_cat=has_cat)
 
 
 def _route_left(bins_col: jax.Array, t: jax.Array, default_left: jax.Array,
@@ -83,11 +102,12 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl", "psum_axis"))
+                     "hist_impl", "psum_axis", "has_cat"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
                        hist_impl: str = "auto", psum_axis: str = None,
+                       has_cat: bool = False,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -125,9 +145,9 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         leaf_count=tree.leaf_count.at[0].set(root_c),
         leaf_weight=tree.leaf_weight.at[0].set(root_h))
 
-    root_best = best_numerical_split(
-        pool[:1], meta.num_bin, meta.missing_type, meta.default_bin,
-        feature_mask, meta.monotone, params, tree.leaf_value[:1])
+    root_best = best_split(
+        pool[:1], meta, feature_mask, params, tree.leaf_value[:1],
+        has_cat=has_cat)
     best = BestSplit(*[jnp.zeros((L,) + a.shape[1:], a.dtype).at[0].set(a[0])
                        for a in root_best])
     best = best._replace(gain=best.gain.at[1:].set(NEG_INF))
@@ -150,6 +170,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             f = best.feature[l]
             t = best.threshold[l]
             dl = best.default_left[l]
+            cf = best.cat_flag[l]
+            cm = best.cat_mask[l]
 
             # --- node bookkeeping (ref: tree.h:62 Tree::Split) ---
             write_left = (lpn[l] >= 0) & lil[l]
@@ -167,6 +189,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 split_feature=tree.split_feature.at[i].set(f),
                 threshold_bin=tree.threshold_bin.at[i].set(t),
                 default_left=tree.default_left.at[i].set(dl),
+                cat_flag=tree.cat_flag.at[i].set(cf),
+                cat_mask=tree.cat_mask.at[i].set(cm),
                 left_child=lc, right_child=rc,
                 split_gain=tree.split_gain.at[i].set(best.gain[l]),
                 internal_value=tree.internal_value.at[i].set(tree.leaf_value[l]),
@@ -189,6 +213,10 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             bins_col = jnp.take(bins, f, axis=1, mode="clip")
             go_left = _route_left(bins_col, t, dl, meta.num_bin[f],
                                   meta.missing_type[f], meta.default_bin[f])
+            if has_cat:
+                cat_left = jnp.take(cm, bins_col.astype(jnp.int32),
+                                    mode="clip")
+                go_left = jnp.where(cf, cat_left, go_left)
             on_leaf = row_leaf == l
             row_leaf2 = jnp.where(on_leaf & ~go_left, new, row_leaf)
 
@@ -207,9 +235,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             child_hist = jnp.stack([pool2[l], pool2[new]])
             parent_out2 = jnp.stack([tree2.leaf_value[l],
                                      tree2.leaf_value[new]])
-            bs2 = best_numerical_split(
-                child_hist, meta.num_bin, meta.missing_type, meta.default_bin,
-                feature_mask, meta.monotone, params, parent_out2)
+            bs2 = best_split(child_hist, meta, feature_mask, params,
+                             parent_out2, has_cat=has_cat)
             best2 = _merge_best(best, l, new, bs2)
             return tree2, row_leaf2, pool2, best2, lpn2, lil2
 
@@ -225,11 +252,12 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl", "psum_axis"))
+                     "hist_impl", "psum_axis", "has_cat"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
                         hist_impl: str = "segment", psum_axis: str = None,
+                        has_cat: bool = False,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -270,9 +298,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     num_nodes = jnp.int32(0)
 
     def all_best(pool, tree):
-        return best_numerical_split(
-            pool, meta.num_bin, meta.missing_type, meta.default_bin,
-            feature_mask, meta.monotone, params, tree.leaf_value)
+        return best_split(pool, meta, feature_mask, params, tree.leaf_value,
+                          has_cat=has_cat)
 
     best = all_best(pool, tree)
     best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
@@ -304,6 +331,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             f_l = best.feature
             t_l = best.threshold
             dl_l = best.default_left
+            cf_l = best.cat_flag
+            cm_l = best.cat_mask
             new_depth = tree.leaf_depth + 1
 
             def scatter_nodes(tree, lpn, lil):
@@ -313,6 +342,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 sf = w(tree.split_feature, f_l)
                 tb = w(tree.threshold_bin, t_l)
                 dfl = w(tree.default_left, dl_l)
+                cfw = w(tree.cat_flag, cf_l)
+                cmw = w(tree.cat_mask, cm_l)
                 sg = w(tree.split_gain, best.gain)
                 iv = w(tree.internal_value, tree.leaf_value)
                 ic = w(tree.internal_count, tree.leaf_count)
@@ -332,6 +363,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                                        jnp.zeros((L,), bool), selected)
                 tree2 = tree._replace(
                     split_feature=sf, threshold_bin=tb, default_left=dfl,
+                    cat_flag=cfw, cat_mask=cmw,
                     split_gain=sg, internal_value=iv, internal_count=ic,
                     internal_weight=iw, left_child=lc, right_child=rc)
                 return tree2, lpn2, lil2
@@ -348,6 +380,9 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                                   meta.num_bin[f_row],
                                   meta.missing_type[f_row],
                                   meta.default_bin[f_row])
+            if has_cat:
+                cat_left = cm_l[l_row, bins_row.astype(jnp.int32)]
+                go_left = jnp.where(cf_l[l_row], cat_left, go_left)
             row_leaf2 = jnp.where(sel_row & ~go_left, new_of_leaf[l_row],
                                   row_leaf)
 

@@ -25,12 +25,16 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
                          threshold_bin: jax.Array, default_left: jax.Array,
                          left_child: jax.Array, right_child: jax.Array,
                          num_bin: jax.Array, missing_type: jax.Array,
-                         default_bin: jax.Array, max_steps: int) -> jax.Array:
+                         default_bin: jax.Array, max_steps: int,
+                         cat_flag: jax.Array = None,
+                         cat_mask: jax.Array = None) -> jax.Array:
     """Leaf index per row for one tree (arrays follow the TreeArrays
     convention: child >= 0 internal node, child < 0 means ~leaf).
 
     ``max_steps`` must be >= tree depth.  Single-leaf trees (no node 0)
     are handled by the caller (leaf 0 for every row).
+    ``cat_flag``/``cat_mask`` ([N], [N, B]) enable categorical bitset
+    decisions (ref: tree.h CategoricalDecision on bin space).
     """
     R = bins.shape[0]
     node = jnp.zeros((R,), jnp.int32)
@@ -43,6 +47,9 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
                                 axis=1)[:, 0].astype(jnp.int32)
         go_left = _route_left(b, threshold_bin[nd], default_left[nd],
                               num_bin[f], missing_type[f], default_bin[f])
+        if cat_flag is not None:
+            cat_left = cat_mask[nd, b]
+            go_left = jnp.where(cat_flag[nd], cat_left, go_left)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         return jnp.where(is_internal, nxt, node)
 
@@ -56,10 +63,11 @@ def add_tree_score(score: jax.Array, bins: jax.Array, leaf_value: jax.Array,
                    default_left: jax.Array, left_child: jax.Array,
                    right_child: jax.Array, num_bin: jax.Array,
                    missing_type: jax.Array, default_bin: jax.Array,
-                   max_steps: int) -> jax.Array:
+                   max_steps: int, cat_flag: jax.Array = None,
+                   cat_mask: jax.Array = None) -> jax.Array:
     """score += leaf_value[route(row)] in one fused pass."""
     leaves = route_rows_to_leaves(bins, split_feature, threshold_bin,
                                   default_left, left_child, right_child,
                                   num_bin, missing_type, default_bin,
-                                  max_steps)
+                                  max_steps, cat_flag, cat_mask)
     return score + leaf_value[leaves]

@@ -32,6 +32,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 K_EPSILON = 1e-15
 K_MIN_SCORE = -jnp.inf
@@ -52,6 +53,12 @@ class SplitParams(NamedTuple):
     min_gain_to_split: float = 0.0
     path_smooth: float = 0.0
     monotone_penalty: float = 0.0
+    # categorical split search (ref: config.h cat_l2/cat_smooth/...)
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: int = 100
 
 
 def threshold_l1(s, l1):
@@ -61,10 +68,12 @@ def threshold_l1(s, l1):
 
 
 def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams,
-                          num_data=None, parent_output=0.0):
+                          num_data=None, parent_output=0.0, l2=None):
     """Closed-form Newton leaf value
-    (ref: feature_histogram.hpp:742 CalculateSplittedLeafOutput)."""
-    ret = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + p.lambda_l2)
+    (ref: feature_histogram.hpp:742 CalculateSplittedLeafOutput).
+    ``l2`` overrides p.lambda_l2 (categorical splits add cat_l2)."""
+    ret = -threshold_l1(sum_grad, p.lambda_l1) / (
+        sum_hess + (p.lambda_l2 if l2 is None else l2))
     if p.max_delta_step > 0:
         ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
     if p.path_smooth > 0 and num_data is not None:
@@ -73,20 +82,24 @@ def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams,
     return ret
 
 
-def leaf_gain_given_output(sum_grad, sum_hess, p: SplitParams, output):
+def leaf_gain_given_output(sum_grad, sum_hess, p: SplitParams, output,
+                           l2=None):
     # ref: feature_histogram.hpp:846 GetLeafGainGivenOutput
     sg = threshold_l1(sum_grad, p.lambda_l1)
-    return -(2.0 * sg * output + (sum_hess + p.lambda_l2) * output * output)
+    return -(2.0 * sg * output
+             + (sum_hess + (p.lambda_l2 if l2 is None else l2))
+             * output * output)
 
 
 def leaf_gain(sum_grad, sum_hess, p: SplitParams, num_data=None,
-              parent_output=0.0):
+              parent_output=0.0, l2=None):
     # ref: feature_histogram.hpp:828 GetLeafGain
     if p.max_delta_step <= 0 and p.path_smooth <= 0:
         sg = threshold_l1(sum_grad, p.lambda_l1)
-        return (sg * sg) / (sum_hess + p.lambda_l2)
-    out = calculate_leaf_output(sum_grad, sum_hess, p, num_data, parent_output)
-    return leaf_gain_given_output(sum_grad, sum_hess, p, out)
+        return (sg * sg) / (sum_hess + (p.lambda_l2 if l2 is None else l2))
+    out = calculate_leaf_output(sum_grad, sum_hess, p, num_data,
+                                parent_output, l2)
+    return leaf_gain_given_output(sum_grad, sum_hess, p, out, l2)
 
 
 class BestSplit(NamedTuple):
@@ -104,6 +117,12 @@ class BestSplit(NamedTuple):
     right_sum_grad: jax.Array
     right_sum_hess: jax.Array
     right_count: jax.Array
+    cat_flag: jax.Array       # bool [S] categorical split?
+    cat_mask: jax.Array       # bool [S, B] bins routed left (cat only)
+
+
+def _no_cat(S: int, B: int):
+    return (jnp.zeros((S,), bool), jnp.zeros((S, B), bool))
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -266,6 +285,7 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
     left_out = calculate_leaf_output(lg, lh, p, lc, parent_output)
     right_out = calculate_leaf_output(rg, rh, p, rc, parent_output)
     out_gain = jnp.where(valid, gain - min_gain_shift[:, 0, 0], K_MIN_SCORE)
+    no_flag, no_mask = _no_cat(S, B)
     return BestSplit(
         feature=jnp.where(valid, f_best.astype(jnp.int32), -1),
         threshold=take(t_best),
@@ -275,4 +295,232 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
         right_output=right_out,
         left_sum_grad=lg, left_sum_hess=lh - K_EPSILON, left_count=lc,
         right_sum_grad=rg, right_sum_hess=rh - K_EPSILON, right_count=rc,
+        cat_flag=no_flag,
+        cat_mask=no_mask,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
+                              cnt: jax.Array, num_bin_per_feat: jax.Array,
+                              cat_feature_mask: jax.Array,
+                              params: SplitParams,
+                              parent_output: jax.Array) -> BestSplit:
+    """Best categorical split per slot (ref: feature_histogram.hpp:278-470
+    FindBestThresholdCategoricalInner).
+
+    Two modes, per the reference:
+    - one-vs-rest when ``num_bin <= max_cat_to_onehot`` (plain lambda_l2);
+    - otherwise: bins with count >= cat_smooth sorted by
+      grad/(hess+cat_smooth), prefix scans from both ends up to
+      ``min(max_cat_threshold, (used+1)//2)`` categories, gains with
+      lambda_l2 + cat_l2 and min_data_per_group batching.
+
+    Divergence from the reference, deliberate: the reference estimates bin
+    counts as ``hess * num_data / sum_hess`` because its categorical
+    histograms carry no count channel; ours do, so real counts are used.
+
+    Bin 0 is the NaN/other catch-all (binning.py) and is never a member of
+    the left set — matching the reference's ``bin_start = 1`` scan and the
+    predict-side convention that unseen categories go right.
+
+    Args:
+      grad/hess/cnt: [S, F, B] float32 histogram planes.
+      num_bin_per_feat: [F] int32.
+      cat_feature_mask: [F] bool — True for categorical features that may
+        be used (feature sampling already folded in).
+      parent_output: [S] f32.
+
+    Returns a BestSplit whose winners are categorical (cat_flag True,
+    cat_mask = left-bin set, default_left False, threshold 0).
+    """
+    S, F, B = grad.shape
+    p = params
+    l2_cat = p.lambda_l2 + p.cat_l2
+    eps = K_EPSILON
+
+    b_iota = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+    nb = num_bin_per_feat[None, :, None]
+    in_range = (b_iota >= 1) & (b_iota < nb)          # bin 0 = NaN/other
+
+    tot_g = jnp.sum(grad, axis=2)                     # [S, F]
+    tot_h = jnp.sum(hess, axis=2) + 2.0 * eps
+    tot_c = jnp.sum(cnt, axis=2)
+    parent_out = parent_output[:, None]
+
+    gain_shift = leaf_gain(tot_g, tot_h, p, tot_c, parent_out)
+    min_gain_shift = gain_shift + p.min_gain_to_split  # [S, F]
+
+    # ---------------- one-vs-rest (ref :318-374)
+    lg1 = grad
+    lh1 = hess + eps
+    lc1 = cnt
+    rg1 = tot_g[..., None] - lg1
+    rh1 = tot_h[..., None] - lh1 - eps
+    rc1 = tot_c[..., None] - lc1
+    ok1 = (in_range
+           & (lc1 >= p.min_data_in_leaf) & (lh1 >= p.min_sum_hessian_in_leaf)
+           & (rc1 >= p.min_data_in_leaf) & (rh1 >= p.min_sum_hessian_in_leaf))
+    gains1 = (leaf_gain(lg1, lh1, p, lc1, parent_out[..., None])
+              + leaf_gain(rg1, rh1, p, rc1, parent_out[..., None]))
+    gains1 = jnp.where(ok1 & (gains1 > min_gain_shift[..., None]), gains1,
+                       K_MIN_SCORE)
+    t1 = jnp.argmax(gains1, axis=2)                   # [S, F]
+    g1 = jnp.take_along_axis(gains1, t1[..., None], axis=2)[..., 0]
+    onehot_allowed = (num_bin_per_feat <= p.max_cat_to_onehot)[None, :]
+    g1 = jnp.where(onehot_allowed, g1, K_MIN_SCORE)
+
+    # ---------------- sorted-subset (ref :376-473)
+    ok_bin = in_range & (cnt >= p.cat_smooth)
+    ratio = jnp.where(ok_bin, grad / (hess + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=2, stable=True)   # filtered bins last
+    sg = jnp.take_along_axis(grad, order, axis=2)
+    sh = jnp.take_along_axis(hess, order, axis=2)
+    sc = jnp.take_along_axis(cnt, order, axis=2)
+    used = jnp.sum(ok_bin.astype(jnp.int32), axis=2)  # [S, F]
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used + 1) // 2)
+
+    def scan_dir(seq_g, seq_h, seq_c):
+        """Prefix scan over the sorted sequence; returns per-position gains
+        [S, F, B] (K_MIN_SCORE where not a candidate)."""
+        def step(carry, xs):
+            sum_g, sum_h, sum_c, grp = carry
+            tg, th, tc, i = xs
+            live = (i < used) & (i < max_num_cat)
+            sum_g = sum_g + jnp.where(live, tg, 0.0)
+            sum_h = sum_h + jnp.where(live, th, 0.0)
+            sum_c = sum_c + jnp.where(live, tc, 0.0)
+            grp = grp + jnp.where(live, tc, 0.0)
+            rc = tot_c - sum_c
+            rh = tot_h - sum_h - eps
+            ok = (live
+                  & (sum_c >= p.min_data_in_leaf)
+                  & (sum_h + eps >= p.min_sum_hessian_in_leaf)
+                  & (rc >= p.min_data_in_leaf)
+                  & (rc >= p.min_data_per_group)
+                  & (rh >= p.min_sum_hessian_in_leaf)
+                  & (grp >= p.min_data_per_group))
+            rg = tot_g - sum_g
+            gain = (leaf_gain(sum_g, sum_h + eps, p, sum_c, parent_out,
+                              l2_cat)
+                    + leaf_gain(rg, rh, p, rc, parent_out, l2_cat))
+            gain = jnp.where(ok & (gain > min_gain_shift), gain, K_MIN_SCORE)
+            grp = jnp.where(ok, 0.0, grp)
+            return (sum_g, sum_h, sum_c, grp), gain
+
+        init = (jnp.zeros((S, F)), jnp.zeros((S, F)), jnp.zeros((S, F)),
+                jnp.zeros((S, F)))
+        xs = (jnp.moveaxis(seq_g, 2, 0), jnp.moveaxis(seq_h, 2, 0),
+              jnp.moveaxis(seq_c, 2, 0),
+              jnp.arange(B, dtype=jnp.int32))
+        _, gains = jax.lax.scan(step, init, xs)
+        return jnp.moveaxis(gains, 0, 2)              # [S, F, B]
+
+    gains_fwd = scan_dir(sg, sh, sc)
+    # reverse: walk the valid region from its end (position used-1-i)
+    rev_idx = jnp.clip(used[..., None] - 1 - jnp.arange(B)[None, None, :],
+                       0, B - 1)
+    gains_rev = scan_dir(jnp.take_along_axis(sg, rev_idx, axis=2),
+                         jnp.take_along_axis(sh, rev_idx, axis=2),
+                         jnp.take_along_axis(sc, rev_idx, axis=2))
+
+    i_fwd = jnp.argmax(gains_fwd, axis=2)
+    g_fwd = jnp.take_along_axis(gains_fwd, i_fwd[..., None], axis=2)[..., 0]
+    i_rev = jnp.argmax(gains_rev, axis=2)
+    g_rev = jnp.take_along_axis(gains_rev, i_rev[..., None], axis=2)[..., 0]
+
+    # ---------------- combine modes per feature, then across features
+    # (onehot vs sorted are exclusive per feature; fwd beats rev on ties —
+    # the reference scans fwd first and replaces only on strictly greater)
+    use_rev = g_rev > g_fwd
+    g_sorted = jnp.where(use_rev, g_rev, g_fwd)
+    g_feat = jnp.where(onehot_allowed, g1, g_sorted)   # [S, F]
+    g_feat = jnp.where(cat_feature_mask[None, :], g_feat, K_MIN_SCORE)
+    f_best = jnp.argmax(g_feat, axis=1)                # [S]
+    take = lambda a: jnp.take_along_axis(a, f_best[:, None], axis=1)[:, 0]
+    gain = take(g_feat)
+    valid = jnp.isfinite(gain)
+
+    is_onehot = take(onehot_allowed.astype(jnp.int32) *
+                     jnp.ones((S, F), jnp.int32)) > 0
+    tb = take(t1)                                      # [S] onehot bin
+    ifw = take(i_fwd)
+    irv = take(i_rev)
+    urev = take(use_rev.astype(jnp.int32)) > 0
+    usedb = take(used)
+
+    # left-set membership mask over bins [S, B]
+    rank = jnp.zeros((S, F, B), jnp.int32)
+    rank = jnp.put_along_axis(
+        rank, order, jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32),
+                                      (S, F, B)), axis=2,
+        inplace=False)
+    rank_b = jnp.take_along_axis(
+        rank, f_best[:, None, None].repeat(B, 2), axis=1)[:, 0, :]  # [S, B]
+    okb_b = jnp.take_along_axis(
+        ok_bin, f_best[:, None, None].repeat(B, 2), axis=1)[:, 0, :]
+    mask_fwd = okb_b & (rank_b <= ifw[:, None])
+    mask_rev = okb_b & (rank_b >= (usedb - 1 - irv)[:, None])
+    mask_sorted = jnp.where(urev[:, None], mask_rev, mask_fwd)
+    mask_onehot = jnp.arange(B)[None, :] == tb[:, None]
+    cat_mask = jnp.where(is_onehot[:, None], mask_onehot, mask_sorted)
+    cat_mask = cat_mask & valid[:, None]
+
+    # left-side stats of the winner
+    gb = jnp.take_along_axis(
+        grad, f_best[:, None, None].repeat(B, 2), axis=1)[:, 0, :]
+    hb = jnp.take_along_axis(
+        hess, f_best[:, None, None].repeat(B, 2), axis=1)[:, 0, :]
+    cb = jnp.take_along_axis(
+        cnt, f_best[:, None, None].repeat(B, 2), axis=1)[:, 0, :]
+    lg = jnp.sum(jnp.where(cat_mask, gb, 0.0), axis=1)
+    lh = jnp.sum(jnp.where(cat_mask, hb, 0.0), axis=1) + eps
+    lc = jnp.sum(jnp.where(cat_mask, cb, 0.0), axis=1)
+    tg = take(tot_g)
+    th = take(tot_h)
+    tc = take(tot_c)
+    rg = tg - lg
+    rh = th - lh - eps
+    rc = tc - lc
+
+    l2_out = jnp.where(is_onehot, p.lambda_l2, l2_cat)
+    left_out = calculate_leaf_output(lg, lh, p, lc, parent_output, l2_out)
+    right_out = calculate_leaf_output(rg, rh, p, rc, parent_output, l2_out)
+    out_gain = jnp.where(valid, gain - take(min_gain_shift), K_MIN_SCORE)
+    return BestSplit(
+        feature=jnp.where(valid, f_best.astype(jnp.int32), -1),
+        threshold=jnp.zeros((S,), jnp.int32),
+        default_left=jnp.zeros((S,), bool),
+        gain=out_gain,
+        left_output=left_out,
+        right_output=right_out,
+        left_sum_grad=lg, left_sum_hess=lh - eps, left_count=lc,
+        right_sum_grad=rg, right_sum_hess=rh, right_count=rc,
+        cat_flag=valid,
+        cat_mask=cat_mask,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params", "has_cat"))
+def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
+                  num_bin_per_feat: jax.Array, missing_type: jax.Array,
+                  default_bin: jax.Array, feature_mask: jax.Array,
+                  is_cat: jax.Array, monotone: jax.Array,
+                  params: SplitParams, parent_output: jax.Array,
+                  has_cat: bool = False) -> BestSplit:
+    """Combined numerical + categorical best split per slot (the analog of
+    FeatureHistogram::FindBestThreshold dispatch on bin_type,
+    ref: feature_histogram.hpp:85). ``has_cat`` is static: all-numerical
+    datasets skip the categorical scan entirely at trace time."""
+    num = best_numerical_split_cm(
+        grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
+        feature_mask & ~is_cat, monotone, params, parent_output)
+    if not has_cat:
+        return num
+    cat = best_categorical_split_cm(
+        grad, hess, cnt, num_bin_per_feat, feature_mask & is_cat, params,
+        parent_output)
+    use_cat = cat.gain > num.gain
+    merged = [jnp.where(use_cat if a.ndim == 1 else use_cat[:, None], a, b)
+              for a, b in zip(cat, num)]
+    return BestSplit(*merged)

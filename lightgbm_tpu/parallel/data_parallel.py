@@ -29,15 +29,19 @@ from .mesh import DATA_AXIS
 def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                          max_bins: int, max_depth: int = -1,
                          policy: str = "leafwise", hist_impl: str = "auto",
-                         axis_name: str = DATA_AXIS):
+                         axis_name: str = DATA_AXIS,
+                         has_cat: bool = False):
     """shard_map-wrapped tree growth: bins/gh row-sharded in, replicated tree
-    + row-sharded leaf assignment out."""
+    + row-sharded leaf assignment out. ``has_cat`` enables the categorical
+    split scan (pass True whenever the dataset has categorical features —
+    without it category bins would be scanned as ordered numeric
+    thresholds)."""
     grow = grow_tree_leafwise if policy == "leafwise" else grow_tree_depthwise
 
     def per_shard(bins, gh, meta, feature_mask):
         return grow(bins, gh, meta, feature_mask, params, num_leaves,
                     max_bins, max_depth, hist_impl=hist_impl,
-                    psum_axis=axis_name)
+                    psum_axis=axis_name, has_cat=has_cat)
 
     sharded = shard_map(
         per_shard, mesh=mesh,
@@ -51,18 +55,19 @@ def grow_tree_data_parallel(mesh: Mesh, bins, gh, meta: FeatureMeta,
                             feature_mask, params: SplitParams,
                             num_leaves: int, max_bins: int,
                             max_depth: int = -1, policy: str = "leafwise",
-                            hist_impl: str = "auto",
+                            hist_impl: str = "auto", has_cat: bool = False,
                             ) -> Tuple[TreeArrays, jax.Array]:
     """One-shot helper (the GBDT driver caches make_sharded_grow_fn)."""
     fn = make_sharded_grow_fn(mesh, params, num_leaves, max_bins, max_depth,
-                              policy, hist_impl)
+                              policy, hist_impl, has_cat=has_cat)
     return fn(bins, gh, meta, feature_mask)
 
 
 def train_step_data_parallel(mesh: Mesh, params: SplitParams,
                              num_leaves: int, max_bins: int,
                              axis_name: str = DATA_AXIS,
-                             policy: str = "depthwise"):
+                             policy: str = "depthwise",
+                             has_cat: bool = False):
     """A FULL jit-compiled data-parallel boosting step: binary-logloss
     gradients -> sharded tree growth (histogram psum over the mesh) -> score
     update.  This is the flagship multi-chip path the driver dry-runs
@@ -85,7 +90,8 @@ def train_step_data_parallel(mesh: Mesh, params: SplitParams,
         gh = jnp.stack([grad, hess, valid], axis=1)
         tree, row_leaf = grow(bins, gh, meta, feature_mask, params,
                               num_leaves, max_bins, -1,
-                              hist_impl="segment", psum_axis=axis_name)
+                              hist_impl="segment", psum_axis=axis_name,
+                              has_cat=has_cat)
         new_score = score + 0.1 * tree.leaf_value[row_leaf]
         return new_score, tree
 

@@ -1,0 +1,52 @@
+import sys, json
+sys.path.insert(0, "/tmp/refpkg")
+import numpy as np
+import lightgbm as ref_lgb
+
+OUT = "/root/repo/tests/fixtures"
+import os
+os.makedirs(OUT, exist_ok=True)
+
+# ---- deterministic dataset (same recipe as tests/parity tests will use)
+rng = np.random.RandomState(42)
+R = 5000
+X = np.empty((R, 6), np.float64)
+X[:, 0] = rng.randn(R)                       # gaussian
+X[:, 1] = rng.exponential(2.0, R)            # skewed
+X[:, 2] = rng.randint(0, 10, R)              # few distinct values
+X[:, 3] = np.where(rng.rand(R) < 0.7, 0.0, rng.randn(R))  # sparse-ish zeros
+X[:, 4] = rng.rand(R)
+X[:, 4][::7] = np.nan                        # missing
+X[:, 5] = rng.randint(0, 12, R)              # categorical
+w = np.array([1.0, -0.5, 0.3, 0.8, 1.2, 0.0])
+logit = (X[:, 0] * w[0] + X[:, 1] * w[1] + X[:, 2] * w[2]
+         + np.nan_to_num(X[:, 4]) * w[4]
+         + np.isin(X[:, 5], [2, 5, 7]) * 1.5)
+y = (logit + 0.3 * rng.randn(R) > 0.5).astype(np.float64)
+np.save(f"{OUT}/parity_X.npy", X.astype(np.float32))
+np.save(f"{OUT}/parity_y.npy", y.astype(np.float32))
+
+params = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+          "max_bin": 63, "min_data_in_leaf": 20, "verbose": -1,
+          "deterministic": True, "force_row_wise": True, "seed": 7}
+ds = ref_lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1},
+                     categorical_feature=[5])
+bst = ref_lgb.train(params, ds, num_boost_round=20)
+bst.save_model(f"{OUT}/ref_model_binary.txt")
+np.save(f"{OUT}/ref_pred_binary.npy", bst.predict(X))
+
+from sklearn.metrics import roc_auc_score
+print("ref AUC:", roc_auc_score(y, bst.predict(X)))
+
+# ---- reference bin boundaries via a numerical-only dataset dump
+ds2 = ref_lgb.Dataset(X[:, :5], label=y, params={"max_bin": 63,
+                                                 "verbose": -1,
+                                                 "min_data_in_bin": 3})
+ds2.construct()
+ds2._dump_text("/tmp/ref_dump.txt")
+# parse bin boundaries from the dump
+import re
+bounds = {}
+with open("/tmp/ref_dump.txt") as f:
+    txt = f.read()
+print(txt[:600])

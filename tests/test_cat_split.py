@@ -1,0 +1,153 @@
+"""Categorical split finder vs a numpy re-implementation of the reference
+algorithm (feature_histogram.hpp:278-470)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.split import (SplitParams, best_categorical_split_cm,
+                                    best_split_cm)
+
+
+def _leaf_gain(g, h, l1, l2):
+    reg = max(0.0, abs(g) - l1)
+    sg = np.sign(g) * reg
+    return sg * sg / (h + l2)
+
+
+def _oracle_cat(grad, hess, cnt, nb, p: SplitParams):
+    """Best categorical split for ONE (slot, feature) histogram, numpy."""
+    eps = 1e-15
+    tot_g = grad.sum()
+    tot_h = hess.sum() + 2 * eps
+    tot_c = cnt.sum()
+    gain_shift = _leaf_gain(tot_g, tot_h, p.lambda_l1, p.lambda_l2)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+    best = (-np.inf, None)
+
+    if nb <= p.max_cat_to_onehot:
+        for t in range(1, nb):
+            lg, lh, lc = grad[t], hess[t] + eps, cnt[t]
+            rg, rh, rc = tot_g - lg, tot_h - lh - eps, tot_c - lc
+            if (lc < p.min_data_in_leaf or lh < p.min_sum_hessian_in_leaf
+                    or rc < p.min_data_in_leaf
+                    or rh < p.min_sum_hessian_in_leaf):
+                continue
+            gain = (_leaf_gain(lg, lh, p.lambda_l1, p.lambda_l2)
+                    + _leaf_gain(rg, rh, p.lambda_l1, p.lambda_l2))
+            if gain > min_gain_shift and gain > best[0]:
+                best = (gain, {t})
+        return best
+
+    l2 = p.lambda_l2 + p.cat_l2
+    idx = [t for t in range(1, nb) if cnt[t] >= p.cat_smooth]
+    idx.sort(key=lambda t: grad[t] / (hess[t] + p.cat_smooth))
+    used = len(idx)
+    max_num_cat = min(p.max_cat_threshold, (used + 1) // 2)
+    for dir_, start in ((1, 0), (-1, used - 1)):
+        sum_g, sum_h, sum_c, grp = 0.0, eps, 0.0, 0.0
+        pos = start
+        members = []
+        for i in range(min(used, max_num_cat)):
+            t = idx[pos]
+            pos += dir_
+            members.append(t)
+            sum_g += grad[t]
+            sum_h += hess[t]
+            sum_c += cnt[t]
+            grp += cnt[t]
+            if (sum_c < p.min_data_in_leaf
+                    or sum_h < p.min_sum_hessian_in_leaf):
+                continue
+            rc = tot_c - sum_c
+            if rc < p.min_data_in_leaf or rc < p.min_data_per_group:
+                break
+            rh = tot_h - sum_h
+            if rh < p.min_sum_hessian_in_leaf:
+                break
+            if grp < p.min_data_per_group:
+                continue
+            grp = 0.0
+            rg = tot_g - sum_g
+            gain = (_leaf_gain(sum_g, sum_h, p.lambda_l1, l2)
+                    + _leaf_gain(rg, rh, p.lambda_l1, l2))
+            if gain > min_gain_shift and gain > best[0]:
+                best = (gain, set(members))
+    return best
+
+
+def _run(grad, hess, cnt, nb, p, F=1):
+    S = grad.shape[0]
+    B = grad.shape[-1]
+    bs = best_categorical_split_cm(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(cnt),
+        jnp.full((F,), nb, jnp.int32), jnp.ones((F,), bool), p,
+        jnp.zeros((S,), jnp.float32))
+    return bs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sorted_subset_matches_oracle(seed):
+    rng = np.random.RandomState(seed)
+    B, nb = 32, 26
+    p = SplitParams(min_data_in_leaf=3, min_data_per_group=5, cat_smooth=2.0,
+                    cat_l2=1.0, max_cat_to_onehot=4, max_cat_threshold=16)
+    grad = np.zeros((1, 1, B), np.float32)
+    hess = np.zeros((1, 1, B), np.float32)
+    cnt = np.zeros((1, 1, B), np.float32)
+    cnt[0, 0, :nb] = rng.randint(0, 40, nb)
+    hess[0, 0] = cnt[0, 0] * (0.5 + 0.1 * rng.rand(B))
+    grad[0, 0] = rng.randn(B) * cnt[0, 0]
+    want_gain, want_set = _oracle_cat(grad[0, 0], hess[0, 0], cnt[0, 0],
+                                      nb, p)
+    bs = _run(grad, hess, cnt, nb, p)
+    if want_set is None:
+        assert not bool(bs.cat_flag[0])
+        return
+    got_set = set(np.nonzero(np.asarray(bs.cat_mask)[0])[0].tolist())
+    got_total = float(bs.gain[0]) + (  # add back the shift for comparison
+        _leaf_gain(grad[0, 0].sum(), hess[0, 0].sum() + 2e-15,
+                   p.lambda_l1, p.lambda_l2) + p.min_gain_to_split)
+    assert got_set == want_set, (got_set, want_set)
+    np.testing.assert_allclose(got_total, want_gain, rtol=1e-4)
+
+
+def test_onehot_mode():
+    rng = np.random.RandomState(3)
+    B, nb = 8, 4
+    p = SplitParams(min_data_in_leaf=2, max_cat_to_onehot=6, cat_smooth=1.0)
+    grad = np.zeros((1, 1, B), np.float32)
+    hess = np.zeros((1, 1, B), np.float32)
+    cnt = np.zeros((1, 1, B), np.float32)
+    cnt[0, 0, :nb] = [10, 20, 15, 12]
+    hess[0, 0, :nb] = [5, 10, 7, 6]
+    grad[0, 0, :nb] = [1.0, -8.0, 3.0, 1.5]
+    want_gain, want_set = _oracle_cat(grad[0, 0], hess[0, 0], cnt[0, 0],
+                                      nb, p)
+    bs = _run(grad, hess, cnt, nb, p)
+    got_set = set(np.nonzero(np.asarray(bs.cat_mask)[0])[0].tolist())
+    assert got_set == want_set
+
+
+def test_combined_prefers_higher_gain():
+    """best_split_cm picks categorical when its gain beats numerical."""
+    rng = np.random.RandomState(5)
+    B = 16
+    S, F = 1, 2
+    grad = rng.randn(S, F, B).astype(np.float32) * 5
+    hess = np.abs(rng.randn(S, F, B)).astype(np.float32) * 10 + 5
+    cnt = np.full((S, F, B), 20.0, np.float32)
+    p = SplitParams(min_data_in_leaf=1, cat_smooth=1.0, max_cat_to_onehot=2,
+                    max_cat_threshold=8, min_data_per_group=1)
+    bs = best_split_cm(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(cnt),
+        jnp.full((F,), B, jnp.int32), jnp.zeros((F,), jnp.int32),
+        jnp.zeros((F,), jnp.int32), jnp.ones((F,), bool),
+        jnp.asarray([False, True]), jnp.zeros((F,), jnp.int32), p,
+        jnp.zeros((S,), jnp.float32), has_cat=True)
+    assert np.isfinite(float(bs.gain[0]))
+    # feature 1 is categorical; if chosen, cat_flag must be set
+    if int(bs.feature[0]) == 1:
+        assert bool(bs.cat_flag[0])
+    else:
+        assert not bool(bs.cat_flag[0])
