@@ -11,6 +11,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import register_logger
 
 __version__ = "0.1.0"
@@ -18,6 +19,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Dataset", "Booster", "Config", "CVBooster",
     "train", "cv",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "register_logger",
 ]

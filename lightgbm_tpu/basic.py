@@ -24,6 +24,14 @@ from .utils import log
 __all__ = ["Dataset", "Booster"]
 
 
+def pred_trees_stale(pred, models) -> bool:
+    # count alone is not enough: rollback_one_iter + update keeps the
+    # length while swapping the tail tree
+    return (getattr(pred, "n_models_built", -1) != len(models)
+            or (models and getattr(pred, "last_model_id", 0)
+                != id(models[-1])))
+
+
 def _to_2d_numpy(data) -> np.ndarray:
     if hasattr(data, "values") and not isinstance(data, np.ndarray):
         data = data.values  # pandas
@@ -414,9 +422,7 @@ class Booster:
             from .io.shap import predict_contrib
             return predict_contrib(self, X, lo, hi)
 
-        raw = np.zeros((k, n), np.float64)
-        for i, t in enumerate(self.models[lo:hi]):
-            raw[(lo + i) % k] += t.predict_rows(X)
+        raw = self._predict_raw(X, lo, hi)
         if self.average_output and num_iteration > 0:
             raw /= num_iteration
         if not raw_score and self.objective is not None:
@@ -424,6 +430,36 @@ class Booster:
                 return self.objective.convert_output(raw.T)
             return np.asarray(self.objective.convert_output(raw[0]))
         return raw[0] if k == 1 else raw.T
+
+    # ------------------------------------------------------------------
+    def _predict_raw(self, X: np.ndarray, lo: int, hi: int) -> np.ndarray:
+        """Raw scores [k, n]: device batch path for big jobs (bin through
+        the training mappers + one jit scan over a stacked tree tensor —
+        ref: predictor.hpp:30 replaced per SURVEY §3.3), host tree walk
+        otherwise (exact float64 accumulation)."""
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        n_trees = hi - lo
+        use_device = (self.train_set is not None
+                      and self.train_set._inner is not None
+                      and n * max(n_trees, 1) >= 2_000_000)
+        if use_device:
+            pred = getattr(self, "_device_predictor", None)
+            if pred is None or pred_trees_stale(pred, self.models):
+                from .models.predictor import DevicePredictor
+                pred = DevicePredictor(self.models, self.train_set._inner,
+                                       k)
+                if pred.ok:
+                    pred.n_models_built = len(self.models)
+                    pred.last_model_id = (id(self.models[-1])
+                                          if self.models else 0)
+                    self._device_predictor = pred
+            if pred is not None and pred.ok:
+                return pred.predict_raw(X, lo, hi)
+        raw = np.zeros((k, n), np.float64)
+        for i, t in enumerate(self.models[lo:hi]):
+            raw[(lo + i) % k] += t.predict_rows(X)
+        return raw
 
     # ------------------------------------------------------------------
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":

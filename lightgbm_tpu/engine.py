@@ -29,7 +29,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
-          feval=None, init_model: Optional[Union[str, Booster]] = None,
+          fobj=None, feval=None,
+          init_model: Optional[Union[str, Booster]] = None,
           feature_name="auto", categorical_feature="auto",
           keep_training_booster: bool = False,
           callbacks: Optional[List] = None) -> Booster:
@@ -106,7 +107,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 model=booster, params=params, iteration=i,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
-        finished = booster.update()
+        finished = booster.update(fobj=fobj)
 
         evaluation_result_list = []
         if valid_sets is not None or feval is not None:

@@ -1,0 +1,484 @@
+"""scikit-learn estimator API.
+
+TPU-native analog of the reference sklearn wrappers (ref:
+python-package/lightgbm/sklearn.py:358 LGBMModel, :939 LGBMRegressor,
+:985 LGBMClassifier, :1120 LGBMRanker) — an original implementation of the
+same public surface over this package's ``train()``/``Booster``.
+
+Supported: fit/predict(_proba), eval_set + eval_metric + early stopping
+via callbacks, sample/eval weights, init_score, categorical_feature,
+sklearn-signature custom objectives and metrics, label encoding and
+class_weight for classification, group/eval_at for ranking, get_params/
+set_params/clone compatibility, feature_importances_.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as _callback
+from .basic import Booster, Dataset
+from .engine import train as _train
+from .utils import log
+
+try:  # sklearn is optional at import time, required to actually fit
+    from sklearn.base import BaseEstimator as _SKBase
+
+    class _Base(_SKBase):
+        pass
+    _HAS_SKLEARN = True
+except Exception:  # pragma: no cover
+    class _Base:  # minimal stand-in so the module imports without sklearn
+        def get_params(self, deep=True):
+            out = {}
+            import inspect
+            for name in inspect.signature(
+                    type(self).__init__).parameters:
+                if name not in ("self", "kwargs"):
+                    out[name] = getattr(self, name, None)
+            out.update(getattr(self, "_other_params", {}))
+            return out
+
+        def set_params(self, **params):
+            for k, v in params.items():
+                setattr(self, k, v)
+            return self
+    _HAS_SKLEARN = False
+
+
+def _n_positional_args(func: Callable) -> int:
+    import inspect
+    try:
+        sig = inspect.signature(func)
+    except (TypeError, ValueError):
+        return 2
+    return sum(1 for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+
+
+def _objective_adapter(func: Callable):
+    """Wrap an sklearn-signature objective ``func(y_true, y_pred[, weight])
+    -> (grad, hess)`` into the engine's ``fobj(preds, dataset)``
+    (ref: sklearn.py:45 _ObjectiveFunctionWrapper). Arity is inspected
+    once — never probed by catching TypeError, which would swallow user
+    bugs and double-invoke side-effecting objectives."""
+    argc = _n_positional_args(func)
+
+    def fobj(preds, dataset):
+        label = dataset.get_label()
+        if argc >= 3:
+            return func(label, preds, dataset.get_weight())
+        return func(label, preds)
+    return fobj
+
+
+def _metric_adapter(func: Callable):
+    """Wrap ``func(y_true, y_pred[, weight]) -> (name, value, is_higher
+    _better)`` into ``feval(preds, dataset)``
+    (ref: sklearn.py:134 _EvalFunctionWrapper)."""
+    argc = _n_positional_args(func)
+
+    def feval(preds, dataset):
+        label = dataset.get_label()
+        if argc >= 3:
+            return func(label, preds, dataset.get_weight())
+        return func(label, preds)
+    return feval
+
+
+class LGBMModel(_Base):
+    """Base estimator (ref: sklearn.py:358)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[Any] = None,
+                 class_weight: Optional[Any] = None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_score: Dict = {}
+        self._best_iteration: Optional[int] = None
+        self._objective = objective
+        self._class_weight = class_weight
+        self._n_features: Optional[int] = None
+        self.fitted_ = False
+
+    # ------------------------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = super().get_params(deep=deep)
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params):
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, "_other_params") and key not in \
+                    self._sk_constructor_args():
+                self._other_params[key] = value
+        return self
+
+    @classmethod
+    def _sk_constructor_args(cls):
+        import inspect
+        return [n for n in inspect.signature(LGBMModel.__init__).parameters
+                if n not in ("self", "kwargs")]
+
+    # ------------------------------------------------------------------
+    def _process_params(self, default_objective: str) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        # sklearn-name -> LightGBM-name translation (aliases understood by
+        # the Config registry; ref sklearn.py fit parameter mapping)
+        rename = {
+            "boosting_type": "boosting",
+            "min_split_gain": "min_gain_to_split",
+            "min_child_weight": "min_sum_hessian_in_leaf",
+            "min_child_samples": "min_data_in_leaf",
+            "subsample": "bagging_fraction",
+            "subsample_freq": "bagging_freq",
+            "colsample_bytree": "feature_fraction",
+            "reg_alpha": "lambda_l1",
+            "reg_lambda": "lambda_l2",
+            "subsample_for_bin": "bin_construct_sample_cnt",
+            "random_state": "seed",
+        }
+        for a, b in rename.items():
+            if a in params:
+                v = params.pop(a)
+                if v is not None:
+                    params[b] = v
+        params.pop("n_jobs", None)
+        if callable(self.objective):
+            self._objective = self.objective
+            params["objective"] = "none"
+        elif self.objective is None:
+            params["objective"] = default_objective
+        else:
+            params["objective"] = self.objective
+        if params.get("seed") is None:
+            params.pop("seed", None)
+        params.setdefault("verbose", -1)
+        return params
+
+    # ------------------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None, init_model=None):
+        """(ref: sklearn.py:700 LGBMModel.fit)"""
+        params = self._process_params(
+            default_objective=self._default_objective())
+        n_rounds = int(self.n_estimators)
+
+        X_raw, y_raw = X, y
+        X = np.asarray(X, dtype=np.float32)
+        self._n_features = X.shape[1]
+        y_tr = self._prepare_labels(np.asarray(y))
+        w = self._combine_weights(np.asarray(y), sample_weight)
+
+        fobj = None
+        if callable(self._objective):
+            fobj = _objective_adapter(self._objective)
+        feval = None
+        if eval_metric is not None:
+            if callable(eval_metric):
+                feval = _metric_adapter(eval_metric)
+            else:
+                params["metric"] = eval_metric
+
+        train_set = Dataset(X, label=y_tr, weight=w, group=group,
+                            init_score=init_score,
+                            params=dict(params),
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets: List[Dataset] = []
+        names: List[str] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight is not None else None)
+                vis = (eval_init_score[i]
+                       if eval_init_score is not None else None)
+                vg = eval_group[i] if eval_group is not None else None
+                vy_t = self._prepare_labels(np.asarray(vy))
+                vw_t = self._combine_weights(np.asarray(vy), vw)
+                # the training set itself is recognized by identity only
+                # (ref sklearn.py fit: valid_x is X and valid_y is y)
+                if vx is X_raw and vy is y_raw:
+                    valid_sets.append(train_set)
+                else:
+                    valid_sets.append(train_set.create_valid(
+                        np.asarray(vx, np.float32), label=vy_t, weight=vw_t,
+                        group=vg, init_score=vis))
+                names.append(eval_names[i] if eval_names else f"valid_{i}")
+
+        callbacks = list(callbacks) if callbacks else []
+        self._evals_result = {}
+        if valid_sets:
+            callbacks.append(
+                _callback.record_evaluation(self._evals_result))
+
+        booster = _train(
+            params, train_set, num_boost_round=n_rounds,
+            valid_sets=valid_sets or None, valid_names=names or None,
+            fobj=fobj, feval=feval, init_model=init_model,
+            callbacks=callbacks)
+        self._Booster = booster
+        self._best_iteration = booster.best_iteration
+        self._best_score = booster.best_score
+        self.fitted_ = True
+        return self
+
+    # hooks specialized by subclasses -----------------------------------
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        return y.astype(np.float32)
+
+    def _combine_weights(self, y: np.ndarray, sample_weight):
+        return (None if sample_weight is None
+                else np.asarray(sample_weight, np.float32))
+
+    # ------------------------------------------------------------------
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        self._check_fitted()
+        X = np.asarray(X, dtype=np.float32)
+        if self._n_features is not None and X.shape[1] != self._n_features:
+            raise ValueError(
+                f"X has {X.shape[1]} features, expected {self._n_features}")
+        return self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib)
+
+    def _check_fitted(self):
+        if self._Booster is None:
+            raise ValueError(
+                "Estimator not fitted, call fit before exploiting the model.")
+
+    # ------------------------------------------------------------------
+    @property
+    def booster_(self) -> Booster:
+        self._check_fitted()
+        return self._Booster
+
+    @property
+    def evals_result_(self) -> Dict:
+        self._check_fitted()
+        return self._evals_result
+
+    @property
+    def best_iteration_(self):
+        self._check_fitted()
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        self._check_fitted()
+        return self._best_score
+
+    @property
+    def n_features_(self) -> int:
+        self._check_fitted()
+        return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        return self.n_features_
+
+    @property
+    def objective_(self):
+        self._check_fitted()
+        return (self.objective if self.objective is not None
+                else self._default_objective())
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.feature_importance(
+            importance_type=self.importance_type)
+
+    @property
+    def feature_name_(self):
+        self._check_fitted()
+        return self._Booster.feature_name()
+
+
+class LGBMRegressor(LGBMModel):
+    """(ref: sklearn.py:939)"""
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_init_score=None,
+            eval_metric=None, feature_name="auto",
+            categorical_feature="auto", callbacks=None, init_model=None):
+        return super().fit(
+            X, y, sample_weight=sample_weight, init_score=init_score,
+            eval_set=eval_set, eval_names=eval_names,
+            eval_sample_weight=eval_sample_weight,
+            eval_init_score=eval_init_score, eval_metric=eval_metric,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature, callbacks=callbacks,
+            init_model=init_model)
+
+
+class LGBMClassifier(LGBMModel):
+    """(ref: sklearn.py:985)"""
+
+    def _default_objective(self) -> str:
+        return "binary" if getattr(self, "_n_classes", 2) <= 2 \
+            else "multiclass"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_init_score=None,
+            eval_metric=None, feature_name="auto",
+            categorical_feature="auto", callbacks=None, init_model=None):
+        y_arr = np.asarray(y)
+        self._classes = np.unique(y_arr)
+        self._n_classes = len(self._classes)
+        self._label_map = {c: i for i, c in enumerate(self._classes)}
+        if self._n_classes > 2:
+            self._other_params["num_class"] = self._n_classes
+            setattr(self, "num_class", self._n_classes)
+        return super().fit(
+            X, y, sample_weight=sample_weight, init_score=init_score,
+            eval_set=eval_set, eval_names=eval_names,
+            eval_sample_weight=eval_sample_weight,
+            eval_init_score=eval_init_score, eval_metric=eval_metric,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature, callbacks=callbacks,
+            init_model=init_model)
+
+    def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray([self._label_map[v] for v in y], np.float32)
+
+    def _combine_weights(self, y: np.ndarray, sample_weight):
+        w = (np.ones(len(y), np.float32) if sample_weight is None
+             else np.asarray(sample_weight, np.float32).copy())
+        if self.class_weight is None:
+            return None if sample_weight is None else w
+        if self.class_weight == "balanced":
+            counts = np.array([np.sum(y == c) for c in self._classes],
+                              np.float64)
+            cw = len(y) / (self._n_classes * np.maximum(counts, 1))
+            weights = {c: cw[i] for i, c in enumerate(self._classes)}
+        else:
+            weights = dict(self.class_weight)
+        for c, cwv in weights.items():
+            w[y == c] *= cwv
+        return w
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        result = self.predict_proba(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib, **kwargs)
+        if callable(self._objective) or raw_score or pred_leaf \
+                or pred_contrib:
+            # raw scores pass through untouched for custom objectives
+            # (ref: sklearn.py LGBMClassifier.predict)
+            return result
+        idx = (np.argmax(result, axis=1) if result.ndim > 1
+               else (result > 0.5).astype(int))
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      start_iteration: int = 0,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False, pred_contrib: bool = False,
+                      **kwargs):
+        self._check_fitted()
+        preds = super().predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return preds
+        if callable(self._objective):
+            log.warning("Cannot compute class probabilities due to the "
+                        "usage of a customized objective function: "
+                        "returning raw scores")
+            return preds
+        if preds.ndim == 1:
+            return np.stack([1.0 - preds, preds], axis=1)
+        return preds
+
+    @property
+    def classes_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        self._check_fitted()
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """(ref: sklearn.py:1120)"""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            eval_at=(1, 2, 3, 4, 5), feature_name="auto",
+            categorical_feature="auto", callbacks=None, init_model=None):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set is "
+                             "not None")
+        self._other_params["eval_at"] = list(eval_at)
+        setattr(self, "eval_at", list(eval_at))
+        return super().fit(
+            X, y, sample_weight=sample_weight, init_score=init_score,
+            group=group, eval_set=eval_set, eval_names=eval_names,
+            eval_sample_weight=eval_sample_weight,
+            eval_init_score=eval_init_score, eval_group=eval_group,
+            eval_metric=eval_metric, feature_name=feature_name,
+            categorical_feature=categorical_feature, callbacks=callbacks,
+            init_model=init_model)

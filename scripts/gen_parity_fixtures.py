@@ -44,9 +44,17 @@ ds2 = ref_lgb.Dataset(X[:, :5], label=y, params={"max_bin": 63,
                                                  "min_data_in_bin": 3})
 ds2.construct()
 ds2._dump_text("/tmp/ref_dump.txt")
-# parse bin boundaries from the dump
-import re
-bounds = {}
+# the dump carries the reference's per-row BIN ASSIGNMENTS — save them as
+# the bin-parity fixture (stronger than boundary equality)
+rows = []
 with open("/tmp/ref_dump.txt") as f:
-    txt = f.read()
-print(txt[:600])
+    lines = f.read().splitlines()
+start = lines.index("feature 4: ") + 1
+for ln in lines[start:]:
+    ln = ln.strip().rstrip(",")
+    if ln:
+        rows.append([int(x) for x in ln.split(",")])
+arr = np.array(rows, np.int32)
+assert arr.shape == (R, 5), arr.shape
+np.save(f"{OUT}/ref_bins.npy", arr.astype(np.uint8))
+print("fixtures written to", OUT)

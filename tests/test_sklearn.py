@@ -1,0 +1,157 @@
+"""sklearn estimator API tests, mirroring the reference's
+tests/python_package_test/test_sklearn.py basics."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import (LGBMClassifier, LGBMRanker, LGBMRegressor,
+                          early_stopping)
+
+
+def _clf_data(R=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(R, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    return X, y
+
+
+def test_classifier_binary():
+    X, y = _clf_data()
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15, verbose=-1,
+                         min_child_samples=5)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 2
+    assert set(clf.classes_) == {0, 1}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.97
+    assert clf.feature_importances_.shape == (8,)
+    assert clf.n_features_ == 8
+
+
+def test_classifier_string_labels():
+    X, y = _clf_data()
+    labels = np.where(y > 0, "pos", "neg")
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7, verbose=-1,
+                         min_child_samples=5)
+    clf.fit(X, labels)
+    pred = clf.predict(X)
+    assert set(pred) <= {"pos", "neg"}
+    assert (pred == labels).mean() > 0.95
+
+
+def test_classifier_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.2 * rng.randn(1500, 3), axis=1)
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15, verbose=-1,
+                         min_child_samples=5)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (1500, 3)
+    assert (clf.predict(X) == y).mean() > 0.9
+
+
+def test_regressor_and_eval_set_early_stopping():
+    rng = np.random.RandomState(2)
+    X = rng.rand(3000, 5).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(3000)).astype(np.float32)
+    Xt, yt = X[:2400], y[:2400]
+    Xv, yv = X[2400:], y[2400:]
+    reg = LGBMRegressor(n_estimators=200, num_leaves=15, verbose=-1,
+                        min_child_samples=5)
+    reg.fit(Xt, yt, eval_set=[(Xv, yv)], eval_metric="l2",
+            callbacks=[early_stopping(10, verbose=False)])
+    assert reg.best_iteration_ > 0
+    assert "valid_0" in reg.evals_result_
+    mse = np.mean((reg.predict(Xv) - yv) ** 2)
+    assert mse < 0.01
+
+
+def test_custom_objective_and_metric():
+    X, y = _clf_data(seed=3)
+
+    def logloss_obj(y_true, y_pred):
+        p = 1.0 / (1.0 + np.exp(-y_pred))
+        return (p - y_true).astype(np.float32), \
+            (p * (1 - p)).astype(np.float32)
+
+    def err_metric(y_true, y_pred):
+        return "err", float(np.mean((y_pred > 0) != y_true)), False
+
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15, verbose=-1,
+                         min_child_samples=5, objective=logloss_obj)
+    # eval_set sharing the exact train objects is named "training"
+    # (reference sklearn semantics)
+    clf.fit(X, y, eval_set=[(X, y)], eval_metric=err_metric)
+    errs = clf.evals_result_["training"]["err"]
+    assert errs[-1] < 0.1
+    assert errs[-1] <= errs[0]
+
+
+def test_class_weight_balanced():
+    rng = np.random.RandomState(4)
+    X = rng.randn(2000, 4).astype(np.float32)
+    y = (X[:, 0] > 1.3).astype(int)   # ~10% positives
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7, verbose=-1,
+                         min_child_samples=5, class_weight="balanced")
+    clf.fit(X, y)
+    recall = (clf.predict(X)[y == 1] == 1).mean()
+    assert recall > 0.8
+
+
+def test_ranker():
+    rng = np.random.RandomState(5)
+    n_q, per_q = 40, 25
+    X = rng.rand(n_q * per_q, 5).astype(np.float32)
+    rel = (X[:, 0] * 3 + 0.3 * rng.rand(n_q * per_q)).astype(int).clip(0, 3)
+    group = np.full(n_q, per_q)
+    rk = LGBMRanker(n_estimators=10, num_leaves=7, verbose=-1,
+                    min_child_samples=5)
+    rk.fit(X, rel, group=group)
+    scores = rk.predict(X)
+    from scipy.stats import spearmanr
+    rho = spearmanr(scores, rel).statistic
+    assert rho > 0.6
+
+
+def test_sklearn_compat_clone_and_gridsearch():
+    pytest.importorskip("sklearn")
+    from sklearn.base import clone
+    from sklearn.model_selection import GridSearchCV
+    X, y = _clf_data(R=600)
+    clf = LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1,
+                         min_child_samples=5)
+    c2 = clone(clf)
+    assert c2.get_params()["num_leaves"] == 7
+    gs = GridSearchCV(clf, {"num_leaves": [4, 7]}, cv=2, scoring="accuracy")
+    gs.fit(X, y)
+    assert gs.best_params_["num_leaves"] in (4, 7)
+
+
+def test_device_predict_matches_host():
+    """Large batches route through the device predictor; results must match
+    the host float64 walk to f32 tolerance (incl. NaN + categorical)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(9)
+    R = 4000
+    X = rng.randn(R, 6).astype(np.float32)
+    X[:, 5] = rng.randint(0, 9, R)
+    X[::11, 2] = np.nan
+    y = ((X[:, 0] > 0) ^ np.isin(X[:, 5], [2, 6])).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[5])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 5, "min_data_per_group": 5,
+                     "cat_smooth": 2.0}, ds, num_boost_round=10)
+    # host path (small slice, below the device threshold)
+    host = bst.predict(X[:100])
+    # force device path by calling the predictor directly
+    from lightgbm_tpu.models.predictor import DevicePredictor
+    pred = DevicePredictor(bst.models, bst.train_set._inner, 1)
+    assert pred.ok
+    raw_dev = pred.predict_raw(np.asarray(X[:100], np.float64), 0,
+                               bst.num_trees())
+    conv = bst._gbdt.objective.convert_output(raw_dev[0])
+    np.testing.assert_allclose(conv, host, rtol=2e-5, atol=2e-6)
