@@ -24,12 +24,10 @@ from .utils import log
 __all__ = ["Dataset", "Booster"]
 
 
-def pred_trees_stale(pred, models) -> bool:
-    # count alone is not enough: rollback_one_iter + update keeps the
-    # length while swapping the tail tree
-    return (getattr(pred, "n_models_built", -1) != len(models)
-            or (models and getattr(pred, "last_model_id", 0)
-                != id(models[-1])))
+def pred_trees_stale(pred, booster) -> bool:
+    # a monotonically-bumped version survives rollback+update swaps where
+    # both the length and (recycled) id of the tail tree can repeat
+    return getattr(pred, "model_version", -1) != booster._model_version
 
 
 def _to_2d_numpy(data) -> np.ndarray:
@@ -240,6 +238,7 @@ class Booster:
         self.num_tree_per_iteration = 1
         self.max_feature_idx = 0
         self.feature_names: List[str] = []
+        self._model_version = 0  # bumped on every model-list mutation
         self.feature_infos: List[str] = []
         self.monotone_constraints = None
         self.label_index = 0
@@ -316,6 +315,7 @@ class Booster:
         (ref: basic.py:2936 Booster.update)."""
         if train_set is not None and train_set is not self.train_set:
             raise Exception("Replacing train_set is not supported yet")
+        self._model_version += 1
         if fobj is None:
             return self._gbdt.train_one_iter()
         if self.objective is not None:
@@ -334,6 +334,7 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        self._model_version += 1
         return self
 
     def current_iteration(self) -> int:
@@ -445,14 +446,12 @@ class Booster:
                       and n * max(n_trees, 1) >= 2_000_000)
         if use_device:
             pred = getattr(self, "_device_predictor", None)
-            if pred is None or pred_trees_stale(pred, self.models):
+            if pred is None or pred_trees_stale(pred, self):
                 from .models.predictor import DevicePredictor
                 pred = DevicePredictor(self.models, self.train_set._inner,
                                        k)
                 if pred.ok:
-                    pred.n_models_built = len(self.models)
-                    pred.last_model_id = (id(self.models[-1])
-                                          if self.models else 0)
+                    pred.model_version = self._model_version
                     self._device_predictor = pred
             if pred is not None and pred.ok:
                 return pred.predict_raw(X, lo, hi)

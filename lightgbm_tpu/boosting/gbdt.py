@@ -751,12 +751,18 @@ class GBDT:
         if self.early_stopping_round <= 0:
             return False
         stop = False
-        first = True
+        first_name = None
         for ds_name, name, v, bigger in results:
             if ds_name == "training":
                 continue
-            if self.es_first_metric_only and not first:
-                break
+            if self.es_first_metric_only:
+                # the FIRST metric is tracked on EVERY valid set; later
+                # metrics are skipped (ref: gbdt.cpp:560 early-stopping
+                # loop over valid sets with first_metric_only)
+                if first_name is None:
+                    first_name = name
+                elif name != first_name:
+                    continue
             key = (ds_name, name)
             cmp = v if bigger else -v
             if key not in self.best_score or cmp > self.best_score[key]:
@@ -764,11 +770,11 @@ class GBDT:
                 self.best_iter[key] = it
             elif it - self.best_iter[key] >= self.early_stopping_round:
                 stop = True
-            first = False
         return stop
 
     def train(self) -> None:
-        """Full training loop (ref: gbdt.cpp:266 Train)."""
+        """Full training loop (ref: gbdt.cpp:266 Train). Snapshotting lives
+        in engine.train (the driver that owns output paths)."""
         for it in range(self.iter, int(self.config.num_iterations)):
             finished = self.train_one_iter()
             if not finished:
@@ -939,6 +945,8 @@ class GOSS(GBDT):
             self.bag_weight = jnp.ones((n,), jnp.float32)
             self.bag_cnt = n
             return grad, hess
+        # sum over classes of |g*h| (ref: goss.hpp:108-113 accumulates
+        # fabs(g*h) per tree-per-iteration model)
         g_np = np.asarray(jnp.sum(jnp.abs(grad * hess), axis=0))
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))

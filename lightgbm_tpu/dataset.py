@@ -317,6 +317,13 @@ class TpuDataset:
                     change = np.concatenate(
                         [[True], row_query[1:] != row_query[:-1]])
                     starts = np.nonzero(change)[0]
+                    seen = row_query[starts]
+                    if len(np.unique(seen)) != len(seen):
+                        log.warning(
+                            "subset rows interleave query groups: a query's "
+                            "rows are not contiguous in the subset, so it "
+                            "is split into multiple groups — sort subset "
+                            "indices by query to avoid this")
                     sizes = np.diff(np.concatenate([starts,
                                                     [len(row_query)]]))
                     out.metadata.set_group(sizes)

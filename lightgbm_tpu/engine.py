@@ -41,6 +41,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if alias in params:
             num_boost_round = int(params.pop(alias))
     params["num_iterations"] = num_boost_round
+    snapshot_freq = int(params.get("snapshot_freq",
+                                   params.get("save_period", -1) or -1))
+    snapshot_base = str(params.get("output_model", "LightGBM_model.txt"))
     first_metric_only = bool(params.get("first_metric_only", False))
     early_stopping_round = None
     for alias in _ES_ALIASES:
@@ -108,6 +111,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            # periodic checkpoint (ref: gbdt.cpp:279-283 SaveModelToFile
+            # snapshot_out); the text model is the checkpoint format
+            booster.save_model(f"{snapshot_base}.snapshot_iter_{i + 1}")
 
         evaluation_result_list = []
         if valid_sets is not None or feval is not None:

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from ..ops.histogram import build_histograms
 from ..ops.split import (BestSplit, SplitParams, best_numerical_split,
-                         best_split_cm, calculate_leaf_output)
+                         best_numerical_split_cm, best_split_cm,
+                         calculate_leaf_output)
 from .tree import TreeArrays, empty_tree
 
 NEG_INF = -jnp.inf
@@ -68,6 +69,34 @@ def _route_left(bins_col: jax.Array, t: jax.Array, default_left: jax.Array,
     b = bins_col.astype(jnp.int32)
     missing = (((mt == 1) & (b == db)) | ((mt == 2) & (b == nb - 1)))
     return jnp.where(missing, default_left, b <= t)
+
+
+def merge_best_over_shards(bs: BestSplit, axis: str,
+                           f_offset) -> BestSplit:
+    """Global best split per slot across feature-parallel shards
+    (ref: parallel_tree_learner.h:191 SyncUpGlobalBestSplit — the 48-byte
+    SplitInfo allreduce-max, expressed as pmax + winner-shard pick).
+    Local feature indices are globalized with ``f_offset`` first."""
+    g = bs.gain
+    gmax = jax.lax.pmax(g, axis)
+    idx = jax.lax.axis_index(axis)
+    big = jnp.int32(1 << 30)
+    # earliest shard wins ties (matches the reference's rank order)
+    winner = jax.lax.pmin(jnp.where(g >= gmax, idx, big), axis)
+    mine = idx == winner
+
+    def pick(a):
+        m = mine if a.ndim == 1 else mine[:, None]
+        z = jnp.where(m, a, jnp.zeros_like(a))
+        if a.dtype == jnp.bool_:
+            return jax.lax.psum(z.astype(jnp.int32), axis) > 0
+        return jax.lax.psum(z, axis)
+
+    feat_g = jnp.where(bs.feature >= 0,
+                       bs.feature + jnp.int32(f_offset), -1)
+    out = {f: pick(getattr(bs, f)) for f in bs._fields
+           if f not in ("gain", "feature")}
+    return BestSplit(feature=pick(feat_g), gain=gmax, **out)
 
 
 def _merge_best(best: BestSplit, idx0, idx1, new2: BestSplit) -> BestSplit:
@@ -252,12 +281,16 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl", "psum_axis", "has_cat"))
+                     "hist_impl", "psum_axis", "has_cat", "parallel_mode",
+                     "top_k"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
                         hist_impl: str = "segment", psum_axis: str = None,
-                        has_cat: bool = False,
+                        has_cat: bool = False, parallel_mode: str = "data",
+                        top_k: int = 20, route_bins: jax.Array = None,
+                        route_meta: FeatureMeta = None,
+                        feature_offset=None,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -267,6 +300,22 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
     ``psum_axis``: see grow_tree_leafwise — data-parallel allreduce of the
     per-level histogram batch over the mesh axis.
+
+    ``parallel_mode`` (with psum_axis set):
+    - "data": rows sharded, full-histogram allreduce (the default).
+    - "feature": features sharded (``bins`` holds this shard's columns,
+      ``route_bins``/``route_meta`` the full replicated matrix, and
+      ``feature_offset`` this shard's first global column). No histogram
+      comm at all; per-level best splits are merged across shards
+      (ref: feature_parallel_tree_learner.cpp:60-77).
+    - "voting": rows sharded; each level's shards vote for their top_k
+      features by local gain and only the 2*top_k vote winners' histogram
+      columns are summed over the mesh — the level payload drops from
+      F*B*3 to 2*top_k*B*3 (ref: voting_parallel_tree_learner.cpp:151-184
+      GlobalVoting/CopyLocalHistogram; divergence: winners are chosen per
+      LEVEL as the union of per-slot votes, not per leaf). Histogram pool
+      entries for non-winner features are invalid and masked out of later
+      scans via a per-leaf validity plane.
     """
     R, F = bins.shape
     L = num_leaves
@@ -274,16 +323,43 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     n_levels = max_depth if max_depth > 0 else max(1, (L - 1).bit_length() + 1)
     # a level can at most double the leaves; cap levels at L-1 splits total
     n_levels = min(n_levels, L - 1)
+    W = min(F, 2 * top_k)
 
     def _psum(h):
         return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
 
+    def _exchange(hist, parent_out):
+        """Level histogram exchange -> (globally-valid hist, valid [F])."""
+        all_valid = jnp.ones((F,), bool)
+        if psum_axis is None or parallel_mode == "data":
+            return _psum(hist), all_valid
+        if parallel_mode == "feature":
+            return hist, all_valid         # local features are complete
+        # voting: local gains -> per-slot top_k votes -> global top-W cols
+        gains = best_numerical_split_cm(
+            hist[..., 0], hist[..., 1], hist[..., 2], meta.num_bin,
+            meta.missing_type, meta.default_bin, feature_mask,
+            meta.monotone, params, parent_out, per_feature_gains=True)
+        k = min(top_k, F)
+        kth = jnp.sort(gains, axis=1)[:, F - k][:, None]
+        votes = (gains >= kth) & jnp.isfinite(gains)
+        votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)
+        score_f = jnp.sum(votes, axis=0)                     # [F]
+        _, w_idx = jax.lax.top_k(score_f, W)
+        sub = jax.lax.psum(jnp.take(hist, w_idx, axis=1), psum_axis)
+        hist2 = jnp.zeros_like(hist).at[:, w_idx].set(sub)
+        valid = jnp.zeros((F,), bool).at[w_idx].set(True)
+        return hist2, valid
+
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
-    root_hist = _psum(build_histograms(bins, gh, row_leaf, num_slots=1,
-                                       num_bins=B, impl=hist_impl))
+    pool_valid = jnp.zeros((L, F), bool)
+    root_local = build_histograms(bins, gh, row_leaf, num_slots=1,
+                                  num_bins=B, impl=hist_impl)
+    root_hist, root_valid = _exchange(root_local, jnp.zeros((1,)))
     pool = pool.at[0].set(root_hist[0])
+    pool_valid = pool_valid.at[0].set(root_valid)
     root_g = jnp.sum(root_hist[0, 0, :, 0])
     root_h = jnp.sum(root_hist[0, 0, :, 1])
     root_c = jnp.sum(root_hist[0, 0, :, 2])
@@ -297,16 +373,23 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_is_left = jnp.zeros((L,), bool)
     num_nodes = jnp.int32(0)
 
-    def all_best(pool, tree):
-        return best_split(pool, meta, feature_mask, params, tree.leaf_value,
-                          has_cat=has_cat)
+    def all_best(pool, tree, pool_valid):
+        bs = best_split(pool, meta,
+                        feature_mask[None, :] & pool_valid, params,
+                        tree.leaf_value, has_cat=has_cat)
+        if parallel_mode == "feature" and psum_axis is not None:
+            bs = merge_best_over_shards(bs, psum_axis, feature_offset)
+        return bs
 
-    best = all_best(pool, tree)
+    best = all_best(pool, tree, pool_valid)
     best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
                                         NEG_INF))
+    r_bins = bins if route_bins is None else route_bins
+    r_meta = meta if route_meta is None else route_meta
 
     def level(carry, _):
-        tree, row_leaf, pool, best, lpn, lil, num_nodes = carry
+        (tree, row_leaf, pool, pool_valid, best, lpn, lil,
+         num_nodes) = carry
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         budget = L - tree.num_leaves
@@ -318,7 +401,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         n_sel = jnp.sum(selected.astype(jnp.int32))
 
         def do_level(op):
-            tree, row_leaf, pool, best, lpn, lil, num_nodes = op
+            (tree, row_leaf, pool, pool_valid, best, lpn, lil,
+             num_nodes) = op
             # new leaf ids: k-th selected leaf (by slot order) gets
             # num_leaves + k; node ids num_nodes + k
             sel_i32 = selected.astype(jnp.int32)
@@ -371,15 +455,17 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             tree2, lpn2, lil2 = scatter_nodes(tree, lpn, lil)
 
             # --- vectorized partition update: one gather per row ---
+            # (feature-parallel mode routes on the full replicated matrix
+            # since the winning column may belong to another shard)
             l_row = row_leaf
             sel_row = selected[l_row]
             f_row = jnp.maximum(f_l[l_row], 0)  # -1 (no split) rows are masked
             bins_row = jnp.take_along_axis(
-                bins, f_row[:, None].astype(jnp.int32), axis=1)[:, 0]
+                r_bins, f_row[:, None].astype(jnp.int32), axis=1)[:, 0]
             go_left = _route_left(bins_row, t_l[l_row], dl_l[l_row],
-                                  meta.num_bin[f_row],
-                                  meta.missing_type[f_row],
-                                  meta.default_bin[f_row])
+                                  r_meta.num_bin[f_row],
+                                  r_meta.missing_type[f_row],
+                                  r_meta.default_bin[f_row])
             if has_cat:
                 cat_left = cm_l[l_row, bins_row.astype(jnp.int32)]
                 go_left = jnp.where(cf_l[l_row], cat_left, go_left)
@@ -390,16 +476,25 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             leaf_to_slot = jnp.where(selected, k_of_leaf, -1)
             row_slot = jnp.where(sel_row & (row_leaf2 == row_leaf),
                                  leaf_to_slot[l_row], -1)
-            hist_left = _psum(build_histograms(bins, gh, row_slot,
-                                               num_slots=L, num_bins=B,
-                                               impl=hist_impl))
+            hist_local = build_histograms(bins, gh, row_slot,
+                                          num_slots=L, num_bins=B,
+                                          impl=hist_impl)
+            hist_left, lvl_valid = _exchange(hist_local, tree2.leaf_value)
 
-            # scatter: pool[l] = left hist, pool[new] = parent - left
+            # scatter: pool[l] = left hist, pool[new] = parent - left;
+            # validity follows (sibling subtraction only holds where BOTH
+            # the parent and this level's exchange are globally summed)
             gathered_left = hist_left[jnp.where(selected, k_of_leaf, 0)]
             parent_hist = pool[jnp.where(selected, slots, 0)]
+            parent_val = pool_valid[jnp.where(selected, slots, 0)]
             pool2 = _masked_scatter(pool, slots, gathered_left, selected)
             pool2 = _masked_scatter(pool2, new_of_leaf,
                                     parent_hist - gathered_left, selected)
+            lvl_valid_rows = jnp.broadcast_to(lvl_valid[None, :], (L, F))
+            pv2 = _masked_scatter(pool_valid, slots, lvl_valid_rows,
+                                  selected)
+            pv2 = _masked_scatter(pv2, new_of_leaf,
+                                  parent_val & lvl_valid_rows, selected)
 
             # --- leaf stats ---
             def upd2(arr, lv, rv):
@@ -416,19 +511,19 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 leaf_depth=upd2(tree2.leaf_depth, new_depth, new_depth),
             )
 
-            best2 = all_best(pool2, tree2)
+            best2 = all_best(pool2, tree2, pv2)
             active = jnp.arange(L) < tree2.num_leaves
             best2 = best2._replace(gain=jnp.where(active, best2.gain, NEG_INF))
-            return (tree2, row_leaf2, pool2, best2, lpn2, lil2,
+            return (tree2, row_leaf2, pool2, pv2, best2, lpn2, lil2,
                     num_nodes + n_sel)
 
         carry2 = jax.lax.cond(n_sel > 0, do_level, lambda op: op,
-                              (tree, row_leaf, pool, best, lpn, lil,
-                               num_nodes))
+                              (tree, row_leaf, pool, pool_valid, best, lpn,
+                               lil, num_nodes))
         return carry2, None
 
-    carry = (tree, row_leaf, pool, best, leaf_parent_node, leaf_is_left,
-             num_nodes)
-    (tree, row_leaf, pool, best, _, _, _), _ = jax.lax.scan(
+    carry = (tree, row_leaf, pool, pool_valid, best, leaf_parent_node,
+             leaf_is_left, num_nodes)
+    (tree, row_leaf, pool, _, best, _, _, _), _ = jax.lax.scan(
         level, carry, None, length=n_levels)
     return tree, row_leaf

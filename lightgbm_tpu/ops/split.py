@@ -143,13 +143,15 @@ def best_numerical_split(hist: jax.Array, num_bin_per_feat: jax.Array,
         parent_output)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(jax.jit,
+                   static_argnames=("params", "per_feature_gains"))
 def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
                             cnt: jax.Array, num_bin_per_feat: jax.Array,
                             missing_type: jax.Array, default_bin: jax.Array,
                             feature_mask: jax.Array, monotone: jax.Array,
                             params: SplitParams,
-                            parent_output: jax.Array) -> BestSplit:
+                            parent_output: jax.Array,
+                            per_feature_gains: bool = False) -> BestSplit:
     """Best numerical split per slot (channel-major inputs — TPU relayouts
     of channel-minor ``[..., 3]`` arrays are expensive, so the hot path keeps
     grad/hess/count as separate ``[S, F, B]`` planes).
@@ -167,6 +169,11 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
     """
     S, F, B = grad.shape
     p = params
+    # feature_mask may be [F] (global) or [S, F] (per-slot validity, used
+    # by the voting-parallel learner whose shards only hold globally-summed
+    # histograms for vote-winning features)
+    fm3 = (feature_mask[None, :, None] if feature_mask.ndim == 1
+           else feature_mask[:, :, None])
 
     t_iota = jnp.arange(B, dtype=jnp.int32)[None, None, :]
     nb = num_bin_per_feat[None, :, None]          # [1,F,1]
@@ -226,7 +233,7 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
               & (right_c >= p.min_data_in_leaf)
               & (left_h >= p.min_sum_hessian_in_leaf)
               & (right_h >= p.min_sum_hessian_in_leaf)
-              & feature_mask[None, :, None])
+              & fm3)
 
         gains = (leaf_gain(left_g, left_h, p, left_c, parent_out)
                  + leaf_gain(right_g, right_h, p, right_c, parent_out))
@@ -274,6 +281,10 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
     g_best = jnp.where(use_fwd, g_fwd, g_rev)
     stats = [jnp.where(use_fwd, a, b) for a, b in zip(s_fwd, s_rev)]
     default_left = ~use_fwd
+    if per_feature_gains:
+        # voting-parallel wants the [S, F] gain plane, not the argmax
+        # (ref: voting_parallel_tree_learner.cpp:151 votes by local gain)
+        return g_best
 
     # across features: first feature wins ties (argmax picks first max)
     f_best = jnp.argmax(g_best, axis=1)                              # [S]
@@ -435,7 +446,9 @@ def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
     use_rev = g_rev > g_fwd
     g_sorted = jnp.where(use_rev, g_rev, g_fwd)
     g_feat = jnp.where(onehot_allowed, g1, g_sorted)   # [S, F]
-    g_feat = jnp.where(cat_feature_mask[None, :], g_feat, K_MIN_SCORE)
+    cfm = (cat_feature_mask[None, :] if cat_feature_mask.ndim == 1
+           else cat_feature_mask)
+    g_feat = jnp.where(cfm, g_feat, K_MIN_SCORE)
     f_best = jnp.argmax(g_feat, axis=1)                # [S]
     take = lambda a: jnp.take_along_axis(a, f_best[:, None], axis=1)[:, 0]
     gain = take(g_feat)
@@ -512,13 +525,14 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
     FeatureHistogram::FindBestThreshold dispatch on bin_type,
     ref: feature_histogram.hpp:85). ``has_cat`` is static: all-numerical
     datasets skip the categorical scan entirely at trace time."""
+    ic = is_cat[None, :] if feature_mask.ndim == 2 else is_cat
     num = best_numerical_split_cm(
         grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
-        feature_mask & ~is_cat, monotone, params, parent_output)
+        feature_mask & ~ic, monotone, params, parent_output)
     if not has_cat:
         return num
     cat = best_categorical_split_cm(
-        grad, hess, cnt, num_bin_per_feat, feature_mask & is_cat, params,
+        grad, hess, cnt, num_bin_per_feat, feature_mask & ic, params,
         parent_output)
     use_cat = cat.gain > num.gain
     merged = [jnp.where(use_cat if a.ndim == 1 else use_cat[:, None], a, b)

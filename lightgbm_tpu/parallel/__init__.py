@@ -17,9 +17,12 @@ over ICI/DCN (SURVEY.md §2.3):
 from .mesh import make_mesh, replicate, shard_rows
 from .data_parallel import (grow_tree_data_parallel, make_sharded_grow_fn,
                             train_step_data_parallel)
+from .tree_parallel import (make_feature_parallel_grow_fn,
+                            make_voting_parallel_grow_fn)
 
 __all__ = [
     "make_mesh", "replicate", "shard_rows",
     "grow_tree_data_parallel", "make_sharded_grow_fn",
     "train_step_data_parallel",
+    "make_feature_parallel_grow_fn", "make_voting_parallel_grow_fn",
 ]

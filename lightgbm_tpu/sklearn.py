@@ -380,6 +380,11 @@ class LGBMClassifier(LGBMModel):
         if self._n_classes > 2:
             self._other_params["num_class"] = self._n_classes
             setattr(self, "num_class", self._n_classes)
+        else:
+            # a previous multiclass fit must not leak its num_class
+            self._other_params.pop("num_class", None)
+            if getattr(self, "num_class", None) is not None:
+                self.num_class = None
         return super().fit(
             X, y, sample_weight=sample_weight, init_score=init_score,
             eval_set=eval_set, eval_names=eval_names,

@@ -265,3 +265,56 @@ def test_depthwise_policy_quality(binary_data):
     bst = lgb.train({"objective": "binary", "grow_policy": "depthwise",
                      "verbose": -1}, lgb.Dataset(Xtr, label=ytr), 30)
     assert roc_auc_score(yte, bst.predict(Xte)) > 0.97
+
+
+def test_snapshot_freq(tmp_path):
+    """snapshot_freq writes periodic checkpoints that load as boosters
+    (ref: gbdt.cpp:279-283)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    out = str(tmp_path / "m.txt")
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "min_data_in_leaf": 5, "snapshot_freq": 2,
+               "output_model": out}, ds, num_boost_round=5)
+    import os
+    snaps = [p for p in os.listdir(tmp_path) if "snapshot_iter_" in p]
+    assert sorted(snaps) == ["m.txt.snapshot_iter_2", "m.txt.snapshot_iter_4"]
+    b = lgb.Booster(model_file=str(tmp_path / "m.txt.snapshot_iter_4"))
+    assert b.num_trees() == 4
+
+
+def test_first_metric_only_checks_all_valid_sets():
+    """With first_metric_only, the FIRST metric is tracked on every valid
+    set; other metrics are ignored (ADVICE round-1 item)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.randn(800, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X[:400], label=y[:400], params={"verbose": -1})
+    v1 = ds.create_valid(X[400:600], label=y[400:600])
+    v2 = ds.create_valid(X[600:], label=y[600:])
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5,
+                     "metric": ["binary_logloss", "auc"],
+                     "early_stopping_round": 3, "first_metric_only": True},
+                    ds, num_boost_round=30, valid_sets=[v1, v2],
+                    valid_names=["v1", "v2"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    # both valid sets were evaluated on the first metric
+    assert "binary_logloss" in evals["v1"] and "binary_logloss" in evals["v2"]
+    # and the CLI-path early stopper tracks the first metric on BOTH valid
+    # sets (GBDT.output_metric, ref: gbdt.cpp:560)
+    g = bst._gbdt
+    g.best_score.clear()
+    g.best_iter.clear()
+    g.output_metric(1)
+    tracked = {k for k in g.best_score}
+    assert ("v1", "binary_logloss") in tracked
+    assert ("v2", "binary_logloss") in tracked
+    assert not any(name == "auc" for _, name in tracked)
