@@ -269,8 +269,8 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
     T = R // C
 
     kernel = functools.partial(_level_kernel, B=B, F_oh=f_oh, Sp=Sp, nch=nch)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=0,
+    hist, new_leaf = pl.pallas_call(
+        kernel,
         grid=(T,),
         in_specs=[
             pl.BlockSpec((Fp, C), lambda t: (0, t)),
@@ -283,15 +283,11 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
             pl.BlockSpec((FB, nch * Sp), lambda t: (0, 0)),
             pl.BlockSpec((1, C), lambda t: (0, t)),
         ],
-        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
-    )
-    hist, new_leaf = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((FB, nch * Sp), jnp.float32),
             jax.ShapeDtypeStruct((1, R), jnp.int32),
         ],
+        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
