@@ -8,6 +8,7 @@ model IO, continued training) is preserved.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -70,6 +71,20 @@ class Dataset:
         if self._inner is not None:
             return self
         cfg = Config(self.params)
+        if isinstance(self.data, (str, os.PathLike)):
+            # file-based ingestion (ref: DatasetLoader::LoadFromFile)
+            from .io.file_loader import load_text_file
+            X, y, side = load_text_file(
+                str(self.data), label_column=self.params.get("label_column"))
+            self.data = X
+            if self.label is None and y is not None:
+                self.label = y
+            if self.weight is None and "weight" in side:
+                self.weight = side["weight"]
+            if self.group is None and "group" in side:
+                self.group = side["group"]
+            if self.init_score is None and "init_score" in side:
+                self.init_score = side["init_score"]
         data = _to_2d_numpy(self.data)
         feature_names = None
         if self.feature_name != "auto" and self.feature_name is not None:

@@ -1,0 +1,128 @@
+"""Command-line application.
+
+Behavioral analog of the reference CLI (ref: src/main.cpp:11,
+src/application/application.cpp:31): ``k=v`` arguments plus an optional
+``config=<file>`` (one ``k=v`` per line, ``#`` comments; command-line
+wins), tasks train / predict / refit-free convert paths:
+
+    python -m lightgbm_tpu config=train.conf
+    python -m lightgbm_tpu task=train data=train.csv valid=test.csv \\
+        objective=binary num_iterations=100 output_model=model.txt
+    python -m lightgbm_tpu task=predict data=test.csv \\
+        input_model=model.txt output_result=preds.tsv
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train as _train
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """k=v args + config file (ref: application.cpp:50-83 LoadParameters;
+    command-line overrides the file)."""
+    cli: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise SystemExit(f"unrecognized argument: {a} (expected k=v)")
+        k, v = a.split("=", 1)
+        cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    conf = cli.pop("config", None)
+    if conf:
+        with open(conf) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                params[k.strip()] = v.strip()
+    params.update(cli)
+    return params
+
+
+def run_train(params: Dict[str, str]) -> None:
+    data = params.pop("data", None)
+    if not data:
+        raise SystemExit("task=train requires data=<file>")
+    valid = params.pop("valid", params.pop("valid_data", ""))
+    output_model = params.get("output_model", "LightGBM_model.txt")
+    n_rounds = int(params.get("num_iterations",
+                              params.get("num_boost_round", 100)))
+    train_set = Dataset(data, params=dict(params))
+    valid_sets = []
+    valid_names = []
+    for i, v in enumerate(p for p in valid.split(",") if p):
+        valid_sets.append(Dataset(v, params=dict(params),
+                                  reference=train_set))
+        valid_names.append(f"valid_{i}")
+    booster = _train(dict(params), train_set, num_boost_round=n_rounds,
+                     valid_sets=valid_sets or None,
+                     valid_names=valid_names or None)
+    booster.save_model(output_model)
+    log.info("Finished training; model saved to %s", output_model)
+
+
+def run_predict(params: Dict[str, str]) -> None:
+    data = params.pop("data", None)
+    model = params.pop("input_model", None)
+    if not data or not model:
+        raise SystemExit("task=predict requires data=<file> and "
+                         "input_model=<file>")
+    out_path = params.pop("output_result", "LightGBM_predict_result.txt")
+    booster = Booster(model_file=model)
+    from .io.file_loader import load_text_file
+    # a prediction file may or may not carry the label column; default to
+    # stripping column 0 only when the width says one extra column is
+    # present (the reference requires the same layout as training data)
+    lc = params.get("label_column")
+    X, _, _ = load_text_file(data, label_column=-1 if lc is None else lc)
+    n_feat = booster.num_feature()
+    if lc is None and X.shape[1] == n_feat + 1:
+        X = X[:, 1:]    # training-style file: first column is the label
+    if X.shape[1] != n_feat:
+        raise SystemExit(
+            f"prediction data has {X.shape[1]} columns but the model "
+            f"expects {n_feat} features (pass label_column=... if a "
+            f"label column is present)")
+    preds = booster.predict(
+        X, raw_score=str(params.get("predict_raw_score",
+                                    "false")).lower() == "true",
+        pred_leaf=str(params.get("predict_leaf_index",
+                                 "false")).lower() == "true",
+        pred_contrib=str(params.get("predict_contrib",
+                                    "false")).lower() == "true")
+    np.savetxt(out_path, np.asarray(preds), fmt="%.9g", delimiter="\t")
+    log.info("Finished prediction; results saved to %s", out_path)
+
+
+def main(argv: List[str] = None) -> None:
+    # honor JAX_PLATFORMS deterministically: TPU-terminal environments may
+    # register their platform plugin in a way that outranks the env var
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    params = parse_args(sys.argv[1:] if argv is None else argv)
+    task = params.pop("task", "train")
+    if task == "train":
+        run_train(params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params)
+    elif task == "convert_model":
+        raise SystemExit("convert_model (if-else codegen) is not supported")
+    else:
+        raise SystemExit(f"unknown task: {task}")
+
+
+if __name__ == "__main__":
+    main()

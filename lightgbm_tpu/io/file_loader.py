@@ -1,0 +1,116 @@
+"""File-based dataset ingestion.
+
+Behavioral analog of the reference DatasetLoader text pipeline (ref:
+src/io/dataset_loader.cpp:203 LoadFromFile, parser.cpp format
+auto-detection): CSV/TSV/LibSVM auto-detected, a label column extracted
+(``label_column`` param: index, ``name:<col>``, or LibSVM's implicit first
+column), and the reference's sidecar conventions honored (``<file>.weight``
+one weight per row, ``<file>.query``/``.group`` query sizes,
+``<file>.init`` init scores — ref: src/io/metadata.cpp loaders).
+
+Distributed loading (ref: dataset_loader.cpp:1015 rank partitioning) maps
+to ``rank``/``num_machines``: each host parses only its contiguous row
+slice; bin mappers must then be built from a shared sample or a reference
+dataset so shards agree (TpuDataset(reference=...)).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..native import loader as native
+from ..utils import log
+
+
+def _label_spec(label_column, header_names):
+    """-> column index or None (ref: config.h label_column semantics)."""
+    if label_column in (None, ""):
+        return 0
+    if isinstance(label_column, int):
+        return label_column
+    s = str(label_column)
+    if s.startswith("name:"):
+        name = s[5:]
+        if header_names and name in header_names:
+            return header_names.index(name)
+        raise ValueError(f"label column name '{name}' not in header")
+    return int(s)
+
+
+def load_text_file(path: str, label_column=None, rank: int = 0,
+                   num_machines: int = 1
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
+    """Parse a CSV/TSV/LibSVM file -> (X, label, sidecars).
+
+    sidecars: {"weight": arr?, "group": arr?, "init_score": arr?}
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    sep, n_rows, n_cols, is_libsvm, has_header = native.scan(path)
+    if n_rows == 0:
+        raise ValueError(f"no data rows in {path}")
+
+    header_names = None
+    if has_header:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    header_names = [t.strip() for t in line.split(sep)]
+                    break
+
+    if is_libsvm:
+        X, y = native.parse_libsvm(path, n_rows, n_cols)
+    else:
+        data = native.parse_dense(path, sep, has_header, n_rows, n_cols)
+        li = _label_spec(label_column, header_names)
+        if li is None or li < 0:
+            X, y = data, None        # label_column < 0: no label column
+        elif li >= n_cols:
+            raise ValueError(
+                f"label_column={li} out of range for {n_cols}-column file "
+                f"{path}")
+        else:
+            y = data[:, li].copy()
+            X = np.delete(data, li, axis=1)
+
+    # rank-sharded slice (contiguous, reference pre_partition-style)
+    if num_machines > 1:
+        per = (n_rows + num_machines - 1) // num_machines
+        sl = slice(rank * per, min(n_rows, (rank + 1) * per))
+        X = X[sl]
+        y = None if y is None else y[sl]
+    else:
+        sl = slice(0, n_rows)
+
+    side = {}
+    for suffix, key in ((".weight", "weight"), (".query", "group"),
+                        (".group", "group"), (".init", "init_score")):
+        sp = path + suffix
+        if os.path.exists(sp):
+            vals = np.loadtxt(sp, dtype=np.float64, ndmin=1)
+            if key == "group":
+                if num_machines > 1:
+                    # shard whole queries: keep those whose rows fall in
+                    # this rank's slice (ref: metadata.cpp CheckOrPartition)
+                    ends = np.cumsum(vals.astype(np.int64))
+                    starts = ends - vals.astype(np.int64)
+                    keep = (starts >= sl.start) & (ends <= sl.stop)
+                    if not keep.any() or                             int(vals[keep].sum()) != sl.stop - sl.start:
+                        log.warning(
+                            "rank %d row slice cuts through query "
+                            "boundaries; group sizes clipped to the slice",
+                            rank)
+                        clipped = (np.minimum(ends, sl.stop)
+                                   - np.maximum(starts, sl.start))
+                        side[key] = clipped[clipped > 0]
+                    else:
+                        side[key] = vals[keep].astype(np.int64)
+                else:
+                    side[key] = vals.astype(np.int64)
+            else:
+                side[key] = vals[sl]
+            log.info("Loaded %s from %s", key, sp)
+    return X, y, side

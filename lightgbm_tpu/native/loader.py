@@ -1,0 +1,215 @@
+"""ctypes bridge to the native text parser (parser.cpp).
+
+Compiles the shared library on first use with the system toolchain and
+caches it next to the source (the image bakes g++ but not pybind11, so the
+binding layer is plain ctypes per the C ABI in parser.cpp). A pure-numpy
+fallback keeps file loading functional without a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libparser.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_HERE, "parser.cpp")
+    if os.path.exists(_SO_PATH) and \
+            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return _SO_PATH
+    try:
+        # build to a process-unique temp path, then atomically rename so a
+        # concurrent process can never dlopen a half-written .so
+        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
+             "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
+        return _SO_PATH
+    except Exception as e:  # no toolchain / sandboxed build dir
+        log.warning("native parser build failed (%s); using the slower "
+                    "numpy text parser", e)
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.lgbt_scan.restype = ctypes.c_int
+        lib.lgbt_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.lgbt_parse_dense.restype = ctypes.c_int
+        lib.lgbt_parse_dense.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+        lib.lgbt_parse_libsvm.restype = ctypes.c_int
+        lib.lgbt_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+        _LIB = lib
+        return _LIB
+
+
+def scan(path: str) -> Tuple[str, int, int, bool, bool]:
+    """(sep, n_rows, n_cols, is_libsvm, has_header) for a text file."""
+    lib = get_lib()
+    if lib is not None:
+        sep = ctypes.c_char(b",")
+        rows = ctypes.c_int64(0)
+        cols = ctypes.c_int64(0)
+        is_svm = ctypes.c_int(0)
+        header = ctypes.c_int(0)
+        rc = lib.lgbt_scan(path.encode(), ctypes.byref(sep),
+                           ctypes.byref(rows), ctypes.byref(cols),
+                           ctypes.byref(is_svm), ctypes.byref(header))
+        if rc != 0:
+            raise IOError(f"cannot scan {path} (rc={rc})")
+        return (sep.value.decode(), rows.value, cols.value,
+                bool(is_svm.value), bool(header.value))
+    return _scan_numpy(path)
+
+
+def parse_dense(path: str, sep: str, has_header: bool, n_rows: int,
+                n_cols: int) -> np.ndarray:
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty((n_rows, n_cols), np.float32)
+        rc = lib.lgbt_parse_dense(
+            path.encode(), sep.encode(), int(has_header),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_rows, n_cols)
+        if rc != 0:
+            raise IOError(f"cannot parse {path} (rc={rc})")
+        return out
+    return _parse_dense_numpy(path, sep, has_header)
+
+
+def parse_libsvm(path: str, n_rows: int,
+                 n_cols: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(X [n_rows, n_cols-1], label [n_rows]) — file column 0 is the
+    label; zeros are implicit (LibSVM sparse convention)."""
+    lib = get_lib()
+    n_feat = n_cols - 1
+    if lib is not None:
+        out = np.empty((n_rows, n_feat), np.float32)
+        lab = np.empty((n_rows,), np.float32)
+        rc = lib.lgbt_parse_libsvm(
+            path.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_rows, n_feat)
+        if rc != 0:
+            raise IOError(f"cannot parse {path} (rc={rc})")
+        return out, lab
+    return _parse_libsvm_numpy(path, n_rows, n_feat)
+
+
+# ---------------------------------------------------------------- fallbacks
+def _scan_numpy(path: str):
+    sep, rows, cols, libsvm, header = ",", 0, 0, False, False
+    with open(path) as f:
+        first = True
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if first:
+                if "\t" in line:
+                    sep = "\t"
+                elif "," in line:
+                    sep = ","
+                else:
+                    sep = " "
+                toks = line.split() if sep == " " else line.split(sep)
+                if len(toks) > 1 and ":" in toks[1] and \
+                        toks[1].split(":")[0].isdigit():
+                    libsvm, sep = True, " "
+                if not libsvm:
+                    def num(t):
+                        try:
+                            float(t or "nan")
+                            return True
+                        except ValueError:
+                            return t.lower() in ("na", "nan", "null", "none",
+                                                 "")
+                    header = not all(num(t) for t in toks)
+                first = False
+                if header:
+                    continue
+            rows += 1
+            if libsvm:
+                for t in line.split()[1:]:
+                    if ":" in t:
+                        cols = max(cols, int(t.split(":")[0]) + 1)
+            else:
+                cols = max(cols, len(line.split(sep)))
+    return sep, rows, (cols + 1 if libsvm else cols), libsvm, header
+
+
+def _parse_dense_numpy(path: str, sep: str, has_header: bool) -> np.ndarray:
+    rows = []
+    with open(path) as f:
+        first = True
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if first and has_header:
+                first = False
+                continue
+            first = False
+            vals = []
+            for t in line.split(sep):
+                t = t.strip()
+                try:
+                    vals.append(float(t))
+                except ValueError:
+                    vals.append(np.nan)
+            rows.append(vals)
+    n_cols = max(len(r) for r in rows)
+    out = np.full((len(rows), n_cols), np.nan, np.float32)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return out
+
+
+def _parse_libsvm_numpy(path: str, n_rows: int, n_feat: int):
+    X = np.zeros((n_rows, n_feat), np.float32)
+    y = np.zeros((n_rows,), np.float32)
+    i = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            toks = line.split()
+            y[i] = float(toks[0])
+            for t in toks[1:]:
+                k, v = t.split(":")
+                k = int(k)
+                if 0 <= k < n_feat:
+                    X[i, k] = float(v)
+            i += 1
+    return X, y
