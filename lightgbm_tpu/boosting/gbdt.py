@@ -129,6 +129,24 @@ class GBDT:
         self.meta = feature_meta_from_dataset(train_data)
         self.has_cat = bool(np.any(
             train_data.is_categorical[train_data.used_features]))
+        self.use_mono_bounds = bool(np.any(np.asarray(self.meta.monotone)
+                                           != 0))
+        # NOTE: computed before _setup_engine so the frontier-v1 fallback
+        # sees them
+        ic = config.interaction_constraints
+        bynode = float(config.feature_fraction_bynode)
+        self.use_node_masks = bool(ic) or (0.0 < bynode < 1.0)
+        self.node_masks = None
+        if self.use_node_masks:
+            from ..models.learner import make_node_mask_cfg
+            # constraints are in REAL feature indices; map to inner
+            inner_ic = []
+            for g in (ic or []):
+                gi = [train_data.inner_feature_index(int(f)) for f in g]
+                inner_ic.append([f for f in gi if f >= 0])
+            self.node_masks = make_node_mask_cfg(
+                train_data.num_features, inner_ic, bynode,
+                int(config.feature_fraction_seed) + 12345)
         self.bins_dev = jnp.asarray(train_data.bins)
         # the fused/Pallas paths are the TPU throughput modes; leafwise is
         # the exact reference-parity mode (and the CPU default)
@@ -180,9 +198,7 @@ class GBDT:
         self.early_stopping_round = int(config.early_stopping_round)
         self.es_first_metric_only = bool(config.first_metric_only)
 
-        if config.feature_fraction_bynode < 1.0:
-            log.warning("feature_fraction_bynode is not supported yet on the "
-                        "TPU learner; using per-tree feature_fraction only")
+
 
     # ------------------------------------------------------------------
     def _setup_engine(self, config: Config) -> None:
@@ -199,9 +215,12 @@ class GBDT:
                              and HAS_PALLAS
                              and config.tpu_histogram_impl
                              in ("auto", "pallas"))
-        if self.use_frontier and self.has_cat:
-            log.warning("tpu_engine=frontier has no categorical support; "
-                        "using the fused engine")
+        needs_v2 = (self.has_cat or getattr(self, "use_mono_bounds", False)
+                    or getattr(self, "use_node_masks", False))
+        if self.use_frontier and needs_v2:
+            log.warning("tpu_engine=frontier supports neither categorical "
+                        "features, monotone bounds, nor interaction/bynode "
+                        "constraints; using the fused engine")
             self.use_frontier = False
             self.use_fused = True
             self.fused_interpret = not self.on_tpu
@@ -439,7 +458,11 @@ class GBDT:
                 self.fused_f_oh, num_rows=n, nch=self.fused_nch,
                 max_depth=int(self.config.max_depth),
                 extra_levels=int(self.config.tpu_extra_levels),
-                has_cat=self.has_cat, interpret=self.fused_interpret)
+                has_cat=self.has_cat,
+                use_mono_bounds=self.use_mono_bounds,
+                use_node_masks=self.use_node_masks,
+                node_masks=self._node_masks_padded(),
+                interpret=self.fused_interpret)
             return tree, row_leaf[:n]
         if self.use_frontier:
             from ..models.frontier import grow_tree_frontier
@@ -455,11 +478,42 @@ class GBDT:
                 self.bins_dev, gh, self.meta, fm, self.params,
                 self.max_leaves, self.max_bins,
                 int(self.config.max_depth),
-                hist_impl=self._xla_hist_impl(), has_cat=self.has_cat)
+                hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
+                use_mono_bounds=self.use_mono_bounds,
+                use_node_masks=self.use_node_masks,
+                node_masks=self._node_masks_for_iter())
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
-            hist_impl=self._xla_hist_impl(), has_cat=self.has_cat)
+            hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
+            use_mono_bounds=self.use_mono_bounds,
+            use_node_masks=self.use_node_masks,
+            node_masks=self._node_masks_for_iter())
+
+    def _node_masks_for_iter(self):
+        """Per-tree bynode randomness: fold the boosting iteration into the
+        sampling key so each tree draws fresh per-node feature subsets."""
+        if self.node_masks is None:
+            return None
+        import jax.random as jrandom
+        return self.node_masks._replace(
+            key=jrandom.fold_in(self.node_masks.key, self.iter))
+
+    def _node_masks_padded(self):
+        """NodeMaskCfg padded to the fused engine's f_oh feature count,
+        with the per-tree key fold."""
+        if self.node_masks is None:
+            return None
+        from ..models.learner import NodeMaskCfg
+        nm = self._node_masks_for_iter()
+        F_oh = self.fused_f_oh
+        F = nm.group_feat.shape[1]
+        if F == F_oh:
+            return nm
+        gf = jnp.zeros((nm.group_feat.shape[0], F_oh), bool) \
+            .at[:, :F].set(nm.group_feat)
+        gwf = jnp.zeros((F_oh,), jnp.int32).at[:F].set(nm.groups_with_f)
+        return NodeMaskCfg(gf, gwf, nm.bynode_k, nm.key)
 
     def _xla_hist_impl(self) -> str:
         impl = self.config.tpu_histogram_impl

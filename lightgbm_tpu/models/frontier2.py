@@ -37,7 +37,8 @@ from ..ops.fused_level import (NCH_PRECISE, build_route_table, hist_planes,
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output)
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
-                      meta_is_cat)
+                      meta_is_cat, mono_child_bounds, node_feature_mask,
+                      update_leaf_groups)
 from .tree import TreeArrays, empty_tree
 
 
@@ -106,13 +107,15 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "f_oh", "num_rows",
                      "nch", "max_depth", "extra_levels", "has_cat",
-                     "interpret"))
+                     "use_mono_bounds", "use_node_masks", "interpret"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
                     num_rows: int = 0, nch: int = NCH_PRECISE,
                     max_depth: int = -1, extra_levels: int = 3,
-                    has_cat: bool = False, interpret: bool = False,
+                    has_cat: bool = False, use_mono_bounds: bool = False,
+                    use_node_masks: bool = False, node_masks=None,
+                    interpret: bool = False,
                     ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with fused level passes.
 
@@ -173,10 +176,19 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         leaf_count=tree.leaf_count.at[0].set(root_c),
         leaf_weight=tree.leaf_weight.at[0].set(root_h))
 
+    leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
+    leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
+    leaf_groups = jnp.full((L,), -1, jnp.int32)
+    root_mask = feature_mask[None, :]
+    if use_node_masks:
+        root_mask = root_mask & node_feature_mask(
+            node_masks, leaf_groups[:1], jnp.zeros((1,), jnp.int32))
     root_best = best_split_cm(
         g0[:1], h0[:1], c0[:1], meta.num_bin, meta.missing_type,
-        meta.default_bin, feature_mask, meta_is_cat(meta), meta.monotone,
-        params, tree.leaf_value[:1], has_cat=has_cat)
+        meta.default_bin, root_mask, meta_is_cat(meta), meta.monotone,
+        params, tree.leaf_value[:1], has_cat=has_cat,
+        use_bounds=use_mono_bounds, bound_lo=leaf_lo[:1],
+        bound_hi=leaf_hi[:1], leaf_depth=tree.leaf_depth[:1])
     best = BestSplit(*[jnp.zeros((L,) + a.shape[1:], a.dtype).at[0].set(a[0])
                        for a in root_best])
     best = best._replace(gain=best.gain.at[1:].set(NEG_INF))
@@ -184,18 +196,22 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     lpn = jnp.full((L,), -1, jnp.int32)   # leaf -> parent node
     lil = jnp.zeros((L,), bool)           # leaf is left child of its parent
 
-    state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil)
-    for S_d in caps:
+    state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
+             leaf_lo, leaf_hi, leaf_groups)
+    for li, S_d in enumerate(caps):
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
-                           interpret)
+                           use_mono_bounds, use_node_masks, node_masks,
+                           li + 1, interpret)
     tree, leaf_T = state[0], state[1]
     return tree, leaf_T[0]
 
 
 def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
-               S_d, nch, max_depth, has_cat, interpret):
-    (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil) = state
+               S_d, nch, max_depth, has_cat, use_mono_bounds,
+               use_node_masks, node_masks, fold, interpret):
+    (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
+     leaf_lo, leaf_hi, leaf_groups) = state
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
 
@@ -208,7 +224,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
     n_sel = jnp.sum(selected.astype(jnp.int32))
 
     def do_level(op):
-        (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil) = op
+        (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
+         leaf_lo, leaf_hi, leaf_groups) = op
         sel_i32 = selected.astype(jnp.int32)
         k_of_leaf = jnp.cumsum(sel_i32) - sel_i32
         new_of_leaf = jnp.where(selected, tree.num_leaves + k_of_leaf, -1)
@@ -325,22 +342,52 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         ch_g = jnp.concatenate([left_g, right_g], axis=0)
         ch_h = jnp.concatenate([left_h, right_h], axis=0)
         ch_c = jnp.concatenate([left_c, right_c], axis=0)
+        if use_mono_bounds:
+            mono_dir = jnp.where(best.feature >= 0,
+                                 meta.monotone[jnp.maximum(best.feature, 0)],
+                                 0)
+            leaf_lo2, leaf_hi2 = mono_child_bounds(
+                leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
+                best.left_output, best.right_output,
+                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
+            ch_lo = jnp.concatenate([leaf_lo2[lof_safe], leaf_lo2[new_s]])
+            ch_hi = jnp.concatenate([leaf_hi2[lof_safe], leaf_hi2[new_s]])
+        else:
+            leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
+            ch_lo = ch_hi = None
+        ch_mask = feature_mask[None, :]
+        if use_node_masks:
+            leaf_groups2 = update_leaf_groups(
+                node_masks, leaf_groups, best.feature, selected,
+                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
+            ch_groups = jnp.concatenate([leaf_groups2[lof_safe],
+                                         leaf_groups2[new_s]])
+            # per-node sampling identity: creating node id + side bit
+            ch_ids = jnp.concatenate([2 * (node_of_leaf[lof_safe] + 1) + 1,
+                                      2 * (node_of_leaf[lof_safe] + 1)])
+            ch_mask = ch_mask & node_feature_mask(node_masks, ch_groups,
+                                                  ch_ids)
+        else:
+            leaf_groups2 = leaf_groups
+        ch_depth = jnp.concatenate([tree2.leaf_depth[lof_safe],
+                                    tree2.leaf_depth[new_s]])
         bs = best_split_cm(
             ch_g, ch_h, ch_c, meta.num_bin, meta.missing_type,
-            meta.default_bin, feature_mask, meta_is_cat(meta), meta.monotone,
+            meta.default_bin, ch_mask, meta_is_cat(meta), meta.monotone,
             params, jnp.concatenate([left_out, right_out]),
-            has_cat=has_cat)
+            has_cat=has_cat, use_bounds=use_mono_bounds, bound_lo=ch_lo,
+            bound_hi=ch_hi, leaf_depth=ch_depth)
         left_bs = BestSplit(*[a[:Sp] for a in bs])
         right_bs = BestSplit(*[a[Sp:] for a in bs])
         best2 = _merge_best_many(best, lof_safe, left_bs, lof_on)
         best2 = _merge_best_many(best2, new_s, right_bs, lof_on)
 
         return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2, lpn2,
-                lil2)
+                lil2, leaf_lo2, leaf_hi2, leaf_groups2)
 
     return jax.lax.cond(n_sel > 0, do_level, lambda op: op,
                         (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn,
-                         lil))
+                         lil, leaf_lo, leaf_hi, leaf_groups))
 
 
 def add_leaf_values_to_score(score: jax.Array, row_leaf: jax.Array,

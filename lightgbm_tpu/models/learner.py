@@ -52,14 +52,121 @@ def meta_is_cat(meta: "FeatureMeta") -> jax.Array:
 
 def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
                params: SplitParams, parent_output: jax.Array,
-               has_cat: bool = False) -> BestSplit:
+               has_cat: bool = False, use_bounds: bool = False,
+               bound_lo=None, bound_hi=None, leaf_depth=None) -> BestSplit:
     """Channel-minor convenience wrapper over the combined numerical +
     categorical scan (ref: feature_histogram.hpp:85 FindBestThreshold)."""
     return best_split_cm(
         hist[..., 0], hist[..., 1], hist[..., 2], meta.num_bin,
         meta.missing_type, meta.default_bin, feature_mask,
         meta_is_cat(meta), meta.monotone, params, parent_output,
-        has_cat=has_cat)
+        has_cat=has_cat, use_bounds=use_bounds, bound_lo=bound_lo,
+        bound_hi=bound_hi, leaf_depth=leaf_depth)
+
+
+class NodeMaskCfg(NamedTuple):
+    """Per-node feature-mask machinery (ref: col_sampler.hpp:20 ColSampler
+    — interaction-constraint filtering + feature_fraction_bynode).
+
+    group_feat: [G, F] bool — constraint groups (one all-True row when no
+      interaction constraints).
+    groups_with_f: [F] int32 — bitmask of groups containing each feature.
+    bynode_k: int32 scalar — features sampled per node (0 = off).
+    key: jax PRNG key for by-node sampling.
+    """
+    group_feat: jax.Array
+    groups_with_f: jax.Array
+    bynode_k: jax.Array
+    key: jax.Array
+
+
+def make_node_mask_cfg(num_features: int, interaction_constraints,
+                       bynode_fraction: float, seed: int) -> NodeMaskCfg:
+    import numpy as _np
+    groups = [list(g) for g in (interaction_constraints or [])]
+    if not groups:
+        gf = _np.ones((1, num_features), bool)
+    else:
+        if len(groups) > 31:
+            raise ValueError("at most 31 interaction constraint groups are "
+                             "supported")
+        gf = _np.zeros((len(groups), num_features), bool)
+        for gi, g in enumerate(groups):
+            for f in g:
+                if 0 <= int(f) < num_features:
+                    gf[gi, int(f)] = True
+    gwf = _np.zeros((num_features,), _np.int32)
+    for gi in range(gf.shape[0]):
+        gwf |= _np.where(gf[gi], _np.int32(1 << gi), 0).astype(_np.int32)
+    k = 0
+    if 0.0 < bynode_fraction < 1.0:
+        k = max(1, int(round(num_features * bynode_fraction)))
+    return NodeMaskCfg(
+        group_feat=jnp.asarray(gf),
+        groups_with_f=jnp.asarray(gwf),
+        bynode_k=jnp.int32(k),
+        key=jax.random.PRNGKey(seed))
+
+
+def node_feature_mask(cfg: NodeMaskCfg, leaf_groups: jax.Array,
+                      node_ids: jax.Array) -> jax.Array:
+    """[L, F] allowed-feature mask for each leaf: union of the constraint
+    groups still compatible with the leaf's path, intersected with a
+    per-NODE random feature sample when bynode_k > 0 (``node_ids`` [L]
+    identify the node each leaf was created by, so a leaf's sample is
+    stable for its whole lifetime — per-node semantics like the
+    reference's ColSampler, not a per-level re-roll)."""
+    G, F = cfg.group_feat.shape
+    L = leaf_groups.shape[0]
+    bits = ((leaf_groups[:, None] >> jnp.arange(G, dtype=jnp.int32)) & 1
+            ).astype(jnp.float32)                              # [L, G]
+    allowed = (bits @ cfg.group_feat.astype(jnp.float32)) > 0  # [L, F]
+    k = cfg.bynode_k
+
+    def with_bynode(allowed):
+        keys = jax.vmap(lambda nid: jax.random.fold_in(cfg.key, nid))(
+            node_ids.astype(jnp.int32))
+        r = jax.vmap(lambda kk: jax.random.uniform(kk, (F,)))(keys)
+        r = jnp.where(allowed, r, jnp.inf)
+        kth = jnp.sort(r, axis=1)[
+            jnp.arange(L), jnp.clip(k - 1, 0, F - 1)]
+        return allowed & (r <= kth[:, None])
+
+    return jax.lax.cond(k > 0, with_bynode, lambda a: a, allowed)
+
+
+def update_leaf_groups(cfg: NodeMaskCfg, leaf_groups, split_feature,
+                       sel, left_idx, new_idx):
+    """Child group-compatibility bitmasks: parent & groups containing the
+    split feature (both children take the same narrowed set)."""
+    f_safe = jnp.maximum(split_feature, 0)
+    child = leaf_groups & jnp.where(split_feature >= 0,
+                                    cfg.groups_with_f[f_safe], -1)
+    if left_idx is not None:
+        out = _masked_scatter(leaf_groups, left_idx, child, sel)
+    else:
+        out = jnp.where(sel, child, leaf_groups)
+    return _masked_scatter(out, new_idx, child, sel)
+
+
+def mono_child_bounds(lo, hi, new_lo, new_hi, sel, mono_dir,
+                      left_output, right_output, left_idx, new_idx):
+    """Per-leaf monotone bound update at split time (ref:
+    monotone_constraints.hpp:546-556 UpdateConstraintsWithOutputs):
+    m>0: left.upper <- min(., right_out), right.lower <- max(., left_out);
+    m<0 mirrored. Non-monotone splits pass bounds through. All arrays [L];
+    ``sel`` masks the leaves actually split this step."""
+    par_lo = lo[left_idx] if left_idx is not None else lo
+    par_hi = hi[left_idx] if left_idx is not None else hi
+    l_hi = jnp.where(mono_dir > 0, jnp.minimum(par_hi, right_output), par_hi)
+    l_lo = jnp.where(mono_dir < 0, jnp.maximum(par_lo, right_output), par_lo)
+    r_lo = jnp.where(mono_dir > 0, jnp.maximum(par_lo, left_output), par_lo)
+    r_hi = jnp.where(mono_dir < 0, jnp.minimum(par_hi, left_output), par_hi)
+    lo2 = _masked_scatter(new_lo, left_idx, l_lo, sel)         if left_idx is not None else jnp.where(sel, l_lo, new_lo)
+    hi2 = _masked_scatter(new_hi, left_idx, l_hi, sel)         if left_idx is not None else jnp.where(sel, l_hi, new_hi)
+    lo2 = _masked_scatter(lo2, new_idx, r_lo, sel)
+    hi2 = _masked_scatter(hi2, new_idx, r_hi, sel)
+    return lo2, hi2
 
 
 def _route_left(bins_col: jax.Array, t: jax.Array, default_left: jax.Array,
@@ -131,12 +238,15 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
-                     "hist_impl", "psum_axis", "has_cat"))
+                     "hist_impl", "psum_axis", "has_cat",
+                     "use_mono_bounds", "use_node_masks"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
                        hist_impl: str = "auto", psum_axis: str = None,
-                       has_cat: bool = False,
+                       has_cat: bool = False, use_mono_bounds: bool = False,
+                       use_node_masks: bool = False,
+                       node_masks: "NodeMaskCfg" = None,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -174,9 +284,24 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         leaf_count=tree.leaf_count.at[0].set(root_c),
         leaf_weight=tree.leaf_weight.at[0].set(root_h))
 
+    leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
+    leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
+    leaf_groups = jnp.full((L,), -1, jnp.int32)
+
+    def _scan_mask(lg_rows, node_ids):
+        m = feature_mask[None, :] if feature_mask.ndim == 1 else feature_mask
+        if use_node_masks:
+            m = m & node_feature_mask(node_masks, lg_rows, node_ids)
+        return jnp.broadcast_to(m, (lg_rows.shape[0],
+                                    meta.num_bin.shape[0]))
+
     root_best = best_split(
-        pool[:1], meta, feature_mask, params, tree.leaf_value[:1],
-        has_cat=has_cat)
+        pool[:1], meta,
+        _scan_mask(leaf_groups[:1], jnp.zeros((1,), jnp.int32)), params,
+        tree.leaf_value[:1],
+        has_cat=has_cat, use_bounds=use_mono_bounds,
+        bound_lo=leaf_lo[:1], bound_hi=leaf_hi[:1],
+        leaf_depth=tree.leaf_depth[:1])
     best = BestSplit(*[jnp.zeros((L,) + a.shape[1:], a.dtype).at[0].set(a[0])
                        for a in root_best])
     best = best._replace(gain=best.gain.at[1:].set(NEG_INF))
@@ -187,14 +312,16 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     State = Tuple  # (tree, row_leaf, pool, best, parent_node, is_left)
 
     def body(i, state):
-        tree, row_leaf, pool, best, lpn, lil = state
+        (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
+         leaf_groups) = state
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         l = jnp.argmax(gains).astype(jnp.int32)
         do_split = gains[l] > 0.0
 
         def split_branch(op):
-            tree, row_leaf, pool, best, lpn, lil = op
+            (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
+             leaf_groups) = op
             new = tree.num_leaves
             f = best.feature[l]
             t = best.threshold[l]
@@ -260,20 +387,62 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             pool2 = pool2.at[new].set(jnp.where(target_is_left, hist_sib,
                                                 hist_t))
 
+            # --- monotone bound propagation for the two children ---
+            if use_mono_bounds:
+                mono_d = jnp.where(f >= 0, meta.monotone[jnp.maximum(f, 0)],
+                                   0)
+                p_lo, p_hi = leaf_lo[l], leaf_hi[l]
+                l_hi = jnp.where(mono_d > 0,
+                                 jnp.minimum(p_hi, best.right_output[l]),
+                                 p_hi)
+                l_lo = jnp.where(mono_d < 0,
+                                 jnp.maximum(p_lo, best.right_output[l]),
+                                 p_lo)
+                r_lo = jnp.where(mono_d > 0,
+                                 jnp.maximum(p_lo, best.left_output[l]),
+                                 p_lo)
+                r_hi = jnp.where(mono_d < 0,
+                                 jnp.minimum(p_hi, best.left_output[l]),
+                                 p_hi)
+                leaf_lo2 = leaf_lo.at[l].set(l_lo).at[new].set(r_lo)
+                leaf_hi2 = leaf_hi.at[l].set(l_hi).at[new].set(r_hi)
+            else:
+                leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
+
+            # --- interaction-group narrowing for the two children ---
+            if use_node_masks:
+                child_g = leaf_groups[l] & jnp.where(
+                    f >= 0, node_masks.groups_with_f[jnp.maximum(f, 0)], -1)
+                leaf_groups2 = leaf_groups.at[l].set(child_g) \
+                    .at[new].set(child_g)
+            else:
+                leaf_groups2 = leaf_groups
+
             # --- child best splits ---
             child_hist = jnp.stack([pool2[l], pool2[new]])
             parent_out2 = jnp.stack([tree2.leaf_value[l],
                                      tree2.leaf_value[new]])
-            bs2 = best_split(child_hist, meta, feature_mask, params,
-                             parent_out2, has_cat=has_cat)
+            bs2 = best_split(
+                child_hist, meta,
+                _scan_mask(jnp.stack([leaf_groups2[l], leaf_groups2[new]]),
+                           jnp.stack([2 * (i + 1) + 1, 2 * (i + 1)])),
+                params, parent_out2,
+                has_cat=has_cat, use_bounds=use_mono_bounds,
+                bound_lo=jnp.stack([leaf_lo2[l], leaf_lo2[new]]),
+                bound_hi=jnp.stack([leaf_hi2[l], leaf_hi2[new]]),
+                leaf_depth=jnp.stack([tree2.leaf_depth[l],
+                                      tree2.leaf_depth[new]]))
             best2 = _merge_best(best, l, new, bs2)
-            return tree2, row_leaf2, pool2, best2, lpn2, lil2
+            return (tree2, row_leaf2, pool2, best2, lpn2, lil2, leaf_lo2,
+                    leaf_hi2, leaf_groups2)
 
         return jax.lax.cond(do_split, split_branch, lambda op: op,
-                            (tree, row_leaf, pool, best, lpn, lil))
+                            (tree, row_leaf, pool, best, lpn, lil,
+                             leaf_lo, leaf_hi, leaf_groups))
 
-    state = (tree, row_leaf, pool, best, leaf_parent_node, leaf_is_left)
-    tree, row_leaf, pool, best, _, _ = jax.lax.fori_loop(
+    state = (tree, row_leaf, pool, best, leaf_parent_node, leaf_is_left,
+             leaf_lo, leaf_hi, leaf_groups)
+    tree, row_leaf, pool, best, _, _, _, _, _ = jax.lax.fori_loop(
         0, L - 1, body, state)
     return tree, row_leaf
 
@@ -282,7 +451,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat", "parallel_mode",
-                     "top_k"))
+                     "top_k", "use_mono_bounds", "use_node_masks"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -290,7 +459,9 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         has_cat: bool = False, parallel_mode: str = "data",
                         top_k: int = 20, route_bins: jax.Array = None,
                         route_meta: FeatureMeta = None,
-                        feature_offset=None,
+                        feature_offset=None, use_mono_bounds: bool = False,
+                        use_node_masks: bool = False,
+                        node_masks: "NodeMaskCfg" = None,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -373,15 +544,26 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_is_left = jnp.zeros((L,), bool)
     num_nodes = jnp.int32(0)
 
-    def all_best(pool, tree, pool_valid):
-        bs = best_split(pool, meta,
-                        feature_mask[None, :] & pool_valid, params,
-                        tree.leaf_value, has_cat=has_cat)
+    leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
+    leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
+    leaf_groups = jnp.full((L,), -1, jnp.int32)   # all groups compatible
+
+    def all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
+                 node_ids):
+        mask2d = feature_mask[None, :] & pool_valid
+        if use_node_masks:
+            mask2d = mask2d & node_feature_mask(node_masks, leaf_groups,
+                                                node_ids)
+        bs = best_split(pool, meta, mask2d, params,
+                        tree.leaf_value, has_cat=has_cat,
+                        use_bounds=use_mono_bounds, bound_lo=leaf_lo,
+                        bound_hi=leaf_hi, leaf_depth=tree.leaf_depth)
         if parallel_mode == "feature" and psum_axis is not None:
             bs = merge_best_over_shards(bs, psum_axis, feature_offset)
         return bs
 
-    best = all_best(pool, tree, pool_valid)
+    best = all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
+                    jnp.zeros((L,), jnp.int32))
     best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
                                         NEG_INF))
     r_bins = bins if route_bins is None else route_bins
@@ -389,7 +571,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
     def level(carry, _):
         (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-         num_nodes) = carry
+         num_nodes, leaf_lo, leaf_hi, leaf_groups) = carry
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         budget = L - tree.num_leaves
@@ -402,7 +584,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
         def do_level(op):
             (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-             num_nodes) = op
+             num_nodes, leaf_lo, leaf_hi, leaf_groups) = op
             # new leaf ids: k-th selected leaf (by slot order) gets
             # num_leaves + k; node ids num_nodes + k
             sel_i32 = selected.astype(jnp.int32)
@@ -511,19 +693,38 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 leaf_depth=upd2(tree2.leaf_depth, new_depth, new_depth),
             )
 
-            best2 = all_best(pool2, tree2, pv2)
+            if use_mono_bounds:
+                mono_dir = jnp.where(
+                    best.feature >= 0,
+                    meta.monotone[jnp.maximum(best.feature, 0)], 0)
+                leaf_lo2, leaf_hi2 = mono_child_bounds(
+                    leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
+                    best.left_output, best.right_output, slots, new_of_leaf)
+            else:
+                leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
+            if use_node_masks:
+                leaf_groups2 = update_leaf_groups(
+                    node_masks, leaf_groups, best.feature, selected, slots,
+                    new_of_leaf)
+            else:
+                leaf_groups2 = leaf_groups
+            # a leaf's sampling identity: creating node id + side bit
+            node_ids2 = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
+            best2 = all_best(pool2, tree2, pv2, leaf_lo2, leaf_hi2,
+                             leaf_groups2, node_ids2)
             active = jnp.arange(L) < tree2.num_leaves
             best2 = best2._replace(gain=jnp.where(active, best2.gain, NEG_INF))
             return (tree2, row_leaf2, pool2, pv2, best2, lpn2, lil2,
-                    num_nodes + n_sel)
+                    num_nodes + n_sel, leaf_lo2, leaf_hi2, leaf_groups2)
 
         carry2 = jax.lax.cond(n_sel > 0, do_level, lambda op: op,
                               (tree, row_leaf, pool, pool_valid, best, lpn,
-                               lil, num_nodes))
+                               lil, num_nodes, leaf_lo, leaf_hi,
+                               leaf_groups))
         return carry2, None
 
     carry = (tree, row_leaf, pool, pool_valid, best, leaf_parent_node,
-             leaf_is_left, num_nodes)
-    (tree, row_leaf, pool, _, best, _, _, _), _ = jax.lax.scan(
+             leaf_is_left, num_nodes, leaf_lo, leaf_hi, leaf_groups)
+    (tree, row_leaf, pool, _, best, _, _, _, _, _, _), _ = jax.lax.scan(
         level, carry, None, length=n_levels)
     return tree, row_leaf

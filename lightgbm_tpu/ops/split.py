@@ -144,14 +144,19 @@ def best_numerical_split(hist: jax.Array, num_bin_per_feat: jax.Array,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("params", "per_feature_gains"))
+                   static_argnames=("params", "per_feature_gains",
+                                    "use_bounds"))
 def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
                             cnt: jax.Array, num_bin_per_feat: jax.Array,
                             missing_type: jax.Array, default_bin: jax.Array,
                             feature_mask: jax.Array, monotone: jax.Array,
                             params: SplitParams,
                             parent_output: jax.Array,
-                            per_feature_gains: bool = False) -> BestSplit:
+                            per_feature_gains: bool = False,
+                            use_bounds: bool = False,
+                            bound_lo: jax.Array = None,
+                            bound_hi: jax.Array = None,
+                            leaf_depth: jax.Array = None) -> BestSplit:
     """Best numerical split per slot (channel-major inputs — TPU relayouts
     of channel-minor ``[..., 3]`` arrays are expensive, so the hot path keeps
     grad/hess/count as separate ``[S, F, B]`` planes).
@@ -235,12 +240,24 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
               & (right_h >= p.min_sum_hessian_in_leaf)
               & fm3)
 
-        gains = (leaf_gain(left_g, left_h, p, left_c, parent_out)
-                 + leaf_gain(right_g, right_h, p, right_c, parent_out))
-        # local monotone check (ref: GetSplitGains USE_MC branch returns 0)
         mono = monotone[None, :, None]
         lo = calculate_leaf_output(left_g, left_h, p, left_c, parent_out)
         ro = calculate_leaf_output(right_g, right_h, p, right_c, parent_out)
+        if use_bounds:
+            # per-leaf monotone bounds: candidate outputs are clipped into
+            # the leaf's feasible interval and the gain recomputed with the
+            # clipped outputs (ref: monotone_constraints.hpp BasicLeaf
+            # Constraints + feature_histogram GetSplitGains USE_MC)
+            blo = bound_lo[:, None, None]
+            bhi = bound_hi[:, None, None]
+            lo = jnp.clip(lo, blo, bhi)
+            ro = jnp.clip(ro, blo, bhi)
+            gains = (leaf_gain_given_output(left_g, left_h, p, lo)
+                     + leaf_gain_given_output(right_g, right_h, p, ro))
+        else:
+            gains = (leaf_gain(left_g, left_h, p, left_c, parent_out)
+                     + leaf_gain(right_g, right_h, p, right_c, parent_out))
+        # monotone direction check (ref: GetSplitGains USE_MC -> 0)
         viol = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
         gains = jnp.where(viol, 0.0, gains)
         gains = jnp.where(ok & (gains > min_gain_shift), gains, K_MIN_SCORE)
@@ -281,6 +298,22 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
     g_best = jnp.where(use_fwd, g_fwd, g_rev)
     stats = [jnp.where(use_fwd, a, b) for a, b in zip(s_fwd, s_rev)]
     default_left = ~use_fwd
+    if use_bounds and p.monotone_penalty > 0:
+        # depth-based penalty on the NET gain of monotone-feature splits,
+        # after validity gating on the gross gain (ref:
+        # monotone_constraints.hpp:355 ComputeMonotoneSplitGainPenalty,
+        # applied to SplitInfo.gain = best_gain - min_gain_shift)
+        pen = p.monotone_penalty
+        d = leaf_depth[:, None].astype(jnp.float32)
+        factor = jnp.where(
+            pen >= d + 1.0, K_EPSILON,
+            jnp.where(pen <= 1.0,
+                      1.0 - pen / jnp.exp2(d) + K_EPSILON,
+                      1.0 - jnp.exp2(pen - 1.0 - d) + K_EPSILON))
+        shift2 = min_gain_shift[:, :, 0]
+        net = jnp.where(jnp.isfinite(g_best),
+                        (g_best - shift2) * factor + shift2, g_best)
+        g_best = jnp.where(monotone[None, :] != 0, net, g_best)
     if per_feature_gains:
         # voting-parallel wants the [S, F] gain plane, not the argmax
         # (ref: voting_parallel_tree_learner.cpp:151 votes by local gain)
@@ -295,6 +328,9 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
 
     left_out = calculate_leaf_output(lg, lh, p, lc, parent_output)
     right_out = calculate_leaf_output(rg, rh, p, rc, parent_output)
+    if use_bounds:
+        left_out = jnp.clip(left_out, bound_lo, bound_hi)
+        right_out = jnp.clip(right_out, bound_lo, bound_hi)
     out_gain = jnp.where(valid, gain - min_gain_shift[:, 0, 0], K_MIN_SCORE)
     no_flag, no_mask = _no_cat(S, B)
     return BestSplit(
@@ -514,13 +550,16 @@ def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("params", "has_cat"))
+@functools.partial(jax.jit,
+                   static_argnames=("params", "has_cat", "use_bounds"))
 def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
                   num_bin_per_feat: jax.Array, missing_type: jax.Array,
                   default_bin: jax.Array, feature_mask: jax.Array,
                   is_cat: jax.Array, monotone: jax.Array,
                   params: SplitParams, parent_output: jax.Array,
-                  has_cat: bool = False) -> BestSplit:
+                  has_cat: bool = False, use_bounds: bool = False,
+                  bound_lo: jax.Array = None, bound_hi: jax.Array = None,
+                  leaf_depth: jax.Array = None) -> BestSplit:
     """Combined numerical + categorical best split per slot (the analog of
     FeatureHistogram::FindBestThreshold dispatch on bin_type,
     ref: feature_histogram.hpp:85). ``has_cat`` is static: all-numerical
@@ -528,12 +567,21 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
     ic = is_cat[None, :] if feature_mask.ndim == 2 else is_cat
     num = best_numerical_split_cm(
         grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
-        feature_mask & ~ic, monotone, params, parent_output)
+        feature_mask & ~ic, monotone, params, parent_output,
+        use_bounds=use_bounds, bound_lo=bound_lo, bound_hi=bound_hi,
+        leaf_depth=leaf_depth)
     if not has_cat:
         return num
     cat = best_categorical_split_cm(
         grad, hess, cnt, num_bin_per_feat, feature_mask & ic, params,
         parent_output)
+    if use_bounds:
+        # categorical features carry no monotone direction, but the leaf's
+        # feasible output interval still applies (winner-level clamp;
+        # divergence: the reference clips per candidate)
+        cat = cat._replace(
+            left_output=jnp.clip(cat.left_output, bound_lo, bound_hi),
+            right_output=jnp.clip(cat.right_output, bound_lo, bound_hi))
     use_cat = cat.gain > num.gain
     merged = [jnp.where(use_cat if a.ndim == 1 else use_cat[:, None], a, b)
               for a, b in zip(cat, num)]

@@ -30,7 +30,9 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                          max_bins: int, max_depth: int = -1,
                          policy: str = "leafwise", hist_impl: str = "auto",
                          axis_name: str = DATA_AXIS,
-                         has_cat: bool = False):
+                         has_cat: bool = False,
+                         use_mono_bounds: bool = False,
+                         use_node_masks: bool = False, node_masks=None):
     """shard_map-wrapped tree growth: bins/gh row-sharded in, replicated tree
     + row-sharded leaf assignment out. ``has_cat`` enables the categorical
     split scan (pass True whenever the dataset has categorical features —
@@ -41,7 +43,9 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
     def per_shard(bins, gh, meta, feature_mask):
         return grow(bins, gh, meta, feature_mask, params, num_leaves,
                     max_bins, max_depth, hist_impl=hist_impl,
-                    psum_axis=axis_name, has_cat=has_cat)
+                    psum_axis=axis_name, has_cat=has_cat,
+                    use_mono_bounds=use_mono_bounds,
+                    use_node_masks=use_node_masks, node_masks=node_masks)
 
     sharded = shard_map(
         per_shard, mesh=mesh,

@@ -1,0 +1,70 @@
+"""Monotone constraint enforcement with per-leaf bound propagation.
+
+The adversarial case from VERDICT round 1: transitive violations across
+the tree that a local left/right check provably misses (ref:
+monotone_constraints.hpp BasicLeafConstraints + split-time clipping)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _adversarial(R=6000, seed=0):
+    """y rises then falls in x0 (non-monotone), plus a confounder."""
+    rng = np.random.RandomState(seed)
+    x0 = rng.rand(R).astype(np.float32)
+    x1 = rng.rand(R).astype(np.float32)
+    y = (np.sin(3.0 * x0) + 0.3 * x1 + 0.05 * rng.randn(R)) \
+        .astype(np.float32)
+    return np.stack([x0, x1], 1), y
+
+
+def _check_monotone(bst, n_grid=200):
+    """Predictions must be non-decreasing in x0 for any fixed x1."""
+    grid = np.linspace(0.01, 0.99, n_grid).astype(np.float32)
+    worst = 0.0
+    for x1 in (0.1, 0.5, 0.9):
+        X = np.stack([grid, np.full(n_grid, x1, np.float32)], 1)
+        p = bst.predict(X)
+        worst = min(worst, float(np.min(np.diff(p))))
+    return worst
+
+
+@pytest.mark.parametrize("engine,policy", [("xla", "leafwise"),
+                                           ("xla", "depthwise"),
+                                           ("fused", "depthwise")])
+def test_no_transitive_violation(engine, policy):
+    X, y = _adversarial()
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 10,
+                     "monotone_constraints": [1, 0],
+                     "grow_policy": policy, "tpu_engine": engine},
+                    ds, num_boost_round=20)
+    worst = _check_monotone(bst)
+    assert worst >= -1e-6, f"monotone violation: {worst}"
+
+
+def test_unconstrained_is_nonmonotone():
+    """Sanity: without the constraint the same data must violate (the test
+    above is vacuous otherwise)."""
+    X, y = _adversarial()
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 10},
+                    ds, num_boost_round=20)
+    assert _check_monotone(bst) < -1e-3
+
+
+def test_monotone_penalty_discourages_root_split():
+    X, y = _adversarial()
+    # huge penalty: monotone feature splits near the root get ~zeroed
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 10,
+                     "monotone_constraints": [1, 0],
+                     "monotone_penalty": 2.0},
+                    ds, num_boost_round=1)
+    root_feature = bst.dump_model()["tree_info"][0]["tree_structure"] \
+        .get("split_feature")
+    assert root_feature == 1  # x1 (unconstrained) wins the root
