@@ -14,6 +14,14 @@ from .engine import CVBooster, cv, train
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import register_logger
 
+try:  # plotting needs matplotlib (optional)
+    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
+    _PLOT = ["plot_importance", "plot_metric", "plot_split_value_histogram",
+             "plot_tree", "create_tree_digraph"]
+except ImportError:  # pragma: no cover
+    _PLOT = []
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -22,4 +30,4 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "register_logger",
-]
+] + _PLOT

@@ -412,6 +412,9 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         """(ref: basic.py:3449 Booster.predict → predictor.hpp)"""
         X = _to_2d_numpy(data).astype(np.float64)
@@ -438,7 +441,12 @@ class Booster:
             from .io.shap import predict_contrib
             return predict_contrib(self, X, lo, hi)
 
-        raw = self._predict_raw(X, lo, hi)
+        if pred_early_stop and self.num_tree_per_iteration >= 1 \
+                and not self.average_output:
+            raw = self._predict_raw_early_stop(
+                X, lo, hi, pred_early_stop_freq, pred_early_stop_margin)
+        else:
+            raw = self._predict_raw(X, lo, hi)
         if self.average_output and num_iteration > 0:
             raw /= num_iteration
         if not raw_score and self.objective is not None:
@@ -448,6 +456,30 @@ class Booster:
         return raw[0] if k == 1 else raw.T
 
     # ------------------------------------------------------------------
+    def _predict_raw_early_stop(self, X: np.ndarray, lo: int, hi: int,
+                                freq: int, margin: float) -> np.ndarray:
+        """Margin-based prediction early stopping (ref:
+        src/boosting/prediction_early_stop.cpp — binary: |raw| > margin;
+        multiclass: top1 - top2 > margin; checked every ``freq`` trees).
+        Rows whose margin clears the threshold stop accumulating trees."""
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        raw = np.zeros((k, n), np.float64)
+        active = np.ones(n, bool)
+        for i, t in enumerate(self.models[lo:hi]):
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            raw[(lo + i) % k, idx] += t.predict_rows(X[idx])
+            if (i + 1) % (freq * k) == 0:
+                if k == 1:
+                    done = np.abs(raw[0, idx]) > margin
+                else:
+                    part = np.sort(raw[:, idx], axis=0)
+                    done = (part[-1] - part[-2]) > margin
+                active[idx[done]] = False
+        return raw
+
     def _predict_raw(self, X: np.ndarray, lo: int, hi: int) -> np.ndarray:
         """Raw scores [k, n]: device batch path for big jobs (bin through
         the training mappers + one jit scan over a stacked tree tensor —

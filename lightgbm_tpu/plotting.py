@@ -1,0 +1,268 @@
+"""Plotting utilities.
+
+Behavioral analog of ref: python-package/lightgbm/plotting.py
+(plot_importance, plot_metric, plot_split_value_histogram, plot_tree /
+create_tree_digraph). matplotlib/graphviz are optional; informative errors
+otherwise.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .sklearn import LGBMModel
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name):
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(model) -> Booster:
+    if isinstance(model, LGBMModel):
+        return model.booster_
+    if isinstance(model, Booster):
+        return model
+    raise TypeError("model should be a Booster or LGBMModel instance")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple] = None, ylim: Optional[Tuple] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """Horizontal bar plot of feature importances
+    (ref: plotting.py plot_importance)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as e:
+        raise ImportError("You must install matplotlib to plot importance."
+                          ) from e
+    booster = _to_booster(booster)
+    importance = booster.feature_importance(importance_type=importance_type)
+    names = booster.feature_name()
+    tuples = sorted(zip(names, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [t for t in tuples if t[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("Cannot plot trees with zero importance")
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    fmt = f"%.{precision}f" if importance_type == "gain" else "%d"
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y, fmt % x, va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    else:
+        ax.set_xlim(0, max(values) * 1.1)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None, title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "auto",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot one metric recorded during training
+    (ref: plotting.py plot_metric). Accepts the evals_result dict from
+    ``record_evaluation`` or a fitted LGBMModel."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as e:
+        raise ImportError("You must install matplotlib to plot metric."
+                          ) from e
+    if isinstance(booster, LGBMModel):
+        eval_results = dict(booster.evals_result_)
+    elif isinstance(booster, dict):
+        eval_results = booster
+    else:
+        raise TypeError("booster must be a dict from record_evaluation or "
+                        "an LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    names = dataset_names or list(eval_results.keys())
+    msv = None
+    for name in names:
+        metrics = eval_results[name]
+        if metric is None:
+            metric = next(iter(metrics))
+        if metric not in metrics:
+            raise ValueError(f"Metric {metric} was not recorded for {name}")
+        results = metrics[metric]
+        ax.plot(np.arange(len(results)), results, label=name)
+        msv = metric
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    ax.set_ylabel(msv if ylabel == "auto" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature "
+                                     "with @index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid: bool = True):
+    """Histogram of split thresholds used for one feature
+    (ref: plotting.py plot_split_value_histogram)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as e:
+        raise ImportError("You must install matplotlib.") from e
+    booster = _to_booster(booster)
+    names = booster.feature_name()
+    if isinstance(feature, str):
+        fidx = names.index(feature)
+    else:
+        fidx = int(feature)
+    values = []
+    for t in booster.models:
+        for i in range(t.num_internal):
+            if int(t.split_feature[i]) == fidx and \
+                    not (int(t.decision_type[i]) & 1):
+                values.append(float(t.threshold[i]))
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centers, hist,
+           width=width_coef * (bin_edges[1] - bin_edges[0]))
+    if title:
+        title = title.replace("@index/name@",
+                              "name" if isinstance(feature, str) else
+                              "index")
+        title = title.replace("@feature@", str(feature))
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        **kwargs):
+    """Graphviz Digraph of one tree (ref: plotting.py create_tree_digraph).
+    Requires the ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("You must install graphviz to plot tree.") from e
+    booster = _to_booster(booster)
+    if tree_index < 0 or tree_index >= len(booster.models):
+        raise IndexError("tree_index is out of range.")
+    t = booster.models[tree_index]
+    names = booster.feature_name()
+    show_info = show_info or []
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+
+    def leaf_label(i):
+        parts = [f"leaf {i}: {t.leaf_value[i]:.{precision}f}"]
+        if "leaf_count" in show_info and len(t.leaf_count) > i:
+            parts.append(f"count: {int(t.leaf_count[i])}")
+        if "leaf_weight" in show_info and len(t.leaf_weight) > i:
+            parts.append(f"weight: {t.leaf_weight[i]:.{precision}f}")
+        return "\n".join(parts)
+
+    def add(node_idx):
+        name = f"node{node_idx}"
+        f = int(t.split_feature[node_idx])
+        d = int(t.decision_type[node_idx])
+        if d & 1:
+            cond = f"{names[f]} in categories"
+        else:
+            cond = f"{names[f]} <= {t.threshold[node_idx]:.{precision}f}"
+        parts = [cond]
+        if "split_gain" in show_info:
+            parts.append(f"gain: {t.split_gain[node_idx]:.{precision}f}")
+        if "internal_count" in show_info:
+            parts.append(f"count: {int(t.internal_count[node_idx])}")
+        graph.node(name, "\n".join(parts), shape="rectangle")
+        for child, tag in ((int(t.left_child[node_idx]), "yes"),
+                           (int(t.right_child[node_idx]), "no")):
+            if child < 0:
+                leaf = ~child
+                cname = f"leaf{leaf}"
+                graph.node(cname, leaf_label(leaf), shape="ellipse")
+            else:
+                cname = f"node{child}"
+                add(child)
+            graph.edge(name, cname, label=tag)
+
+    if t.num_internal == 0:
+        graph.node("leaf0", leaf_label(0), shape="ellipse")
+    else:
+        add(0)
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info: Optional[List[str]] = None, precision: int = 3,
+              orientation: str = "horizontal", **kwargs):
+    """Render one tree with matplotlib via graphviz
+    (ref: plotting.py plot_tree)."""
+    try:
+        import matplotlib.image as mimage
+        import matplotlib.pyplot as plt
+    except ImportError as e:
+        raise ImportError("You must install matplotlib to plot tree.") from e
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation, **kwargs)
+    from io import BytesIO
+    buf = BytesIO(graph.pipe(format="png"))
+    img = mimage.imread(buf)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -318,3 +318,24 @@ def test_first_metric_only_checks_all_valid_sets():
     assert ("v1", "binary_logloss") in tracked
     assert ("v2", "binary_logloss") in tracked
     assert not any(name == "auc" for _, name in tracked)
+
+
+def test_prediction_early_stop():
+    """pred_early_stop skips remaining trees for confident rows with
+    bounded output change (ref: prediction_early_stop.cpp)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.randn(1000, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=40)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=2.0)
+    # stopped rows must already be on the right side with margin >= 2
+    moved = np.abs(full - es) > 1e-12
+    assert np.all(np.abs(es[moved]) >= 2.0)
+    assert np.sign(es[moved]).astype(int).tolist() == \
+        np.sign(full[moved]).astype(int).tolist()

@@ -1,0 +1,46 @@
+"""Plotting surface (ref: python-package/lightgbm/plotting.py,
+test_plotting.py basics)."""
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def model():
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5, "metric": "binary_logloss"},
+                    ds, num_boost_round=8, valid_sets=[ds],
+                    valid_names=["training"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+def test_plot_importance(model):
+    bst, _ = model
+    ax = lgb.plot_importance(bst)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(bst, importance_type="gain", precision=2)
+    assert len(ax2.patches) > 0
+
+
+def test_plot_metric(model):
+    _, evals = model
+    ax = lgb.plot_metric(evals, metric="binary_logloss")
+    assert len(ax.lines) == 1
+
+
+def test_plot_split_value_histogram(model):
+    bst, _ = model
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert len(ax.patches) > 0
+    with pytest.raises(ValueError):
+        lgb.plot_split_value_histogram(bst, feature=4)  # likely unused
