@@ -107,6 +107,10 @@ class Dataset:
         self._inner = TpuDataset.from_data(
             data, cfg, categorical_feature=cats, feature_names=feature_names,
             reference=ref_inner)
+        if bool(cfg.linear_tree):
+            # linear leaves fit ridge models on RAW feature values
+            # (ref: dataset raw-data retention for linear_tree)
+            self._inner.raw_data = np.asarray(data, np.float32)
         if self.label is not None:
             self._inner.metadata.set_label(np.asarray(self.label))
         if self.weight is not None:

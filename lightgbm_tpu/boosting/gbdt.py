@@ -632,6 +632,63 @@ class GBDT:
             ht.leaf_value[leaf] = new_out
 
     # ------------------------------------------------------------------
+    def _fit_linear_leaves(self, ht: HostTree, row_leaf: np.ndarray,
+                           grad, hess) -> None:
+        """Per-leaf weighted ridge on the raw path features (ref:
+        linear_tree_learner.cpp CalculateLinear, Eq 3 of
+        arXiv:1802.05640): coeff = -(X^T H X + lambda I)^-1 X^T g with an
+        intercept column; the first tree keeps constants only. Rows with
+        NaN in the leaf's features are excluded from the fit (they fall
+        back to the constant leaf output at predict time)."""
+        raw = self.train_data.raw_data
+        if raw is None:
+            log.warning("linear_tree needs retained raw data; keeping "
+                        "constant leaves")
+            return
+        ht.is_linear = True
+        L = ht.num_leaves
+        ht.leaf_const = ht.leaf_value.astype(np.float64).copy()
+        ht.leaf_features = [[] for _ in range(L)]
+        ht.leaf_coeff = [[] for _ in range(L)]
+        if len(self.models) < self.num_tree_per_iteration:
+            return  # first tree: constants only (ref: is_first_tree)
+        g = np.asarray(grad, np.float64)
+        h = np.asarray(hess, np.float64)
+        in_bag = np.asarray(self.bag_weight) > 0
+        lam = float(self.config.linear_lambda)
+        paths = ht.branch_features()
+        is_cat = self.train_data.is_categorical
+        for leaf in range(L):
+            feats = [self.train_data.real_feature_index(f)
+                     for f in paths[leaf]]
+            feats = [f for f in feats if not is_cat[f]]
+            if not feats:
+                continue
+            rows = np.nonzero((row_leaf == leaf) & in_bag)[0]
+            if len(rows) < len(feats) + 2:
+                continue
+            Xl = raw[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(Xl).any(axis=1)
+            rows = rows[ok]
+            if len(rows) < len(feats) + 2:
+                continue
+            Xl = np.concatenate([Xl[ok], np.ones((len(rows), 1))], axis=1)
+            hw = h[rows]
+            gw = g[rows]
+            XtHX = (Xl * hw[:, None]).T @ Xl
+            XtHX[np.diag_indices_from(XtHX)] += lam
+            Xtg = Xl.T @ gw
+            try:
+                coef = -np.linalg.solve(XtHX, Xtg)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.isfinite(coef).all():
+                continue
+            ht.leaf_features[leaf] = [int(f) for f in feats]
+            ht.leaf_coeff[leaf] = [float(c) for c in coef[:-1]]
+            ht.leaf_const[leaf] = float(coef[-1])
+
+    # ------------------------------------------------------------------
     def _add_tree_to_score(self, score, bins_dev, dt: _DeviceTree,
                            tree_id: int, scale: float = 1.0):
         if dt.num_leaves <= 1:
@@ -680,12 +737,44 @@ class GBDT:
                 should_continue = True
                 ht, sf_inner = self._to_host_tree(tree, self.shrinkage_rate)
                 row_leaf_np = None
+                if bool(self.config.linear_tree):
+                    row_leaf_np = np.asarray(row_leaf)
+                    self._fit_linear_leaves(ht, row_leaf_np, grad[tid],
+                                            hess[tid])
                 if (self.objective is not None
                         and self.objective.is_renew_tree_output):
                     row_leaf_np = np.asarray(row_leaf)
                     self._renew_tree_output(ht, row_leaf_np, tid)
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
+                if bool(self.config.linear_tree) and ht.is_linear \
+                        and self.train_data.raw_data is not None:
+                    # linear leaves: per-row outputs on host raw data
+                    rl = (row_leaf_np if row_leaf_np is not None
+                          else np.asarray(row_leaf))
+                    delta_lin = ht._linear_outputs(
+                        self.train_data.raw_data, rl)
+                    self.scores = self.scores.at[tid].add(
+                        jnp.asarray(delta_lin, jnp.float32))
+                    dt = _DeviceTree(ht, sf_inner)
+                    for vi in range(len(self.valid_scores)):
+                        if self.valid_data[vi].raw_data is not None:
+                            vp = ht.predict_rows(
+                                self.valid_data[vi].raw_data)
+                            self.valid_scores[vi] = \
+                                self.valid_scores[vi].at[tid].add(
+                                    jnp.asarray(vp, jnp.float32))
+                        else:
+                            self.valid_scores[vi] = self._add_tree_to_score(
+                                self.valid_scores[vi], self.valid_bins[vi],
+                                dt, tid)
+                    if abs(init_scores[tid]) > K_EPSILON:
+                        ht.add_bias(init_scores[tid])
+                        dt.leaf_value = jnp.asarray(ht.leaf_value,
+                                                    jnp.float32)
+                    self.models.append(ht)
+                    self.device_trees.append(dt)
+                    continue
                 lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
                 if self.use_fused:
                     # per-row gathers are slow on TPU; streaming lookup

@@ -106,6 +106,7 @@ class TpuDataset:
         self.bin_offsets: np.ndarray = np.zeros(0, np.int32)
         self.most_freq_bins: np.ndarray = np.zeros(0, np.int32)
         self.is_categorical: np.ndarray = np.zeros(0, bool)
+        self.raw_data: "np.ndarray" = None  # retained for linear trees
         self.missing_types: np.ndarray = np.zeros(0, np.int32)
         self.monotone_constraints: Optional[np.ndarray] = None
 

@@ -64,6 +64,16 @@ def tree_to_string(tree: HostTree) -> str:
         lines.append("cat_boundaries=" + _fmt_arr(tree.cat_boundaries))
         lines.append("cat_threshold=" + _fmt_arr(tree.cat_threshold))
     lines.append(f"is_linear={1 if tree.is_linear else 0}")
+    if tree.is_linear:
+        # ref: tree.cpp ToString is_linear block
+        lines.append("leaf_const=" + _fmt_arr(tree.leaf_const,
+                                              high_precision=True))
+        nf = [len(c) for c in tree.leaf_coeff]
+        lines.append("num_features=" + _fmt_arr(nf))
+        flat_f = [f for fs in tree.leaf_features for f in fs]
+        flat_c = [c for cs in tree.leaf_coeff for c in cs]
+        lines.append("leaf_features=" + _fmt_arr(flat_f))
+        lines.append("leaf_coeff=" + _fmt_arr(flat_c, high_precision=True))
     lines.append(f"shrinkage={tree.shrinkage:g}")
     return "\n".join(lines) + "\n"
 
@@ -96,6 +106,21 @@ def tree_from_block(kv: Dict[str, str]) -> HostTree:
         tree.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
         tree.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
     tree.is_linear = bool(int(kv.get("is_linear", 0)))
+    if tree.is_linear:
+        import numpy as _np
+        tree.leaf_const = _np.array(
+            [float(x) for x in kv.get("leaf_const", "").split()] or
+            [0.0] * nl, _np.float64)
+        nf = [int(x) for x in kv.get("num_features", "").split()] or \
+            [0] * nl
+        flat_f = [int(x) for x in kv.get("leaf_features", "").split()]
+        flat_c = [float(x) for x in kv.get("leaf_coeff", "").split()]
+        tree.leaf_features, tree.leaf_coeff = [], []
+        pos = 0
+        for n in nf:
+            tree.leaf_features.append(flat_f[pos:pos + n])
+            tree.leaf_coeff.append(flat_c[pos:pos + n])
+            pos += n
     return tree
 
 

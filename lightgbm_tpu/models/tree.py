@@ -85,7 +85,11 @@ class HostTree:
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
         self.leaf_depth: np.ndarray = np.zeros(1, np.int32)
+        # linear trees (ref: tree.h is_linear_/leaf_const_/leaf_coeff_)
         self.is_linear = False
+        self.leaf_const: np.ndarray = np.zeros(1, np.float64)
+        self.leaf_features: List[List[int]] = []
+        self.leaf_coeff: List[List[float]] = []
 
     # decision_type bitfield (ref: tree.h:166-186): bit0 categorical,
     # bit1 default_left, bits 2-3 missing type (0 none, 1 zero, 2 nan)
@@ -118,20 +122,30 @@ class HostTree:
 
     # ------------------------------------------------------------------
     def apply_shrinkage(self, rate: float) -> None:
-        """ref: tree.h:188 Shrinkage — scales leaf and internal values."""
+        """ref: tree.h:188 Shrinkage — scales leaf and internal values
+        (and the linear models when present)."""
         self.shrinkage *= rate
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in self.leaf_coeff]
 
     def add_bias(self, val: float) -> None:
         self.leaf_value = self.leaf_value + val
         self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
         self.shrinkage = 1.0
 
     # ------------------------------------------------------------------
     def predict_rows(self, X: np.ndarray) -> np.ndarray:
         """Vectorized node walk over raw features for a batch of rows
         (ref: tree.h Tree::Predict / Decision with missing routing)."""
+        if self.is_linear:
+            leaves = self.predict_leaf_index(X)
+            return self._linear_outputs(X, leaves)
         n = X.shape[0]
         if self.num_leaves == 1:
             return np.full(n, self.leaf_value[0])
@@ -187,6 +201,49 @@ class HostTree:
             if word < hi - lo and (self.cat_threshold[lo + word] >> bit) & 1:
                 go_left[k] = True
         return go_left
+
+    def _linear_outputs(self, X: np.ndarray,
+                        leaves: np.ndarray) -> np.ndarray:
+        """Per-leaf linear model outputs; rows with NaN in any leaf feature
+        fall back to the constant leaf_value (ref: tree.cpp:130
+        PredictLinear macro)."""
+        out = np.empty(len(leaves), np.float64)
+        for leaf in range(self.num_leaves):
+            m = leaves == leaf
+            if not m.any():
+                continue
+            feats = (self.leaf_features[leaf]
+                     if leaf < len(self.leaf_features) else [])
+            base = (self.leaf_const[leaf]
+                    if leaf < len(self.leaf_const) else 0.0)
+            if not feats:
+                out[m] = base
+                continue
+            sub = X[np.ix_(m, feats)].astype(np.float64)
+            nan_rows = np.isnan(sub).any(axis=1)
+            coef = np.asarray(self.leaf_coeff[leaf], np.float64)
+            vals = base + sub @ coef
+            vals[nan_rows] = self.leaf_value[leaf]
+            out[m] = vals
+        return out
+
+    def branch_features(self) -> List[List[int]]:
+        """Per-leaf sorted unique feature sets along the root path
+        (ref: tree.h branch_features_)."""
+        paths: List[List[int]] = [[] for _ in range(self.num_leaves)]
+        if self.num_internal == 0:
+            return paths
+
+        def walk(node, feats):
+            feats = feats + [int(self.split_feature[node])]
+            for child in (int(self.left_child[node]),
+                          int(self.right_child[node])):
+                if child < 0:
+                    paths[~child] = sorted(set(feats))
+                else:
+                    walk(child, feats)
+        walk(0, [])
+        return paths
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         n = X.shape[0]
