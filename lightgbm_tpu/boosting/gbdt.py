@@ -57,7 +57,9 @@ def split_params_from_config(config: Config) -> SplitParams:
         max_cat_threshold=int(config.max_cat_threshold),
         cat_l2=float(config.cat_l2),
         cat_smooth=float(config.cat_smooth),
-        min_data_per_group=int(config.min_data_per_group))
+        min_data_per_group=int(config.min_data_per_group),
+        cegb_tradeoff=float(config.cegb_tradeoff),
+        cegb_penalty_split=float(config.cegb_penalty_split))
 
 
 class _DeviceTree:
@@ -131,6 +133,22 @@ class GBDT:
             train_data.is_categorical[train_data.used_features]))
         self.use_mono_bounds = bool(np.any(np.asarray(self.meta.monotone)
                                            != 0))
+        # CEGB (ref: cost_effective_gradient_boosting.hpp:26 IsEnable)
+        coupled = list(config.cegb_penalty_feature_coupled or [])
+        self.use_cegb = (config.cegb_tradeoff < 1.0
+                         or config.cegb_penalty_split > 0.0
+                         or bool(coupled))
+        if self.use_cegb:
+            cp = np.zeros(train_data.num_features, np.float32)
+            for real_f, pen in enumerate(coupled):
+                inner = train_data.inner_feature_index(real_f)
+                if inner >= 0:
+                    cp[inner] = pen
+            self.cegb_coupled = jnp.asarray(cp)
+            self.cegb_used = np.zeros(train_data.num_features, bool)
+            if config.cegb_penalty_feature_lazy:
+                log.warning("cegb_penalty_feature_lazy is not supported; "
+                            "ignoring the lazy per-row penalties")
         # NOTE: computed before _setup_engine so the frontier-v1 fallback
         # sees them
         ic = config.interaction_constraints
@@ -215,6 +233,12 @@ class GBDT:
                              and HAS_PALLAS
                              and config.tpu_histogram_impl
                              in ("auto", "pallas"))
+        if getattr(self, "use_cegb", False):
+            # CEGB gain deltas are wired into the depthwise XLA grower
+            if engine in ("fused", "frontier"):
+                log.info("cost-effective gradient boosting uses the "
+                         "depthwise XLA engine")
+            engine = "xla"
         needs_v2 = (self.has_cat or getattr(self, "use_mono_bounds", False)
                     or getattr(self, "use_node_masks", False))
         if self.use_frontier and needs_v2:
@@ -224,10 +248,17 @@ class GBDT:
             self.use_frontier = False
             self.use_fused = True
             self.fused_interpret = not self.on_tpu
-        default_policy = ("depthwise" if (self.use_fused or self.use_frontier)
+        default_policy = ("depthwise" if (self.use_fused or self.use_frontier
+                                          or getattr(self, "use_cegb",
+                                                     False))
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
                                                         config.grow_policy)
+        if getattr(self, "use_cegb", False) \
+                and self.grow_policy != "depthwise":
+            log.warning("CEGB is implemented on the depthwise grower; "
+                        "switching grow_policy")
+            self.grow_policy = "depthwise"
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
         if self.use_fused:
@@ -481,7 +512,11 @@ class GBDT:
                 hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
                 use_mono_bounds=self.use_mono_bounds,
                 use_node_masks=self.use_node_masks,
-                node_masks=self._node_masks_for_iter())
+                node_masks=self._node_masks_for_iter(),
+                use_cegb=self.use_cegb,
+                cegb_coupled=(self.cegb_coupled if self.use_cegb else None),
+                cegb_used=(jnp.asarray(self.cegb_used)
+                           if self.use_cegb else None))
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
@@ -736,6 +771,10 @@ class GBDT:
             if nl > 1:
                 should_continue = True
                 ht, sf_inner = self._to_host_tree(tree, self.shrinkage_rate)
+                if self.use_cegb:
+                    for f in sf_inner:
+                        if f >= 0:
+                            self.cegb_used[int(f)] = True
                 row_leaf_np = None
                 if bool(self.config.linear_tree):
                     row_leaf_np = np.asarray(row_leaf)

@@ -53,7 +53,8 @@ def meta_is_cat(meta: "FeatureMeta") -> jax.Array:
 def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
                params: SplitParams, parent_output: jax.Array,
                has_cat: bool = False, use_bounds: bool = False,
-               bound_lo=None, bound_hi=None, leaf_depth=None) -> BestSplit:
+               bound_lo=None, bound_hi=None, leaf_depth=None,
+               cegb_delta=None) -> BestSplit:
     """Channel-minor convenience wrapper over the combined numerical +
     categorical scan (ref: feature_histogram.hpp:85 FindBestThreshold)."""
     return best_split_cm(
@@ -61,7 +62,7 @@ def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
         meta.missing_type, meta.default_bin, feature_mask,
         meta_is_cat(meta), meta.monotone, params, parent_output,
         has_cat=has_cat, use_bounds=use_bounds, bound_lo=bound_lo,
-        bound_hi=bound_hi, leaf_depth=leaf_depth)
+        bound_hi=bound_hi, leaf_depth=leaf_depth, cegb_delta=cegb_delta)
 
 
 class NodeMaskCfg(NamedTuple):
@@ -147,6 +148,19 @@ def update_leaf_groups(cfg: NodeMaskCfg, leaf_groups, split_feature,
     else:
         out = jnp.where(sel, child, leaf_groups)
     return _masked_scatter(out, new_idx, child, sel)
+
+
+def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,
+                      leaf_counts):
+    """[S, F] CEGB gain delta: tradeoff*penalty_split*n_leaf plus the
+    one-time coupled feature cost for features not yet used in any split
+    (ref: cost_effective_gradient_boosting.hpp:66 DetlaGain; the per-row
+    lazy penalty is not implemented)."""
+    split_pen = (params.cegb_tradeoff * params.cegb_penalty_split
+                 * leaf_counts[:, None])
+    feat_pen = params.cegb_tradeoff * jnp.where(used_features, 0.0,
+                                                coupled_penalty)[None, :]
+    return split_pen + feat_pen
 
 
 def mono_child_bounds(lo, hi, new_lo, new_hi, sel, mono_dir,
@@ -451,7 +465,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat", "parallel_mode",
-                     "top_k", "use_mono_bounds", "use_node_masks"))
+                     "top_k", "use_mono_bounds", "use_node_masks",
+                     "use_cegb"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -462,6 +477,9 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_offset=None, use_mono_bounds: bool = False,
                         use_node_masks: bool = False,
                         node_masks: "NodeMaskCfg" = None,
+                        use_cegb: bool = False,
+                        cegb_coupled: jax.Array = None,
+                        cegb_used: jax.Array = None,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -547,23 +565,30 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
     leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
     leaf_groups = jnp.full((L,), -1, jnp.int32)   # all groups compatible
+    used_f = (cegb_used if (use_cegb and cegb_used is not None)
+              else jnp.zeros((F,), bool))
 
     def all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
-                 node_ids):
+                 node_ids, used_f):
         mask2d = feature_mask[None, :] & pool_valid
         if use_node_masks:
             mask2d = mask2d & node_feature_mask(node_masks, leaf_groups,
                                                 node_ids)
+        delta = None
+        if use_cegb:
+            delta = cegb_delta_matrix(params, cegb_coupled, used_f,
+                                      tree.leaf_count)
         bs = best_split(pool, meta, mask2d, params,
                         tree.leaf_value, has_cat=has_cat,
                         use_bounds=use_mono_bounds, bound_lo=leaf_lo,
-                        bound_hi=leaf_hi, leaf_depth=tree.leaf_depth)
+                        bound_hi=leaf_hi, leaf_depth=tree.leaf_depth,
+                        cegb_delta=delta)
         if parallel_mode == "feature" and psum_axis is not None:
             bs = merge_best_over_shards(bs, psum_axis, feature_offset)
         return bs
 
     best = all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
-                    jnp.zeros((L,), jnp.int32))
+                    jnp.zeros((L,), jnp.int32), used_f)
     best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
                                         NEG_INF))
     r_bins = bins if route_bins is None else route_bins
@@ -571,7 +596,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
     def level(carry, _):
         (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-         num_nodes, leaf_lo, leaf_hi, leaf_groups) = carry
+         num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f) = carry
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         budget = L - tree.num_leaves
@@ -584,7 +609,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
         def do_level(op):
             (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-             num_nodes, leaf_lo, leaf_hi, leaf_groups) = op
+             num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f) = op
             # new leaf ids: k-th selected leaf (by slot order) gets
             # num_leaves + k; node ids num_nodes + k
             sel_i32 = selected.astype(jnp.int32)
@@ -708,23 +733,32 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                     new_of_leaf)
             else:
                 leaf_groups2 = leaf_groups
+            if use_cegb:
+                chosen = _masked_scatter(
+                    jnp.zeros((F,), bool),
+                    jnp.maximum(f_l, 0).astype(jnp.int32),
+                    jnp.ones((L,), bool), selected & (f_l >= 0))
+                used_f2 = used_f | chosen
+            else:
+                used_f2 = used_f
             # a leaf's sampling identity: creating node id + side bit
             node_ids2 = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
             best2 = all_best(pool2, tree2, pv2, leaf_lo2, leaf_hi2,
-                             leaf_groups2, node_ids2)
+                             leaf_groups2, node_ids2, used_f2)
             active = jnp.arange(L) < tree2.num_leaves
             best2 = best2._replace(gain=jnp.where(active, best2.gain, NEG_INF))
             return (tree2, row_leaf2, pool2, pv2, best2, lpn2, lil2,
-                    num_nodes + n_sel, leaf_lo2, leaf_hi2, leaf_groups2)
+                    num_nodes + n_sel, leaf_lo2, leaf_hi2, leaf_groups2,
+                    used_f2)
 
         carry2 = jax.lax.cond(n_sel > 0, do_level, lambda op: op,
                               (tree, row_leaf, pool, pool_valid, best, lpn,
                                lil, num_nodes, leaf_lo, leaf_hi,
-                               leaf_groups))
+                               leaf_groups, used_f))
         return carry2, None
 
     carry = (tree, row_leaf, pool, pool_valid, best, leaf_parent_node,
-             leaf_is_left, num_nodes, leaf_lo, leaf_hi, leaf_groups)
-    (tree, row_leaf, pool, _, best, _, _, _, _, _, _), _ = jax.lax.scan(
+             leaf_is_left, num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f)
+    (tree, row_leaf, pool, _, best, _, _, _, _, _, _, _), _ = jax.lax.scan(
         level, carry, None, length=n_levels)
     return tree, row_leaf

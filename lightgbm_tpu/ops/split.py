@@ -46,6 +46,8 @@ class SplitParams(NamedTuple):
     """Static split-finding hyper-parameters (subset of ref Config used by
     FeatureHistogram)."""
     lambda_l1: float = 0.0
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
     lambda_l2: float = 0.0
     max_delta_step: float = 0.0
     min_data_in_leaf: int = 20
@@ -156,7 +158,8 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
                             use_bounds: bool = False,
                             bound_lo: jax.Array = None,
                             bound_hi: jax.Array = None,
-                            leaf_depth: jax.Array = None) -> BestSplit:
+                            leaf_depth: jax.Array = None,
+                            cegb_delta: jax.Array = None) -> BestSplit:
     """Best numerical split per slot (channel-major inputs — TPU relayouts
     of channel-minor ``[..., 3]`` arrays are expensive, so the hot path keeps
     grad/hess/count as separate ``[S, F, B]`` planes).
@@ -314,6 +317,13 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
         net = jnp.where(jnp.isfinite(g_best),
                         (g_best - shift2) * factor + shift2, g_best)
         g_best = jnp.where(monotone[None, :] != 0, net, g_best)
+    if cegb_delta is not None:
+        # cost-effective gradient boosting: per-(leaf,feature) acquisition
+        # cost subtracted from the candidate gain before feature choice
+        # (ref: cost_effective_gradient_boosting.hpp:66 DetlaGain,
+        # serial_tree_learner.cpp:769-777)
+        g_best = jnp.where(jnp.isfinite(g_best), g_best - cegb_delta,
+                           g_best)
     if per_feature_gains:
         # voting-parallel wants the [S, F] gain plane, not the argmax
         # (ref: voting_parallel_tree_learner.cpp:151 votes by local gain)
@@ -559,7 +569,8 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
                   params: SplitParams, parent_output: jax.Array,
                   has_cat: bool = False, use_bounds: bool = False,
                   bound_lo: jax.Array = None, bound_hi: jax.Array = None,
-                  leaf_depth: jax.Array = None) -> BestSplit:
+                  leaf_depth: jax.Array = None,
+                  cegb_delta: jax.Array = None) -> BestSplit:
     """Combined numerical + categorical best split per slot (the analog of
     FeatureHistogram::FindBestThreshold dispatch on bin_type,
     ref: feature_histogram.hpp:85). ``has_cat`` is static: all-numerical
@@ -569,7 +580,7 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
         grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
         feature_mask & ~ic, monotone, params, parent_output,
         use_bounds=use_bounds, bound_lo=bound_lo, bound_hi=bound_hi,
-        leaf_depth=leaf_depth)
+        leaf_depth=leaf_depth, cegb_delta=cegb_delta)
     if not has_cat:
         return num
     cat = best_categorical_split_cm(

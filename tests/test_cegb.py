@@ -1,0 +1,53 @@
+"""Cost-effective gradient boosting penalties
+(ref: cost_effective_gradient_boosting.hpp:22)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(R=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(R, 4).astype(np.float32)
+    # feature 0 slightly stronger than feature 1; 2,3 noise
+    y = (1.0 * X[:, 0] + 0.9 * X[:, 1] + 0.1 * rng.randn(R)) \
+        .astype(np.float32)
+    return X, y
+
+
+def test_coupled_penalty_avoids_expensive_feature():
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    ds1 = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train(dict(base), ds1, num_boost_round=5)
+    used_plain = set()
+    for t in bst.models:
+        used_plain |= set(t.split_feature[:t.num_internal].tolist())
+    assert 0 in used_plain
+
+    # make feature 0 prohibitively expensive to acquire
+    ds2 = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst2 = lgb.train(dict(base, cegb_tradeoff=1.0,
+                          cegb_penalty_feature_coupled=[1e9, 0, 0, 0]),
+                     ds2, num_boost_round=5)
+    used = set()
+    for t in bst2.models:
+        used |= set(t.split_feature[:t.num_internal].tolist())
+    assert 0 not in used
+    # the model still learns from the remaining features
+    mse = float(np.mean((bst2.predict(X) - y) ** 2))
+    assert mse < np.var(y)
+
+
+def test_split_penalty_shrinks_trees():
+    X, y = _data(seed=1)
+    base = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+            "min_data_in_leaf": 5}
+    ds1 = lgb.Dataset(X, label=y, params={"verbose": -1})
+    n_plain = sum(t.num_leaves for t in
+                  lgb.train(dict(base), ds1, num_boost_round=3).models)
+    ds2 = lgb.Dataset(X, label=y, params={"verbose": -1})
+    n_pen = sum(t.num_leaves for t in
+                lgb.train(dict(base, cegb_penalty_split=0.5), ds2,
+                          num_boost_round=3).models)
+    assert n_pen < n_plain
