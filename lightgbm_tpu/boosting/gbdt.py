@@ -133,22 +133,7 @@ class GBDT:
             train_data.is_categorical[train_data.used_features]))
         self.use_mono_bounds = bool(np.any(np.asarray(self.meta.monotone)
                                            != 0))
-        # CEGB (ref: cost_effective_gradient_boosting.hpp:26 IsEnable)
-        coupled = list(config.cegb_penalty_feature_coupled or [])
-        self.use_cegb = (config.cegb_tradeoff < 1.0
-                         or config.cegb_penalty_split > 0.0
-                         or bool(coupled))
-        if self.use_cegb:
-            cp = np.zeros(train_data.num_features, np.float32)
-            for real_f, pen in enumerate(coupled):
-                inner = train_data.inner_feature_index(real_f)
-                if inner >= 0:
-                    cp[inner] = pen
-            self.cegb_coupled = jnp.asarray(cp)
-            self.cegb_used = np.zeros(train_data.num_features, bool)
-            if config.cegb_penalty_feature_lazy:
-                log.warning("cegb_penalty_feature_lazy is not supported; "
-                            "ignoring the lazy per-row penalties")
+        self._setup_cegb(config)
         # NOTE: computed before _setup_engine so the frontier-v1 fallback
         # sees them
         ic = config.interaction_constraints
@@ -219,6 +204,31 @@ class GBDT:
 
 
     # ------------------------------------------------------------------
+    def _setup_cegb(self, config: Config) -> None:
+        """CEGB enablement and per-feature cost arrays (ref:
+        cost_effective_gradient_boosting.hpp:26 IsEnable). Re-run by
+        reset_config so reset_parameter can change the penalties."""
+        train_data = self.train_data
+        coupled = list(config.cegb_penalty_feature_coupled or [])
+        lazy = list(config.cegb_penalty_feature_lazy or [])
+        self.use_cegb = (config.cegb_tradeoff < 1.0
+                         or config.cegb_penalty_split > 0.0
+                         or bool(coupled) or bool(lazy))
+        if not self.use_cegb:
+            return
+        cp = np.zeros(train_data.num_features, np.float32)
+        for real_f, pen in enumerate(coupled):
+            inner = train_data.inner_feature_index(real_f)
+            if inner >= 0:
+                cp[inner] = pen
+        self.cegb_coupled = jnp.asarray(cp)
+        if not hasattr(self, "cegb_used"):
+            self.cegb_used = np.zeros(train_data.num_features, bool)
+        if lazy:
+            log.warning("cegb_penalty_feature_lazy is not supported; "
+                        "ignoring the lazy per-row penalties")
+
+    # ------------------------------------------------------------------
     def _setup_engine(self, config: Config) -> None:
         """Resolve tpu_engine/grow_policy into the learner flags (called by
         init and again by reset_config so reset_parameter can switch
@@ -227,18 +237,18 @@ class GBDT:
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
+        if getattr(self, "use_cegb", False) and engine != "xla":
+            # CEGB gain deltas are wired into the depthwise XLA grower;
+            # must override BEFORE the engine flags are derived
+            log.info("cost-effective gradient boosting uses the "
+                     "depthwise XLA engine")
+            engine = "xla"
         self.use_fused = engine == "fused" and HAS_PALLAS
         self.fused_interpret = self.use_fused and not self.on_tpu
         self.use_frontier = (engine == "frontier" and self.on_tpu
                              and HAS_PALLAS
                              and config.tpu_histogram_impl
                              in ("auto", "pallas"))
-        if getattr(self, "use_cegb", False):
-            # CEGB gain deltas are wired into the depthwise XLA grower
-            if engine in ("fused", "frontier"):
-                log.info("cost-effective gradient boosting uses the "
-                         "depthwise XLA engine")
-            engine = "xla"
         needs_v2 = (self.has_cat or getattr(self, "use_mono_bounds", False)
                     or getattr(self, "use_node_masks", False))
         if self.use_frontier and needs_v2:
@@ -875,6 +885,7 @@ class GBDT:
         self.shrinkage_rate = float(config.learning_rate)
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
+        self._setup_cegb(config)
         self._setup_engine(config)
         n = self.num_data
         self.is_bagging = False

@@ -362,7 +362,8 @@ def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
                               cnt: jax.Array, num_bin_per_feat: jax.Array,
                               cat_feature_mask: jax.Array,
                               params: SplitParams,
-                              parent_output: jax.Array) -> BestSplit:
+                              parent_output: jax.Array,
+                              cegb_delta: jax.Array = None) -> BestSplit:
     """Best categorical split per slot (ref: feature_histogram.hpp:278-470
     FindBestThresholdCategoricalInner).
 
@@ -492,6 +493,11 @@ def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
     use_rev = g_rev > g_fwd
     g_sorted = jnp.where(use_rev, g_rev, g_fwd)
     g_feat = jnp.where(onehot_allowed, g1, g_sorted)   # [S, F]
+    if cegb_delta is not None:
+        # CEGB acquisition costs apply to every candidate feature
+        # (ref: serial_tree_learner.cpp:769-777)
+        g_feat = jnp.where(jnp.isfinite(g_feat), g_feat - cegb_delta,
+                           g_feat)
     cfm = (cat_feature_mask[None, :] if cat_feature_mask.ndim == 1
            else cat_feature_mask)
     g_feat = jnp.where(cfm, g_feat, K_MIN_SCORE)
@@ -585,7 +591,7 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
         return num
     cat = best_categorical_split_cm(
         grad, hess, cnt, num_bin_per_feat, feature_mask & ic, params,
-        parent_output)
+        parent_output, cegb_delta=cegb_delta)
     if use_bounds:
         # categorical features carry no monotone direction, but the leaf's
         # feasible output interval still applies (winner-level clamp;
