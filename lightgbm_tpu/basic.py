@@ -512,6 +512,24 @@ class Booster:
         return raw
 
     # ------------------------------------------------------------------
+    def set_network(self, machines: str, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Multi-host setup shim (ref: basic.py:2687 Booster.set_network);
+        maps the reference's machine-list parameters onto
+        jax.distributed.initialize — see parallel/distributed.py."""
+        from .parallel import distributed
+        distributed.set_network(machines, local_listen_port, num_machines,
+                                listen_time_out)
+        return self
+
+    def free_network(self) -> "Booster":
+        """(ref: basic.py:2721)"""
+        from .parallel import distributed
+        distributed.free_network()
+        return self
+
+    # ------------------------------------------------------------------
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """(ref: basic.py Booster.reset_parameter → gbdt.cpp ResetConfig)"""
         self.params.update(params)

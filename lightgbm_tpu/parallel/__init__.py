@@ -19,10 +19,12 @@ from .data_parallel import (grow_tree_data_parallel, make_sharded_grow_fn,
                             train_step_data_parallel)
 from .tree_parallel import (make_feature_parallel_grow_fn,
                             make_voting_parallel_grow_fn)
+from . import distributed
 
 __all__ = [
     "make_mesh", "replicate", "shard_rows",
     "grow_tree_data_parallel", "make_sharded_grow_fn",
     "train_step_data_parallel",
     "make_feature_parallel_grow_fn", "make_voting_parallel_grow_fn",
+    "distributed",
 ]

@@ -1,0 +1,117 @@
+"""Multi-host orchestration.
+
+Replaces the reference's machine-list/port plumbing (ref: basic.py:2687
+Booster.set_network -> LGBM_NetworkInit, dask.py:354
+_machines_to_worker_map, src/network/linkers_socket.cpp all-pairs TCP
+mesh) with JAX's process runtime: one `jax.distributed.initialize` call
+per host, after which `jax.devices()` spans the pod slice and the SAME
+mesh/shard_map training code (parallel/data_parallel.py,
+parallel/tree_parallel.py) runs over ICI+DCN — no port negotiation, no
+linker topology, no reduce-scatter schedules.
+
+    # on every host (rank r of N):
+    from lightgbm_tpu.parallel import distributed, make_mesh
+    distributed.init_distributed("host0:1234", N, r)
+    mesh = make_mesh()            # all pod devices
+    ...train with make_sharded_grow_fn(mesh, ...)
+
+`set_network` accepts the reference's machine-list parameters and maps
+them onto initialize() so ported launch scripts keep working.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..utils import log
+
+_INITIALIZED = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None
+                     ) -> None:
+    """Bring up the JAX process mesh (ref: the Network::Init role,
+    network.h:89). Idempotent; TPU pod environments can usually omit all
+    arguments (auto-detected from the TPU metadata)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        log.warning("distributed runtime already initialized; ignoring")
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _INITIALIZED = True
+    log.info("distributed runtime up: process %d/%d, %d local / %d global "
+             "devices", jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def set_network(machines: str, local_listen_port: int = 12400,
+                num_machines: int = 1, time_out: int = 120) -> None:
+    """Reference-parameter shim (ref: basic.py:2687 set_network): the
+    first machine acts as the coordinator; this host's rank is its
+    position in the comma-separated list. ``time_out`` is accepted for
+    signature compatibility (JAX handles connection retries itself)."""
+    del time_out
+    hosts = [m.strip() for m in machines.split(",") if m.strip()]
+    if not hosts:
+        raise ValueError("set_network: 'machines' must be a comma-separated "
+                         "list of host[:port] entries, got an empty string")
+    if num_machines > 1 and len(hosts) != num_machines:
+        log.warning("machines lists %d hosts but num_machines=%d",
+                    len(hosts), num_machines)
+    import socket
+    me = socket.gethostname()
+    my_ids = {me}
+    try:
+        my_ids.add(socket.getfqdn())
+        my_ids.add(socket.gethostbyname(me))
+        my_ids.add("127.0.0.1")
+        my_ids.add("localhost")
+    except OSError:
+        pass
+    # rank: match host AND (when several entries share a host — multiple
+    # processes per machine, as the reference format allows) this
+    # process's local_listen_port
+    candidates = [i for i, h in enumerate(hosts)
+                  if h.split(":")[0] in my_ids]
+    if not candidates:
+        raise ValueError(
+            f"set_network: none of the machines entries matches this host "
+            f"({sorted(my_ids)}); list every worker's address, e.g. "
+            f"'ip1:port,ip2:port'")
+    if len(candidates) > 1:
+        port_matches = [i for i in candidates
+                        if ":" in hosts[i]
+                        and hosts[i].rsplit(":", 1)[1].isdigit()
+                        and int(hosts[i].rsplit(":", 1)[1])
+                        == local_listen_port]
+        if len(port_matches) != 1:
+            raise ValueError(
+                "set_network: multiple machines entries match this host; "
+                "distinguish processes by giving each entry this "
+                "process's local_listen_port")
+        candidates = port_matches
+    rank = candidates[0]
+    # the coordinator is entry 0; its listed port wins over our local one
+    c = hosts[0]
+    if ":" in c and c.rsplit(":", 1)[1].isdigit():
+        coord = f"{c.rsplit(':', 1)[0]}:{int(c.rsplit(':', 1)[1])}"
+    else:
+        coord = f"{c}:{local_listen_port}"
+    init_distributed(coord, len(hosts), rank)
+
+
+def free_network() -> None:
+    """(ref: basic.py:2721 free_network) Shut down the process runtime."""
+    global _INITIALIZED
+    if not _INITIALIZED:
+        return
+    import jax
+    jax.distributed.shutdown()
+    _INITIALIZED = False

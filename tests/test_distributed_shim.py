@@ -1,0 +1,47 @@
+"""set_network parameter mapping (single-host parse logic only —
+jax.distributed.initialize itself needs a real multi-process pod)."""
+import socket
+
+import pytest
+
+from lightgbm_tpu.parallel import distributed
+
+
+def test_empty_machines_raises():
+    with pytest.raises(ValueError, match="machines"):
+        distributed.set_network("")
+
+
+def test_unmatched_host_raises():
+    with pytest.raises(ValueError, match="none of the machines"):
+        distributed.set_network("surely-not-this-host-1:1234,"
+                                "surely-not-this-host-2:1234")
+
+
+def test_rank_and_coordinator_parse(monkeypatch):
+    captured = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None, local_device_ids=None):
+        captured.update(coord=coordinator_address, n=num_processes,
+                        rank=process_id)
+
+    monkeypatch.setattr(distributed, "init_distributed",
+                        lambda *a, **k: fake_init(*a, **k))
+    me = socket.gethostname()
+    distributed.set_network(f"otherhost:5000,{me}:5001",
+                            local_listen_port=5001, num_machines=2)
+    assert captured["rank"] == 1
+    assert captured["coord"] == "otherhost:5000"  # entry-0 port wins
+    assert captured["n"] == 2
+
+
+def test_multiprocess_per_host(monkeypatch):
+    captured = {}
+    monkeypatch.setattr(
+        distributed, "init_distributed",
+        lambda coord, n, rank: captured.update(rank=rank))
+    me = socket.gethostname()
+    distributed.set_network(f"{me}:6000,{me}:6001",
+                            local_listen_port=6001)
+    assert captured["rank"] == 1
