@@ -134,6 +134,7 @@ class GBDT:
         self.use_mono_bounds = bool(np.any(np.asarray(self.meta.monotone)
                                            != 0))
         self._setup_cegb(config)
+        self._setup_forced_splits(config, train_data)
         # NOTE: computed before _setup_engine so the frontier-v1 fallback
         # sees them
         ic = config.interaction_constraints
@@ -204,6 +205,53 @@ class GBDT:
 
 
     # ------------------------------------------------------------------
+    def _setup_forced_splits(self, config: Config, train_data) -> None:
+        """BFS schedule from the forced-splits JSON (ref: gbdt.cpp:72-80
+        load + serial_tree_learner.cpp:455 ForceSplits). Leaf numbering
+        follows the leaf-wise grower: splitting leaf l keeps l as the left
+        child, the right child gets the next fresh id."""
+        self.n_forced = 0
+        path = str(config.forcedsplits_filename or "")
+        if not path:
+            return
+        import json as _json
+        with open(path) as f:
+            root = _json.load(f)
+        leaves, feats, thrs = [], [], []
+        queue = [(root, 0)]
+        next_id = 1
+        while queue:
+            node, leaf = queue.pop(0)
+            real_f = int(node["feature"])
+            inner = train_data.inner_feature_index(real_f)
+            if inner < 0:
+                log.warning("forced split on filtered feature %d skipped",
+                            real_f)
+                continue
+            if bool(train_data.is_categorical[real_f]):
+                log.fatal("forced splits on categorical features are not "
+                          "supported (feature %d)", real_f)
+            m = train_data.mappers[real_f]
+            tbin = int(m.value_to_bin(float(node["threshold"])))
+            leaves.append(leaf)
+            feats.append(inner)
+            thrs.append(tbin)
+            right_id = next_id
+            next_id += 1
+            if "left" in node and node["left"]:
+                queue.append((node["left"], leaf))
+            if "right" in node and node["right"]:
+                queue.append((node["right"], right_id))
+        n = min(len(leaves), self.max_leaves - 1)
+        self.n_forced = n
+        if n:
+            self.forced_leaf = jnp.asarray(
+                np.asarray(leaves[:n], np.int32))
+            self.forced_feat = jnp.asarray(np.asarray(feats[:n], np.int32))
+            self.forced_thr = jnp.asarray(np.asarray(thrs[:n], np.int32))
+            log.info("Loaded %d forced splits from %s", n, path)
+
+    # ------------------------------------------------------------------
     def _setup_cegb(self, config: Config) -> None:
         """CEGB enablement and per-feature cost arrays (ref:
         cost_effective_gradient_boosting.hpp:26 IsEnable). Re-run by
@@ -237,6 +285,9 @@ class GBDT:
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
+        if getattr(self, "n_forced", 0) > 0 and engine != "xla":
+            log.info("forced splits use the leaf-wise XLA engine")
+            engine = "xla"
         if getattr(self, "use_cegb", False) and engine != "xla":
             # CEGB gain deltas are wired into the depthwise XLA grower;
             # must override BEFORE the engine flags are derived
@@ -269,6 +320,16 @@ class GBDT:
             log.warning("CEGB is implemented on the depthwise grower; "
                         "switching grow_policy")
             self.grow_policy = "depthwise"
+        if getattr(self, "n_forced", 0) > 0 \
+                and self.grow_policy != "leafwise":
+            log.warning("forced splits are implemented on the leaf-wise "
+                        "grower; switching grow_policy")
+            self.grow_policy = "leafwise"
+        if getattr(self, "n_forced", 0) > 0 \
+                and getattr(self, "use_cegb", False):
+            log.warning("CEGB penalties are not applied when forced splits "
+                        "are enabled (leaf-wise grower); disabling CEGB")
+            self.use_cegb = False
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
         if self.use_fused:
@@ -527,13 +588,18 @@ class GBDT:
                 cegb_coupled=(self.cegb_coupled if self.use_cegb else None),
                 cegb_used=(jnp.asarray(self.cegb_used)
                            if self.use_cegb else None))
+        n_forced = getattr(self, "n_forced", 0)
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
             hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
             use_mono_bounds=self.use_mono_bounds,
             use_node_masks=self.use_node_masks,
-            node_masks=self._node_masks_for_iter())
+            node_masks=self._node_masks_for_iter(),
+            n_forced=n_forced,
+            forced_leaf=self.forced_leaf if n_forced else None,
+            forced_feat=self.forced_feat if n_forced else None,
+            forced_thr=self.forced_thr if n_forced else None)
 
     def _node_masks_for_iter(self):
         """Per-tree bynode randomness: fold the boosting iteration into the
@@ -886,6 +952,7 @@ class GBDT:
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
         self._setup_cegb(config)
+        self._setup_forced_splits(config, self.train_data)
         self._setup_engine(config)
         n = self.num_data
         self.is_bagging = False

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..ops.histogram import build_histograms
 from ..ops.split import (BestSplit, SplitParams, best_numerical_split,
                          best_numerical_split_cm, best_split_cm,
-                         calculate_leaf_output)
+                         calculate_leaf_output, leaf_gain)
 from .tree import TreeArrays, empty_tree
 
 NEG_INF = -jnp.inf
@@ -150,6 +150,41 @@ def update_leaf_groups(cfg: NodeMaskCfg, leaf_groups, split_feature,
     return _masked_scatter(out, new_idx, child, sel)
 
 
+def gather_split_info(pool_leaf, f, t, meta: "FeatureMeta",
+                      params: SplitParams, parent_output) -> BestSplit:
+    """Split record for a GIVEN (feature, threshold) from a leaf's
+    histogram (ref: feature_histogram.hpp GatherInfoForThresholdNumerical
+    — used by forced splits). default_left=False: missing bins ride right
+    and are excluded from the left sums."""
+    h = jax.lax.dynamic_index_in_dim(pool_leaf, f, axis=0,
+                                     keepdims=False)          # [B, 3]
+    B = h.shape[0]
+    b_iota = jnp.arange(B, dtype=jnp.int32)
+    nb = meta.num_bin[f]
+    mt = meta.missing_type[f]
+    db = meta.default_bin[f]
+    is_missing = (((mt == 1) & (b_iota == db))
+                  | ((mt == 2) & (b_iota == nb - 1)))
+    left_m = ((b_iota <= t) & ~is_missing)[:, None]
+    tot = jnp.sum(h, axis=0)
+    lsum = jnp.sum(jnp.where(left_m, h, 0.0), axis=0)
+    lg, lh, lc = lsum[0], lsum[1] + 1e-15, lsum[2]
+    rg, rh, rc = tot[0] - lg, tot[1] - lsum[1] + 1e-15, tot[2] - lc
+    lo = calculate_leaf_output(lg, lh, params, lc, parent_output)
+    ro = calculate_leaf_output(rg, rh, params, rc, parent_output)
+    shift = leaf_gain(tot[0], tot[1] + 2e-15, params, tot[2],
+                      parent_output) + params.min_gain_to_split
+    gain = (leaf_gain(lg, lh, params, lc, parent_output)
+            + leaf_gain(rg, rh, params, rc, parent_output) - shift)
+    return BestSplit(
+        feature=f.astype(jnp.int32), threshold=t.astype(jnp.int32),
+        default_left=jnp.asarray(False),
+        gain=gain, left_output=lo, right_output=ro,
+        left_sum_grad=lg, left_sum_hess=lh - 1e-15, left_count=lc,
+        right_sum_grad=rg, right_sum_hess=rh - 1e-15, right_count=rc,
+        cat_flag=jnp.asarray(False), cat_mask=jnp.zeros((B,), bool))
+
+
 def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,
                       leaf_counts):
     """[S, F] CEGB gain delta: tradeoff*penalty_split*n_leaf plus the
@@ -253,7 +288,7 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat",
-                     "use_mono_bounds", "use_node_masks"))
+                     "use_mono_bounds", "use_node_masks", "n_forced"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -261,6 +296,10 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        has_cat: bool = False, use_mono_bounds: bool = False,
                        use_node_masks: bool = False,
                        node_masks: "NodeMaskCfg" = None,
+                       n_forced: int = 0,
+                       forced_leaf: jax.Array = None,
+                       forced_feat: jax.Array = None,
+                       forced_thr: jax.Array = None,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -332,6 +371,36 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                              max_depth, L)
         l = jnp.argmax(gains).astype(jnp.int32)
         do_split = gains[l] > 0.0
+        if n_forced > 0:
+            # forced top-of-tree splits (ref: serial_tree_learner.cpp:455
+            # ForceSplits — BFS through the forced-split JSON, bypassing
+            # the gain-based choice; the schedule is precomputed on host).
+            # Invalid forced splits (an empty child) are skipped like the
+            # reference; lax.cond keeps the gather off the hot path once
+            # the schedule is exhausted.
+            safe_i = jnp.minimum(i, n_forced - 1)
+            fl = forced_leaf[safe_i]
+            ff = forced_feat[safe_i]
+            ft = forced_thr[safe_i]
+
+            def _forced_info(_):
+                return gather_split_info(pool[fl], ff, ft, meta, params,
+                                         tree.leaf_value[fl])
+
+            def _no_info(_):
+                z = jnp.float32(0)
+                return BestSplit(
+                    jnp.int32(-1), jnp.int32(0), jnp.asarray(False),
+                    jnp.float32(NEG_INF), z, z, z, z, z, z, z, z,
+                    jnp.asarray(False), jnp.zeros((B,), bool))
+
+            finfo = jax.lax.cond(i < n_forced, _forced_info, _no_info,
+                                 None)
+            forced_ok = ((i < n_forced)
+                         & (finfo.left_count >= 1)
+                         & (finfo.right_count >= 1))
+            l = jnp.where(forced_ok, fl, l)
+            do_split = do_split | forced_ok
 
         def split_branch(op):
             (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
@@ -342,6 +411,14 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             dl = best.default_left[l]
             cf = best.cat_flag[l]
             cm = best.cat_mask[l]
+            bsl = BestSplit(*[a[l] for a in best])
+            if n_forced > 0:
+                f = jnp.where(forced_ok, finfo.feature, f)
+                t = jnp.where(forced_ok, finfo.threshold, t)
+                dl = jnp.where(forced_ok, False, dl)
+                cf = jnp.where(forced_ok, False, cf)
+                bsl = BestSplit(*[jnp.where(forced_ok, a, b)
+                                  for a, b in zip(finfo, bsl)])
 
             # --- node bookkeeping (ref: tree.h:62 Tree::Split) ---
             write_left = (lpn[l] >= 0) & lil[l]
@@ -362,17 +439,17 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 cat_flag=tree.cat_flag.at[i].set(cf),
                 cat_mask=tree.cat_mask.at[i].set(cm),
                 left_child=lc, right_child=rc,
-                split_gain=tree.split_gain.at[i].set(best.gain[l]),
+                split_gain=tree.split_gain.at[i].set(bsl.gain),
                 internal_value=tree.internal_value.at[i].set(tree.leaf_value[l]),
                 internal_count=tree.internal_count.at[i].set(tree.leaf_count[l]),
                 internal_weight=tree.internal_weight.at[i].set(
                     tree.leaf_weight[l]),
-                leaf_value=tree.leaf_value.at[l].set(best.left_output[l])
-                                          .at[new].set(best.right_output[l]),
-                leaf_count=tree.leaf_count.at[l].set(best.left_count[l])
-                                          .at[new].set(best.right_count[l]),
-                leaf_weight=tree.leaf_weight.at[l].set(best.left_sum_hess[l])
-                                            .at[new].set(best.right_sum_hess[l]),
+                leaf_value=tree.leaf_value.at[l].set(bsl.left_output)
+                                          .at[new].set(bsl.right_output),
+                leaf_count=tree.leaf_count.at[l].set(bsl.left_count)
+                                          .at[new].set(bsl.right_count),
+                leaf_weight=tree.leaf_weight.at[l].set(bsl.left_sum_hess)
+                                            .at[new].set(bsl.right_sum_hess),
                 leaf_depth=tree.leaf_depth.at[l].set(new_depth)
                                           .at[new].set(new_depth),
             )
@@ -391,7 +468,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             row_leaf2 = jnp.where(on_leaf & ~go_left, new, row_leaf)
 
             # --- smaller-child histogram + sibling subtraction ---
-            target_is_left = best.left_count[l] <= best.right_count[l]
+            target_is_left = bsl.left_count <= bsl.right_count
             target_leaf = jnp.where(target_is_left, l, new)
             slot = jnp.where(row_leaf2 == target_leaf, 0, -1)
             hist_t = _psum(build_histograms(bins, gh, slot, num_slots=1,
@@ -407,16 +484,16 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                                    0)
                 p_lo, p_hi = leaf_lo[l], leaf_hi[l]
                 l_hi = jnp.where(mono_d > 0,
-                                 jnp.minimum(p_hi, best.right_output[l]),
+                                 jnp.minimum(p_hi, bsl.right_output),
                                  p_hi)
                 l_lo = jnp.where(mono_d < 0,
-                                 jnp.maximum(p_lo, best.right_output[l]),
+                                 jnp.maximum(p_lo, bsl.right_output),
                                  p_lo)
                 r_lo = jnp.where(mono_d > 0,
-                                 jnp.maximum(p_lo, best.left_output[l]),
+                                 jnp.maximum(p_lo, bsl.left_output),
                                  p_lo)
                 r_hi = jnp.where(mono_d < 0,
-                                 jnp.minimum(p_hi, best.left_output[l]),
+                                 jnp.minimum(p_hi, bsl.left_output),
                                  p_hi)
                 leaf_lo2 = leaf_lo.at[l].set(l_lo).at[new].set(r_lo)
                 leaf_hi2 = leaf_hi.at[l].set(l_hi).at[new].set(r_hi)

@@ -32,7 +32,9 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                          axis_name: str = DATA_AXIS,
                          has_cat: bool = False,
                          use_mono_bounds: bool = False,
-                         use_node_masks: bool = False, node_masks=None):
+                         use_node_masks: bool = False, node_masks=None,
+                         n_forced: int = 0, forced_leaf=None,
+                         forced_feat=None, forced_thr=None):
     """shard_map-wrapped tree growth: bins/gh row-sharded in, replicated tree
     + row-sharded leaf assignment out. ``has_cat`` enables the categorical
     split scan (pass True whenever the dataset has categorical features —
@@ -45,7 +47,11 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                     max_bins, max_depth, hist_impl=hist_impl,
                     psum_axis=axis_name, has_cat=has_cat,
                     use_mono_bounds=use_mono_bounds,
-                    use_node_masks=use_node_masks, node_masks=node_masks)
+                    use_node_masks=use_node_masks, node_masks=node_masks,
+                    **({"n_forced": n_forced, "forced_leaf": forced_leaf,
+                        "forced_feat": forced_feat,
+                        "forced_thr": forced_thr}
+                       if policy == "leafwise" and n_forced else {}))
 
     sharded = shard_map(
         per_shard, mesh=mesh,

@@ -112,3 +112,37 @@ def test_cli_train_and_predict(tmp_path):
     assert preds.shape == (600,)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, preds) > 0.9
+
+
+def test_cli_python_consistency(tmp_path):
+    """CLI training and Python-API training on the same file produce the
+    same model (ref: tests/python_package_test/test_consistency.py)."""
+    import os
+    import subprocess
+    import sys
+    import lightgbm_tpu as lgb
+    X, y = _data(R=700, seed=9)
+    train_p = str(tmp_path / "c.csv")
+    _write_csv(train_p, X, y)
+    model_p = str(tmp_path / "cli_model.txt")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    args = ["objective=binary", "num_leaves=7", "num_iterations=4",
+            "min_data_in_leaf=5", "verbose=-1", "seed=3",
+            "deterministic=true"]
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train",
+         f"data={train_p}", f"output_model={model_p}"] + args,
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+
+    ds = lgb.Dataset(train_p, params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1, "seed": 3,
+                     "deterministic": True}, ds, num_boost_round=4)
+    cli_bst = lgb.Booster(model_file=model_p)
+    import numpy as np
+    Xq = np.where(np.isnan(X), np.nan, X)
+    np.testing.assert_allclose(cli_bst.predict(Xq), bst.predict(Xq),
+                               rtol=1e-9)

@@ -1,0 +1,33 @@
+"""Forced splits JSON (ref: serial_tree_learner.cpp:455 ForceSplits)."""
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_forced_splits_shape_tree(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 3).astype(np.float32)
+    y = (X[:, 2] > 0.5).astype(np.float32)  # signal on feature 2 only
+    fs = {"feature": 0, "threshold": 0.5,
+          "left": {"feature": 1, "threshold": 0.3}}
+    path = str(tmp_path / "forced.json")
+    json.dump(fs, open(path, "w"))
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 8, "verbose": -1,
+                     "min_data_in_leaf": 5, "forcedsplits_filename": path},
+                    ds, num_boost_round=1)
+    t = bst.models[0]
+    # node 0 must split feature 0 at ~0.5; node 1 feature 1 at ~0.3 —
+    # neither would be chosen by gain (the signal is feature 2)
+    assert int(t.split_feature[0]) == 0
+    assert abs(float(t.threshold[0]) - 0.5) < 0.05
+    assert int(t.split_feature[1]) == 1
+    assert abs(float(t.threshold[1]) - 0.3) < 0.05
+    # remaining splits are free and find the signal
+    used = set(t.split_feature[:t.num_internal].tolist())
+    assert 2 in used
+    # leaf stats stay consistent with the partition
+    total = int(t.leaf_count.sum())
+    assert total == 2000
