@@ -101,6 +101,26 @@ def run_predict(params: Dict[str, str]) -> None:
     log.info("Finished prediction; results saved to %s", out_path)
 
 
+def run_refit(params: Dict[str, str]) -> None:
+    """(ref: application.cpp task=refit + gbdt.cpp:287 RefitTree)"""
+    data = params.pop("data", None)
+    model = params.pop("input_model", None)
+    if not data or not model:
+        raise SystemExit("task=refit requires data=<file> and "
+                         "input_model=<file>")
+    out_path = params.get("output_model", "LightGBM_model.txt")
+    booster = Booster(model_file=model)
+    from .io.file_loader import load_text_file
+    X, y, _ = load_text_file(data,
+                             label_column=params.get("label_column", 0))
+    if y is None:
+        raise SystemExit("refit data must carry a label column")
+    decay = float(params.get("refit_decay_rate", 0.9))
+    new_booster = booster.refit(X, y, decay_rate=decay)
+    new_booster.save_model(out_path)
+    log.info("Finished refit; model saved to %s", out_path)
+
+
 def main(argv: List[str] = None) -> None:
     # honor JAX_PLATFORMS deterministically: TPU-terminal environments may
     # register their platform plugin in a way that outranks the env var
@@ -118,6 +138,8 @@ def main(argv: List[str] = None) -> None:
         run_train(params)
     elif task in ("predict", "prediction", "test"):
         run_predict(params)
+    elif task == "refit":
+        run_refit(params)
     elif task == "convert_model":
         raise SystemExit("convert_model (if-else codegen) is not supported")
     else:

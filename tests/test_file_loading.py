@@ -146,3 +146,37 @@ def test_cli_python_consistency(tmp_path):
     Xq = np.where(np.isnan(X), np.nan, X)
     np.testing.assert_allclose(cli_bst.predict(Xq), bst.predict(Xq),
                                rtol=1e-9)
+
+
+def test_cli_refit(tmp_path):
+    """CLI refit task re-fits leaf values on new data
+    (ref: application.cpp task=refit)."""
+    import os
+    import subprocess
+    import sys
+    X, y = _data(R=500, seed=4)
+    train_p = str(tmp_path / "r.csv")
+    _write_csv(train_p, X, y)
+    model_p = str(tmp_path / "m.txt")
+    refit_p = str(tmp_path / "m2.txt")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    base = ["objective=binary", "num_leaves=7", "min_data_in_leaf=5",
+            "verbose=-1"]
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train",
+         f"data={train_p}", "num_iterations=3",
+         f"output_model={model_p}"] + base,
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    r2 = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=refit",
+         f"data={train_p}", f"input_model={model_p}",
+         f"output_model={refit_p}", "verbose=-1"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r2.returncode == 0, r2.stderr[-500:]
+    import lightgbm_tpu as lgb
+    b = lgb.Booster(model_file=refit_p)
+    assert b.num_trees() == 3
