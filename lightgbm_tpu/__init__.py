@@ -6,7 +6,7 @@ jit-compiled JAX/XLA histogram + split kernels, tree growth without host
 round trips, and XLA collectives over a device mesh in place of the
 socket/MPI network layer.
 """
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
@@ -25,7 +25,7 @@ except ImportError:  # pragma: no cover
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "Config", "CVBooster",
+    "Dataset", "Booster", "Sequence", "Config", "CVBooster",
     "train", "cv",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",

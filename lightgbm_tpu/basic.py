@@ -9,7 +9,7 @@ model IO, continued training) is preserved.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence as _TSeq, Union
 
 import numpy as np
 
@@ -22,7 +22,42 @@ from .models.tree import HostTree
 from .objective import create_objective, create_objective_from_string
 from .utils import log
 
-__all__ = ["Dataset", "Booster"]
+__all__ = ["Dataset", "Booster", "Sequence"]
+
+
+class Sequence:
+    """Generic chunked data-access interface for dataset construction
+    (ref: basic.py:605 Sequence ABC): implement ``__len__``,
+    ``__getitem__`` for slices, and optionally ``batch_size``. The matrix
+    is assembled in ``batch_size`` slices (the source never has to hand
+    over one giant array; the assembled matrix itself is in RAM — the
+    binned representation is what training keeps).
+
+    A list of Sequences concatenates row-wise (multi-file datasets)."""
+
+    batch_size = 4096
+
+    def __len__(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def __getitem__(self, idx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _materialize_sequences(seqs) -> np.ndarray:
+    """Assemble a row-major float64 matrix from Sequence chunks (float64
+    so binning matches the equivalent ndarray input exactly)."""
+    if isinstance(seqs, Sequence):
+        seqs = [seqs]
+    chunks = []
+    for seq in seqs:
+        n = len(seq)
+        bs = int(getattr(seq, "batch_size", None) or 4096)
+        for lo in range(0, n, bs):
+            chunks.append(np.asarray(seq[lo:min(n, lo + bs)], np.float64))
+    if not chunks:
+        raise ValueError("Sequence dataset has 0 rows")
+    return np.concatenate(chunks, axis=0)
 
 
 def pred_trees_stale(pred, booster) -> bool:
@@ -71,6 +106,11 @@ class Dataset:
         if self._inner is not None:
             return self
         cfg = Config(self.params)
+        if isinstance(self.data, Sequence) or (
+                isinstance(self.data, list) and self.data
+                and all(isinstance(x, Sequence) for x in self.data)):
+            # chunked out-of-core assembly (ref: Sequence streaming push)
+            self.data = _materialize_sequences(self.data)
         if isinstance(self.data, (str, os.PathLike)):
             # file-based ingestion (ref: DatasetLoader::LoadFromFile)
             from .io.file_loader import load_text_file
@@ -91,7 +131,7 @@ class Dataset:
             feature_names = list(self.feature_name)
         elif hasattr(self.data, "columns"):
             feature_names = [str(c) for c in self.data.columns]
-        cats: Sequence[int] = ()
+        cats: _TSeq[int] = ()
         if self.categorical_feature != "auto" \
                 and self.categorical_feature is not None:
             cats = []

@@ -150,6 +150,14 @@ class TpuDataset:
         sample = np.asarray(data[sample_idx], dtype=np.float64)
         forced_bounds = forced_bounds or {}
 
+        # per-feature bin budget override (ref: config.h
+        # max_bin_by_feature, dataset_loader.cpp bin-mapper construction)
+        mb_by_feat = list(config.max_bin_by_feature or [])
+        if mb_by_feat and len(mb_by_feat) != f:
+            log.fatal("max_bin_by_feature has %d entries but the data has "
+                      "%d features" % (len(mb_by_feat), f))
+        if any(int(b) <= 1 for b in mb_by_feat):
+            log.fatal("max_bin_by_feature entries must be > 1")
         self.mappers = []
         for j in range(f):
             m = BinMapper()
@@ -158,7 +166,8 @@ class TpuDataset:
             # the reference feeds only the non-zero sampled values plus the
             # total count (zeros implicit); replicate that contract
             nz = col[(np.abs(col) > 1e-35) | np.isnan(col)]
-            m.find_bin(nz, total_sample_cnt=len(col), max_bin=config.max_bin,
+            mb_j = int(mb_by_feat[j]) if mb_by_feat else config.max_bin
+            m.find_bin(nz, total_sample_cnt=len(col), max_bin=mb_j,
                        min_data_in_bin=config.min_data_in_bin,
                        min_split_data=config.min_data_in_leaf if
                        config.feature_pre_filter else 0,

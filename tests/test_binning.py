@@ -108,3 +108,18 @@ def test_min_data_in_bin_respected():
     # every non-empty interior bin holds >= min_data_in_bin
     nonzero = counts[counts > 0]
     assert (nonzero >= 40).all()  # greedy packing allows slight undershoot
+
+
+def test_max_bin_by_feature():
+    """(ref: config.h max_bin_by_feature)"""
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TpuDataset
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 3)
+    cfg = Config({"max_bin": 255, "max_bin_by_feature": [8, 255, 16],
+                  "verbose": -1})
+    ds = TpuDataset.from_data(X, cfg)
+    assert ds.mappers[0].num_bin <= 8
+    assert ds.mappers[1].num_bin > 100
+    assert ds.mappers[2].num_bin <= 16

@@ -180,3 +180,29 @@ def test_cli_refit(tmp_path):
     import lightgbm_tpu as lgb
     b = lgb.Booster(model_file=refit_p)
     assert b.num_trees() == 3
+
+
+def test_sequence_dataset():
+    """Chunked Sequence ingestion (ref: basic.py:605 Sequence)."""
+    import lightgbm_tpu as lgb
+
+    class NpSeq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __len__(self):
+            return len(self.arr)
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+    X, y = _data(R=600, seed=6)
+    Xc = np.where(np.isnan(X), 0.0, X)
+    ds = lgb.Dataset([NpSeq(Xc[:300]), NpSeq(Xc[300:])], label=y,
+                     params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=5)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(Xc)) > 0.9
