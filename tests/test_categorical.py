@@ -83,3 +83,23 @@ def test_categorical_valid_eval_matches_predict():
     want = log_loss(yv, bst.predict(Xv))
     got = evals["v"]["binary_logloss"][-1]
     assert abs(want - got) < 5e-3, (want, got)
+
+
+def test_binary_cache_roundtrip_with_categorical(tmp_path):
+    """Dataset binary cache preserves categorical vocab + bins
+    (ref: dataset_loader.cpp:336 LoadFromBinFile)."""
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TpuDataset
+    X, y, _ = _cat_data(R=800, seed=7)
+    cfg = Config({"verbose": -1})
+    ds = TpuDataset.from_data(np.asarray(X, np.float64), cfg,
+                              categorical_feature=[0])
+    path = str(tmp_path / "d.bin")
+    ds.save_binary(path)
+    ds2 = TpuDataset.load_binary(path)
+    np.testing.assert_array_equal(np.asarray(ds.bins),
+                                  np.asarray(ds2.bins))
+    assert ds2.is_categorical[0] and not ds2.is_categorical[1]
+    m1, m2 = ds.mappers[0], ds2.mappers[0]
+    assert m1.bin_2_categorical == m2.bin_2_categorical
