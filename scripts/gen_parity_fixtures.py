@@ -58,3 +58,66 @@ arr = np.array(rows, np.int32)
 assert arr.shape == (R, 5), arr.shape
 np.save(f"{OUT}/ref_bins.npy", arr.astype(np.uint8))
 print("fixtures written to", OUT)
+# ---------------------------------------------------------------------
+# Part 2: multiclass / weighted / DART / lambdarank fixtures
+OUT = "/root/repo/tests/fixtures"
+rng = np.random.RandomState(123)
+R = 3000
+X = rng.randn(R, 6).astype(np.float64)
+X[::9, 3] = np.nan
+np.save(f"{OUT}/parity2_X.npy", X.astype(np.float32))
+
+# multiclass
+y3 = np.argmax(X[:, :3] + 0.3 * rng.randn(R, 3), axis=1)
+np.save(f"{OUT}/parity2_y_mc.npy", y3.astype(np.float32))
+ds = ref_lgb.Dataset(X, label=y3, params={"verbose": -1, "max_bin": 63})
+bst = ref_lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbose": -1, "max_bin": 63,
+                     "deterministic": True, "force_row_wise": True,
+                     "seed": 5}, ds, num_boost_round=10)
+bst.save_model(f"{OUT}/ref_model_multiclass.txt")
+np.save(f"{OUT}/ref_pred_multiclass.npy", bst.predict(X))
+
+# weighted regression
+yw = (X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.randn(R))
+w = np.abs(rng.randn(R)) + 0.1
+np.save(f"{OUT}/parity2_y_reg.npy", yw.astype(np.float32))
+np.save(f"{OUT}/parity2_w.npy", w.astype(np.float32))
+ds = ref_lgb.Dataset(X, label=yw, weight=w,
+                     params={"verbose": -1, "max_bin": 63})
+bst = ref_lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "max_bin": 63, "deterministic": True,
+                     "force_row_wise": True, "seed": 5},
+                    ds, num_boost_round=10)
+bst.save_model(f"{OUT}/ref_model_weighted.txt")
+np.save(f"{OUT}/ref_pred_weighted.npy", bst.predict(X))
+
+# dart binary
+yb = (X[:, 0] + X[:, 2] > 0).astype(np.float64)
+np.save(f"{OUT}/parity2_y_bin.npy", yb.astype(np.float32))
+ds = ref_lgb.Dataset(X, label=yb, params={"verbose": -1, "max_bin": 63})
+bst = ref_lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "drop_rate": 0.2, "verbose": -1,
+                     "max_bin": 63, "deterministic": True,
+                     "force_row_wise": True, "seed": 5, "drop_seed": 4},
+                    ds, num_boost_round=12)
+bst.save_model(f"{OUT}/ref_model_dart.txt")
+np.save(f"{OUT}/ref_pred_dart.npy", bst.predict(X))
+
+# lambdarank
+n_q = 60
+per_q = R // n_q
+rel = (2.5 * X[:n_q * per_q, 0] + rng.rand(n_q * per_q)).astype(int)
+rel = np.clip(rel - rel.min(), 0, 4)
+grp = np.full(n_q, per_q)
+np.save(f"{OUT}/parity2_rel.npy", rel.astype(np.float32))
+np.save(f"{OUT}/parity2_grp.npy", grp.astype(np.int64))
+ds = ref_lgb.Dataset(X[:n_q * per_q], label=rel, group=grp,
+                     params={"verbose": -1, "max_bin": 63})
+bst = ref_lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbose": -1, "max_bin": 63, "deterministic": True,
+                     "force_row_wise": True, "seed": 5},
+                    ds, num_boost_round=10)
+bst.save_model(f"{OUT}/ref_model_rank.txt")
+np.save(f"{OUT}/ref_pred_rank.npy", bst.predict(X[:n_q * per_q]))
+print("fixtures2 written")
