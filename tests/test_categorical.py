@@ -103,3 +103,20 @@ def test_binary_cache_roundtrip_with_categorical(tmp_path):
     assert ds2.is_categorical[0] and not ds2.is_categorical[1]
     m1, m2 = ds.mappers[0], ds2.mappers[0]
     assert m1.bin_2_categorical == m2.bin_2_categorical
+
+
+def test_dart_with_categorical():
+    """DART dropout + categorical splits: valid-set scoring and dropout
+    re-routing must handle categorical device trees."""
+    X, y, _ = _cat_data(R=2500, seed=11)
+    Xv, yv = X[2000:], y[2000:]
+    ds = lgb.Dataset(X[:2000], label=y[:2000], params={"verbose": -1},
+                     categorical_feature=[0])
+    dv = ds.create_valid(Xv, label=yv)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 8, "drop_rate": 0.3, "verbose": -1,
+                     "min_data_in_leaf": 5, "min_data_per_group": 5,
+                     "cat_smooth": 1.0, "metric": "binary_logloss"},
+                    ds, num_boost_round=8, valid_sets=[dv])
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(yv, bst.predict(Xv)) > 0.85
