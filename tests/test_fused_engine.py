@@ -66,3 +66,37 @@ def test_fused_matches_xla_trees_first_iter():
     def root(m):
         return (m["split_feature"], round(m["threshold"], 6))
     assert root(models["fused"]) == root(models["xla"])
+
+
+def test_fused_engine_goss_and_rf():
+    """GOSS sampling and random-forest mode run through the fused engine
+    (host-driven sampling feeding the fused grower)."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    from sklearn.metrics import roc_auc_score
+    for boosting, extra in (("goss", {}),
+                            ("rf", {"bagging_freq": 1,
+                                    "bagging_fraction": 0.7})):
+        ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+        bst = lgb.train(dict({"objective": "binary", "boosting": boosting,
+                              "num_leaves": 15, "verbose": -1,
+                              "min_data_in_leaf": 5,
+                              "tpu_engine": "fused"}, **extra),
+                        ds, num_boost_round=8)
+        auc = roc_auc_score(y, bst.predict(X))
+        assert auc > 0.9, (boosting, auc)
+
+
+def test_fused_engine_multiclass_and_weights():
+    rng = np.random.RandomState(6)
+    X = rng.randn(2000, 5).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.3 * rng.randn(2000, 3), axis=1)
+    w = np.abs(rng.randn(2000)).astype(np.float32) + 0.1
+    ds = lgb.Dataset(X, label=y, weight=w, params={"verbose": -1})
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 5, "tpu_engine": "fused"},
+                    ds, num_boost_round=8)
+    acc = (np.argmax(bst.predict(X), 1) == y).mean()
+    assert acc > 0.85, acc
