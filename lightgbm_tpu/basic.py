@@ -230,6 +230,13 @@ class Dataset:
         return None if boundaries is None else np.diff(boundaries)
 
     # ------------------------------------------------------------------
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """(ref: basic.py Dataset.add_features_from)"""
+        self.construct()
+        other.construct()
+        self._inner.add_features_from(other._inner)
+        return self
+
     def num_data(self) -> int:
         return self.construct()._inner.num_data
 

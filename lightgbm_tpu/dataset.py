@@ -212,6 +212,35 @@ class TpuDataset:
         self.bins = out
 
     # ------------------------------------------------------------------
+    def add_features_from(self, other: "TpuDataset") -> None:
+        """Append the other dataset's features column-wise (ref:
+        dataset.h AddFeaturesFrom / basic.py add_features_from). Both
+        datasets must be constructed with the same row count; the other's
+        mappers and binned columns are adopted as new features."""
+        if other.num_data != self.num_data:
+            log.fatal("add_features_from: row counts differ (%d vs %d)"
+                      % (self.num_data, other.num_data))
+        base = len(self.mappers)
+        self.num_total_features += other.num_total_features
+        self.mappers.extend(other.mappers)
+        self.used_features.extend(base + j for j in other.used_features)
+        self.feature_names = list(self.feature_names) + [
+            f"{n}" if n not in self.feature_names else f"{n}_2"
+            for n in other.feature_names]
+        dtype = (np.uint16 if max(self.max_num_bin, other.max_num_bin) > 256
+                 else self.bins.dtype)
+        self.bins = np.concatenate(
+            [np.asarray(self.bins, dtype), np.asarray(other.bins, dtype)],
+            axis=1)
+        if self.monotone_constraints is not None or                 other.monotone_constraints is not None:
+            a = (self.monotone_constraints if self.monotone_constraints
+                 is not None else np.zeros(base, np.int32))
+            b = (other.monotone_constraints
+                 if other.monotone_constraints is not None
+                 else np.zeros(len(other.mappers), np.int32))
+            self.monotone_constraints = np.concatenate([a, b])
+        self._finalize_feature_arrays()
+
     @property
     def num_features(self) -> int:
         return len(self.used_features)

@@ -206,3 +206,18 @@ def test_sequence_dataset():
                      "min_data_in_leaf": 5}, ds, num_boost_round=5)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, bst.predict(Xc)) > 0.9
+
+
+def test_add_features_from():
+    """(ref: dataset.h AddFeaturesFrom)"""
+    import lightgbm_tpu as lgb
+    X, y = _data(R=800, seed=12)
+    d1 = lgb.Dataset(X[:, :3], label=y, params={"verbose": -1})
+    d2 = lgb.Dataset(X[:, 3:], params={"verbose": -1})
+    d1.add_features_from(d2)
+    assert d1.num_feature() >= 5
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5}, d1, num_boost_round=5)
+    from sklearn.metrics import roc_auc_score
+    Xq = np.where(np.isnan(X), np.nan, X)
+    assert roc_auc_score(y, bst.predict(Xq)) > 0.9

@@ -100,3 +100,19 @@ def test_fused_engine_multiclass_and_weights():
                     ds, num_boost_round=8)
     acc = (np.argmax(bst.predict(X), 1) == y).mean()
     assert acc > 0.85, acc
+
+
+def test_fused_engine_quantile_renew():
+    """Quantile objective's leaf renewal (host path) composes with the
+    fused grower's device row_leaf."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(2000, 4).astype(np.float32)
+    y = (2 * X[:, 0] + rng.standard_exponential(2000) * 0.3) \
+        .astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "quantile", "alpha": 0.8,
+                     "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 10, "tpu_engine": "fused"},
+                    ds, num_boost_round=20)
+    cover = float((y <= bst.predict(X)).mean())
+    assert 0.7 < cover < 0.9, cover
