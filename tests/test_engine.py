@@ -339,3 +339,23 @@ def test_prediction_early_stop():
     assert np.all(np.abs(es[moved]) >= 2.0)
     assert np.sign(es[moved]).astype(int).tolist() == \
         np.sign(full[moved]).astype(int).tolist()
+
+
+def test_cv_with_query_groups():
+    """Ranking CV keeps whole queries per fold (ref: engine.py:323
+    _make_n_folds group handling)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(8)
+    n_q, per_q = 24, 20
+    X = rng.rand(n_q * per_q, 4).astype(np.float32)
+    rel = (3 * X[:, 0] + 0.2 * rng.rand(n_q * per_q)).astype(int).clip(0, 3)
+    ds = lgb.Dataset(X, label=rel, group=np.full(n_q, per_q),
+                     params={"verbose": -1})
+    res = lgb.cv({"objective": "lambdarank", "num_leaves": 7,
+                  "verbose": -1, "min_data_in_leaf": 5,
+                  "metric": "ndcg", "ndcg_eval_at": [5]},
+                 ds, num_boost_round=4, nfold=3, stratified=False)
+    key = [k for k in res if k.startswith("valid")][0]
+    assert len(res[key]) == 4
+    assert res[key][-1] > 0.5
