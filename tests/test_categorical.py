@@ -120,3 +120,19 @@ def test_dart_with_categorical():
                     ds, num_boost_round=8, valid_sets=[dv])
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(yv, bst.predict(Xv)) > 0.85
+
+
+def test_pred_contrib_with_categorical():
+    """TreeSHAP contributions sum to the raw prediction, incl.
+    categorical splits (ref: tree.h:437 PredictContrib)."""
+    X, y, _ = _cat_data(R=1200, seed=13)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1},
+                     categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 8, "verbose": -1,
+                     "min_data_in_leaf": 5, "min_data_per_group": 5,
+                     "cat_smooth": 1.0}, ds, num_boost_round=4)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    raw = bst.predict(X[:50], raw_score=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-6)

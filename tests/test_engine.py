@@ -359,3 +359,22 @@ def test_cv_with_query_groups():
     key = [k for k in res if k.startswith("valid")][0]
     assert len(res[key]) == 4
     assert res[key][-1] > 0.5
+
+
+def test_predict_iteration_slicing():
+    """start_iteration/num_iteration slicing (ref: basic.py predict)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(10)
+    X = rng.randn(500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=6)
+    full = bst.predict(X, raw_score=True)
+    head = bst.predict(X, raw_score=True, num_iteration=2)
+    tail = bst.predict(X, raw_score=True, start_iteration=2)
+    # head uses trees [0,2), tail trees [2,6); raw scores add up (minus
+    # the double-counted boost-from-average constant folded into tree 0)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-9, atol=1e-9)
+    assert not np.allclose(head, full)
