@@ -116,3 +116,19 @@ def test_fused_engine_quantile_renew():
                     ds, num_boost_round=20)
     cover = float((y <= bst.predict(X)).mean())
     assert 0.7 < cover < 0.9, cover
+
+
+def test_reset_parameter_callback_with_fused_engine():
+    """Learning-rate schedules via reset_parameter recompile cleanly
+    against the fused engine's cached jits."""
+    rng = np.random.RandomState(8)
+    X = rng.randn(1500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5, "tpu_engine": "fused"},
+                    ds, num_boost_round=6,
+                    callbacks=[lgb.reset_parameter(
+                        learning_rate=lambda i: 0.2 * (0.9 ** i))])
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.95
