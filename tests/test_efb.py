@@ -1,0 +1,72 @@
+"""Exclusive Feature Bundling foundations (ref: src/io/dataset.cpp
+FindGroups/FastFeatureBundling + dataset.cpp:1265 FixHistogram)."""
+import numpy as np
+
+from lightgbm_tpu.ops.efb import (BundleLayout, encode_bundles,
+                                  find_bundles, logical_histograms)
+
+
+def _sparse_data(R=4000, seed=0):
+    """Three mutually-exclusive sparse features + one dense feature."""
+    rng = np.random.RandomState(seed)
+    owner = rng.randint(0, 4, R)       # 3 = no sparse feature active
+    bins = np.zeros((R, 4), np.int64)
+    for f in range(3):
+        m = owner == f
+        bins[m, f] = rng.randint(1, 8, int(m.sum()))
+    bins[:, 3] = rng.randint(0, 16, R)  # dense
+    nb = [8, 8, 8, 16]
+    db = [0, 0, 0, 0]
+    return bins, nb, db
+
+
+def test_find_bundles_groups_exclusive_features():
+    bins, nb, db = _sparse_data()
+    masks = [bins[:, f] != db[f] for f in range(4)]
+    bundles = find_bundles(masks, len(bins))
+    # the three exclusive sparse features share one bundle; the dense
+    # feature stays alone
+    sizes = sorted(len(b) for b in bundles)
+    assert sizes == [1, 3]
+    dense_bundle = [b for b in bundles if 3 in b][0]
+    assert dense_bundle == [3]
+
+
+def test_encode_and_reconstruct_exact():
+    bins, nb, db = _sparse_data()
+    masks = [bins[:, f] != db[f] for f in range(4)]
+    bundles = find_bundles(masks, len(bins))
+    layout = BundleLayout(bundles, nb)
+    assert layout.num_columns == 2
+    enc = encode_bundles(bins, db, layout)
+
+    # histograms over encoded columns with unit weights
+    S = 1
+    ch = 1
+    Bc = max(layout.col_num_bin)
+    bh = np.zeros((S, layout.num_columns, Bc, ch))
+    for ci in range(layout.num_columns):
+        np.add.at(bh[0, ci, :, 0], enc[:, ci], 1.0)
+    totals = np.array([[len(bins)]], np.float64)
+    logical = logical_histograms(bh, totals, layout, nb, db, 16)
+
+    # must equal the direct per-feature histograms exactly (no conflicts
+    # in mutually-exclusive data)
+    for f in range(4):
+        want = np.zeros(16)
+        np.add.at(want, bins[:, f], 1.0)
+        np.testing.assert_allclose(logical[0, f, :, 0], want)
+
+
+def test_conflict_budget_respected():
+    rng = np.random.RandomState(1)
+    R = 1000
+    # two sparse features with ~5% overlap: too many conflicts to bundle
+    # at a tight budget
+    a = rng.rand(R) < 0.3
+    b = rng.rand(R) < 0.3
+    masks = [a, b]
+    tight = find_bundles(masks, R, max_conflict_rate=0.0001)
+    assert sorted(len(x) for x in tight) == [1, 1]
+    loose = find_bundles(masks, R, max_conflict_rate=0.2)
+    assert sorted(len(x) for x in loose) == [2]
