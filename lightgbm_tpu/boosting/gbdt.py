@@ -135,6 +135,7 @@ class GBDT:
                                            != 0))
         self._setup_cegb(config)
         self._setup_forced_splits(config, train_data)
+        self._setup_bundles(config, train_data)
         # NOTE: computed before _setup_engine so the frontier-v1 fallback
         # sees them
         ic = config.interaction_constraints
@@ -203,6 +204,70 @@ class GBDT:
         self.es_first_metric_only = bool(config.first_metric_only)
 
 
+
+    # ------------------------------------------------------------------
+    def _setup_bundles(self, config: Config, train_data) -> None:
+        """Exclusive feature bundling for the depthwise XLA grower (ref:
+        src/io/dataset.cpp FindGroups/FastFeatureBundling). Engaged only
+        when bundling actually reduces the column count; opt-in via
+        tpu_enable_bundle until the fused engine integration lands (the
+        reference's enable_bundle default stays accepted but maps to the
+        logical layout elsewhere)."""
+        self.use_bundles = False
+        if not bool(config.tpu_enable_bundle):
+            return
+        if self.has_cat:
+            log.warning("feature bundling with categorical features is "
+                        "not supported yet; disabled")
+            return
+        if getattr(self, "n_forced", 0) > 0:
+            return  # forced splits route through the leaf-wise grower
+        from ..ops.efb import BundleLayout, encode_bundles, find_bundles
+        bins_np = np.asarray(train_data.bins)
+        mfb = getattr(train_data, "most_freq_bins", None)
+        if mfb is None:
+            mfb = np.array([train_data.mappers[j].most_freq_bin
+                            for j in train_data.used_features], np.int32)
+        masks = [bins_np[:, k] != mfb[k]
+                 for k in range(train_data.num_features)]
+        nb_all = [int(x) for x in np.asarray(self.meta.num_bin)]
+        # keep the uniform column padding (Bc) economical: bundles are
+        # capped at 4x the widest feature (jagged column offsets are a
+        # round-3 improvement)
+        bundles = find_bundles(masks, self.num_data,
+                               max_conflict_rate=0.0,
+                               max_bundle_bins=4 * self.max_bins,
+                               num_bin_per_feat=nb_all)
+        if len(bundles) >= train_data.num_features:
+            return  # nothing to gain
+        nb = nb_all
+        layout = BundleLayout(bundles, nb)
+        enc = encode_bundles(bins_np, mfb, layout)
+        Bc = max(layout.col_num_bin)
+        B = self.max_bins
+        F = train_data.num_features
+        flat_idx = np.zeros((F, B), np.int32)
+        valid = np.zeros((F, B), bool)
+        for f in range(F):
+            ci = int(layout.col_of_feat[f])
+            off = int(layout.offset_of_feat[f])
+            for b in range(nb[f]):
+                flat_idx[f, b] = ci * Bc + off + b
+                valid[f, b] = True
+        from ..models.learner import BundleCfg
+        # FixHistogram residual lands on each feature's MOST FREQUENT bin
+        # (the rows encoded as bundle-default), not the zero-default bin
+        self.bundle_cfg = BundleCfg(
+            flat_idx=jnp.asarray(flat_idx), valid=jnp.asarray(valid),
+            default_bin=jnp.asarray(np.asarray(mfb, np.int32)),
+            col_of_feat=jnp.asarray(layout.col_of_feat),
+            offset_of_feat=jnp.asarray(layout.offset_of_feat))
+        self.bundle_bins_dev = jnp.asarray(enc.astype(
+            np.uint8 if Bc <= 256 else np.uint16))
+        self.bundle_col_bins = int(Bc)
+        self.use_bundles = True
+        log.info("EFB: %d features bundled into %d columns",
+                 F, layout.num_columns)
 
     # ------------------------------------------------------------------
     def _setup_forced_splits(self, config: Config, train_data) -> None:
@@ -288,6 +353,9 @@ class GBDT:
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
             engine = "xla"
+        if getattr(self, "use_bundles", False) and engine != "xla":
+            log.info("feature bundling uses the depthwise XLA engine")
+            engine = "xla"
         if getattr(self, "use_cegb", False) and engine != "xla":
             # CEGB gain deltas are wired into the depthwise XLA grower;
             # must override BEFORE the engine flags are derived
@@ -310,7 +378,8 @@ class GBDT:
             self.use_fused = True
             self.fused_interpret = not self.on_tpu
         default_policy = ("depthwise" if (self.use_fused or self.use_frontier
-                                          or getattr(self, "use_cegb",
+                                          or getattr(self, "use_cegb", False)
+                                          or getattr(self, "use_bundles",
                                                      False))
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
@@ -320,6 +389,15 @@ class GBDT:
             log.warning("CEGB is implemented on the depthwise grower; "
                         "switching grow_policy")
             self.grow_policy = "depthwise"
+        if getattr(self, "use_bundles", False) \
+                and self.grow_policy != "depthwise":
+            log.warning("feature bundling is implemented on the depthwise "
+                        "grower; switching grow_policy")
+            self.grow_policy = "depthwise"
+        if getattr(self, "use_bundles", False) \
+                and getattr(self, "n_forced", 0) > 0:
+            log.warning("forced splits disable feature bundling")
+            self.use_bundles = False
         if getattr(self, "n_forced", 0) > 0 \
                 and self.grow_policy != "leafwise":
             log.warning("forced splits are implemented on the leaf-wise "
@@ -576,8 +654,10 @@ class GBDT:
                 self.max_leaves, self.frontier_Bp,
                 int(self.config.max_depth), hist_impl="pallas")
         if self.grow_policy == "depthwise":
+            ub = getattr(self, "use_bundles", False)
             return grow_tree_depthwise(
-                self.bins_dev, gh, self.meta, fm, self.params,
+                self.bundle_bins_dev if ub else self.bins_dev, gh,
+                self.meta, fm, self.params,
                 self.max_leaves, self.max_bins,
                 int(self.config.max_depth),
                 hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
@@ -587,7 +667,10 @@ class GBDT:
                 use_cegb=self.use_cegb,
                 cegb_coupled=(self.cegb_coupled if self.use_cegb else None),
                 cegb_used=(jnp.asarray(self.cegb_used)
-                           if self.use_cegb else None))
+                           if self.use_cegb else None),
+                use_bundles=ub,
+                bundle_cfg=self.bundle_cfg if ub else None,
+                bundle_col_bins=(self.bundle_col_bins if ub else 0))
         n_forced = getattr(self, "n_forced", 0)
         return grow_tree_leafwise(
             self.bins_dev, gh, self.meta, fm, self.params,

@@ -185,6 +185,45 @@ def gather_split_info(pool_leaf, f, t, meta: "FeatureMeta",
         cat_flag=jnp.asarray(False), cat_mask=jnp.zeros((B,), bool))
 
 
+class BundleCfg(NamedTuple):
+    """Device arrays mapping logical features onto EFB bundle columns
+    (built from ops/efb.BundleLayout; see that module's docstring).
+
+    flat_idx: [F, B] int32 — index into the flattened [C*B_col] bundle
+      histogram for each (feature, bin); invalid bins point at slot 0 and
+      are masked by ``valid``.
+    valid: [F, B] bool.
+    default_bin: [F] int32 (receives the FixHistogram residual mass).
+    col_of_feat / offset_of_feat: [F] int32 — routing decode.
+    (The per-column bin count travels separately as the static
+    ``bundle_col_bins`` grower argument.)
+    """
+    flat_idx: jax.Array
+    valid: jax.Array
+    default_bin: jax.Array
+    col_of_feat: jax.Array
+    offset_of_feat: jax.Array
+
+
+def bundle_views(bundle_hist: jax.Array, cfg: BundleCfg) -> jax.Array:
+    """[S, C, Bc, ch] bundle histograms -> [S, F, B, ch] logical views
+    with the FixHistogram default-bin residual (ref: dataset.cpp:1265).
+    Slot totals come from column 0 (bundle bin 0 is a catch-all, so every
+    column partitions all rows)."""
+    S, C, Bc, ch = bundle_hist.shape
+    F, B = cfg.flat_idx.shape
+    flat = bundle_hist.reshape(S, C * Bc, ch)
+    view = jnp.take(flat, cfg.flat_idx.reshape(-1), axis=1)         .reshape(S, F, B, ch)
+    view = jnp.where(cfg.valid[None, :, :, None], view, 0.0)
+    totals = jnp.sum(bundle_hist[:, 0, :, :], axis=1)          # [S, ch]
+    residual = totals[:, None, :] - jnp.sum(view, axis=2)      # [S, F, ch]
+    add = jnp.zeros_like(view).at[
+        jnp.arange(S)[:, None],
+        jnp.arange(F)[None, :],
+        cfg.default_bin[None, :]].add(residual)
+    return view + add
+
+
 def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,
                       leaf_counts):
     """[S, F] CEGB gain delta: tradeoff*penalty_split*n_leaf plus the
@@ -543,7 +582,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat", "parallel_mode",
                      "top_k", "use_mono_bounds", "use_node_masks",
-                     "use_cegb"))
+                     "use_cegb", "use_bundles", "bundle_col_bins"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -557,6 +596,9 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         use_cegb: bool = False,
                         cegb_coupled: jax.Array = None,
                         cegb_used: jax.Array = None,
+                        use_bundles: bool = False,
+                        bundle_cfg: "BundleCfg" = None,
+                        bundle_col_bins: int = 0,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -584,6 +626,10 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
       scans via a per-leaf validity plane.
     """
     R, F = bins.shape
+    if use_bundles:
+        # ``bins`` holds EFB bundle columns; logical feature count comes
+        # from the mapping (ref: src/io/dataset.cpp feature groups)
+        F = bundle_cfg.flat_idx.shape[0]
     L = num_leaves
     B = max_bins
     n_levels = max_depth if max_depth > 0 else max(1, (L - 1).bit_length() + 1)
@@ -617,12 +663,22 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         valid = jnp.zeros((F,), bool).at[w_idx].set(True)
         return hist2, valid
 
+    def _hist(slot_vec, num_slots):
+        """Histogram pass; EFB mode histograms the bundle columns then
+        reassembles per-feature views (ref: dataset.cpp feature groups +
+        :1265 FixHistogram)."""
+        if use_bundles:
+            hb = build_histograms(bins, gh, slot_vec, num_slots=num_slots,
+                                  num_bins=bundle_col_bins, impl=hist_impl)
+            return bundle_views(hb, bundle_cfg)
+        return build_histograms(bins, gh, slot_vec, num_slots=num_slots,
+                                num_bins=B, impl=hist_impl)
+
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
     pool_valid = jnp.zeros((L, F), bool)
-    root_local = build_histograms(bins, gh, row_leaf, num_slots=1,
-                                  num_bins=B, impl=hist_impl)
+    root_local = _hist(row_leaf, 1)
     root_hist, root_valid = _exchange(root_local, jnp.zeros((1,)))
     pool = pool.at[0].set(root_hist[0])
     pool_valid = pool_valid.at[0].set(root_valid)
@@ -744,8 +800,22 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             l_row = row_leaf
             sel_row = selected[l_row]
             f_row = jnp.maximum(f_l[l_row], 0)  # -1 (no split) rows are masked
-            bins_row = jnp.take_along_axis(
-                r_bins, f_row[:, None].astype(jnp.int32), axis=1)[:, 0]
+            if use_bundles:
+                col_row = bundle_cfg.col_of_feat[f_row]
+                raw = jnp.take_along_axis(
+                    r_bins, col_row[:, None].astype(jnp.int32),
+                    axis=1)[:, 0].astype(jnp.int32)
+                off = bundle_cfg.offset_of_feat[f_row]
+                nb_row = r_meta.num_bin[f_row]
+                in_win = (raw >= off) & (raw < off + nb_row)
+                # out-of-window rows were encoded as bundle-default: they
+                # carry the feature's MOST FREQUENT bin (where the
+                # FixHistogram residual went), not the zero bin
+                bins_row = jnp.where(in_win, raw - off,
+                                     bundle_cfg.default_bin[f_row])
+            else:
+                bins_row = jnp.take_along_axis(
+                    r_bins, f_row[:, None].astype(jnp.int32), axis=1)[:, 0]
             go_left = _route_left(bins_row, t_l[l_row], dl_l[l_row],
                                   r_meta.num_bin[f_row],
                                   r_meta.missing_type[f_row],
@@ -760,9 +830,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             leaf_to_slot = jnp.where(selected, k_of_leaf, -1)
             row_slot = jnp.where(sel_row & (row_leaf2 == row_leaf),
                                  leaf_to_slot[l_row], -1)
-            hist_local = build_histograms(bins, gh, row_slot,
-                                          num_slots=L, num_bins=B,
-                                          impl=hist_impl)
+            hist_local = _hist(row_slot, L)
             hist_left, lvl_valid = _exchange(hist_local, tree2.leaf_value)
 
             # scatter: pool[l] = left hist, pool[new] = parent - left;

@@ -31,7 +31,9 @@ import numpy as np
 
 def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
                  max_conflict_rate: float = 0.0001,
-                 max_bundle_bins: int = 65535) -> List[List[int]]:
+                 max_bundle_bins: int = 65535,
+                 num_bin_per_feat: Sequence[int] = None
+                 ) -> List[List[int]]:
     """Greedy conflict-bounded bundling (ref: dataset.cpp FindGroups).
 
     Args:
@@ -49,25 +51,32 @@ def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
     budget = int(max_conflict_rate * num_rows)
     bundle_masks: List[np.ndarray] = []
     bundle_conflicts: List[int] = []
+    bundle_bins: List[int] = []
     bundles: List[List[int]] = []
+    nb = num_bin_per_feat
     for f in order:
         m = nondefault_masks[f]
         nnz = int(m.sum())
+        f_bins = int(nb[f]) if nb is not None else 1
         placed = False
         # skip bundling for dense features (no savings, conflicts certain)
         if nnz * 2 < num_rows:
             for bi in range(len(bundles)):
+                if bundle_bins[bi] + f_bins > max_bundle_bins:
+                    continue  # keep the encoded bin range in dtype bounds
                 conflicts = int((bundle_masks[bi] & m).sum())
                 if bundle_conflicts[bi] + conflicts <= budget:
                     bundles[bi].append(f)
                     bundle_masks[bi] = bundle_masks[bi] | m
                     bundle_conflicts[bi] += conflicts
+                    bundle_bins[bi] += f_bins
                     placed = True
                     break
         if not placed:
             bundles.append([f])
             bundle_masks.append(m.copy())
             bundle_conflicts.append(0)
+            bundle_bins.append(1 + f_bins)
     return bundles
 
 

@@ -70,3 +70,62 @@ def test_conflict_budget_respected():
     assert sorted(len(x) for x in tight) == [1, 1]
     loose = find_bundles(masks, R, max_conflict_rate=0.2)
     assert sorted(len(x) for x in loose) == [2]
+
+
+def test_bundled_training_end_to_end():
+    """tpu_enable_bundle trains on sparse exclusive features with the
+    same quality as the unbundled path."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    R = 4000
+    owner = rng.randint(0, 4, R)
+    X = np.zeros((R, 4), np.float32)
+    for f in range(3):
+        m = owner == f
+        X[m, f] = rng.rand(int(m.sum())) + 0.5
+    X[:, 3] = rng.rand(R)
+    y = ((X[:, 0] > 1.0) | (X[:, 1] > 1.2) | (X[:, 3] > 0.8)) \
+        .astype(np.float32)
+    from sklearn.metrics import roc_auc_score
+    aucs = {}
+    for bundle in (False, True):
+        ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1, "min_data_in_leaf": 5,
+                         "grow_policy": "depthwise", "tpu_engine": "xla",
+                         "tpu_enable_bundle": bundle},
+                        ds, num_boost_round=10)
+        aucs[bundle] = roc_auc_score(y, bst.predict(X))
+    assert aucs[True] > 0.97, aucs
+    assert abs(aucs[True] - aucs[False]) < 0.01, aucs
+
+
+def test_bundled_nonzero_mode_routing():
+    """A bundled feature whose MOST FREQUENT value is nonzero: routing's
+    out-of-window fallback must use the most-frequent bin (where the
+    FixHistogram residual lives), not the zero bin."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(9)
+    R = 4000
+    # feature 0: 80% of rows at value 5.0 (nonzero mode), 20% spread
+    x0 = np.full(R, 5.0, np.float32)
+    spread = rng.rand(R) < 0.2
+    x0[spread] = rng.rand(int(spread.sum())).astype(np.float32) * 10
+    # feature 1: sparse, exclusive with feature 0's spread region
+    x1 = np.zeros(R, np.float32)
+    m1 = (~spread) & (rng.rand(R) < 0.2)
+    x1[m1] = rng.rand(int(m1.sum())).astype(np.float32) + 1
+    X = np.stack([x0, x1], 1)
+    y = ((x0 > 5.0) | (x1 > 1.5)).astype(np.float32)
+    from sklearn.metrics import roc_auc_score
+    aucs = {}
+    for bundle in (False, True):
+        ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1, "min_data_in_leaf": 5,
+                         "grow_policy": "depthwise", "tpu_engine": "xla",
+                         "tpu_enable_bundle": bundle},
+                        ds, num_boost_round=10)
+        aucs[bundle] = roc_auc_score(y, bst.predict(X))
+    assert aucs[True] > 0.95, aucs
+    assert abs(aucs[True] - aucs[False]) < 0.02, aucs
