@@ -205,30 +205,37 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
     oh = oh_ref[:]
     D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [Sp, C]
-    left = D > 0.5
+    # Mask algebra stays in i32/bf16 throughout: broadcast i1 vectors hit a
+    # Mosaic relayout bug on this toolchain ("Invalid relayout ... 8x1024xi1"
+    # when an [Sp,1] bool meets an [Sp,C] bool), and int select lowers to the
+    # same VPU ops anyway.
+    left_i = (D > 0.5).astype(jnp.int32)                       # [Sp, C] 0/1
 
     # ---- slot membership
     leaf_of_slot = tbl_ref[:, 0:1]                             # [Sp, 1]
     right_delta = tbl_ref[:, 1:2]
-    small_left = tbl_ref[:, 2:3] > 0
-    P = jnp.broadcast_to(leafb, (Sp, C)) == leaf_of_slot       # [Sp, C]
-    in_small = P & (left == small_left)
+    small_left_i = (tbl_ref[:, 2:3] > 0).astype(jnp.int32)     # [Sp, 1] 0/1
+    P_i = (jnp.broadcast_to(leafb, (Sp, C))
+           == leaf_of_slot).astype(jnp.int32)                  # [Sp, C] 0/1
+    same_i = 1 - jnp.bitwise_xor(left_i, small_left_i)         # left==small
+    in_small = (P_i * same_i).astype(jnp.bfloat16)             # [Sp, C] 0/1
 
-    # ---- histogram: one wide-N dot, all channels packed
+    # ---- histogram: one wide-N dot, all channels packed. mask*g instead of
+    # a select (i1 selects also hit the relayout bug); requires FINITE
+    # grad/hess — a NaN/Inf row would leak 0*NaN into other slots' bins,
+    # but non-finite gradients wreck training under any formulation.
     chans = []
     for ch in range(nch):
         g = gh_ref[ch:ch + 1, :]                               # [1, C] bf16
-        chans.append(jnp.where(in_small, jnp.broadcast_to(g, (Sp, C)),
-                               jnp.bfloat16(0.0)))
+        chans.append(in_small * jnp.broadcast_to(g, (Sp, C)))
     ghs = jnp.concatenate(chans, axis=0)                       # [nch*Sp, C]
     hist_ref[:] += jax.lax.dot_general(
         oh, ghs, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                    # [FB, nch*Sp]
 
     # ---- row->leaf update: right-child rows move to their new leaf id
-    go_right = P & ~left
-    delta = jnp.sum(jnp.where(go_right,
-                              jnp.broadcast_to(right_delta, (Sp, C)), 0),
+    go_right = P_i * (1 - left_i)                              # [Sp, C] 0/1
+    delta = jnp.sum(go_right * jnp.broadcast_to(right_delta, (Sp, C)),
                     axis=0, keepdims=True)                     # [1, C] i32
     newleaf_ref[:] = leafb + delta
 
@@ -251,7 +258,10 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
       gh_T: [8, R] bfloat16 channel block from pack_gh().
       W: [Sp, f_oh*num_bins] bfloat16 route table (build_route_table).
       tbl: [Sp, 128] int32; col 0 leaf_of_slot (-1 = inactive slot),
-        col 1 right_delta (new_leaf_id - leaf_id), col 2 small_is_left.
+        col 1 right_delta (new_leaf_id - leaf_id), col 2 small_is_left
+        (any value > 0 means left). grad/hess/weight must be FINITE: the
+        kernel masks channels by multiplication (Mosaic i1-select
+        workaround), so a NaN/Inf row would bleed into other slots.
 
     Returns:
       hist: [f_oh*num_bins, nch*Sp] float32 smaller-child histograms.

@@ -1,0 +1,73 @@
+"""Amortized ablation timing of grow_tree_fused on the attached chip.
+
+Times N back-to-back grows with ONE final block (dispatch pipelining stays
+intact, matching how training actually runs).
+Run: BENCH_ROWS=2000000 python scripts/ablate_grow.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.frontier2 import grow_tree_fused
+from lightgbm_tpu.ops.fused_level import pack_gh
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    reps = int(os.environ.get("REPS", 5))
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 28).astype(np.float32)
+    w = rng.randn(28).astype(np.float32)
+    y = (X @ w + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 1e-3, "verbose": -1,
+              "metric": "None", "tpu_engine": "fused"}
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    booster = lgb.Booster(params=params, train_set=ds)
+    booster.update()
+    g = booster._gbdt
+
+    grad, hess = g._get_gradients()
+    pad = g.fused_Rp - g.num_data
+    fm_pad = jnp.ones((g.fused_f_oh,), bool).at[28:].set(False)
+
+    def run(nch, extra_levels, leaves):
+        gh_T = pack_gh(jnp.pad(grad[0], (0, pad)), jnp.pad(hess[0], (0, pad)),
+                       jnp.pad(jnp.ones_like(grad[0]), (0, pad)), nch)
+        def one():
+            return grow_tree_fused(
+                g.fused_bins_T, gh_T, g.fused_meta, fm_pad, g.params,
+                leaves, g.fused_Bp, g.fused_f_oh, num_rows=g.num_data,
+                nch=nch, max_depth=-1, extra_levels=extra_levels)
+        t_, rl = one()  # compile
+        jax.block_until_ready(rl)
+        t0 = time.perf_counter()
+        outs = [one() for _ in range(reps)]
+        for t_, rl in outs:
+            pass
+        jax.block_until_ready(outs[-1][1])
+        jax.block_until_ready(outs[-1][0].num_leaves)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"  nch={nch} extras={extra_levels} leaves={leaves:4d}"
+              f"  {dt*1e3:8.1f} ms/tree  (num_leaves="
+              f"{int(outs[-1][0].num_leaves)})")
+
+    print(f"rows={n} reps={reps}")
+    run(5, 3, 255)
+    run(5, 0, 255)
+    run(3, 3, 255)
+    run(3, 0, 255)
+    run(5, 3, 63)
+    run(5, 0, 63)
+
+
+if __name__ == "__main__":
+    main()

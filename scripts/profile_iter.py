@@ -1,0 +1,80 @@
+"""Per-phase timing of one fused-engine boosting iteration on the attached
+chip. Run: BENCH_ROWS=2000000 python scripts/profile_iter.py"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+
+def t(label, fn, *a, **k):
+    t0 = time.perf_counter()
+    out = fn(*a, **k)
+    out_flat = jax.tree_util.tree_leaves(out)
+    for x in out_flat:
+        if hasattr(x, "block_until_ready"):
+            x.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"  {label:34s} {dt*1e3:9.1f} ms")
+    return out
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 28).astype(np.float32)
+    w = rng.randn(28).astype(np.float32)
+    y = (X @ w + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 1e-3, "verbose": -1,
+              "metric": "None", "tpu_engine": "fused"}
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    booster = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        booster.update()  # warm all compiles
+
+    g = booster._gbdt
+    print(f"rows={n}")
+    for rep in range(2):
+        print(f"--- iter {rep}")
+        t0_all = time.perf_counter()
+        grad, hess = t("get_gradients", g._get_gradients)
+        gh = t("gh stack", lambda: jnp.stack(
+            [grad[0] * g.bag_weight, hess[0] * g.bag_weight, g.bag_weight],
+            axis=1))
+        from lightgbm_tpu.ops.fused_level import pack_gh, table_lookup
+        fm = g._feature_mask()
+        pad = g.fused_Rp - g.num_data
+        gh_T = t("pack_gh+pad", lambda: pack_gh(
+            jnp.pad(gh[:, 0], (0, pad)), jnp.pad(gh[:, 1], (0, pad)),
+            jnp.pad(gh[:, 2], (0, pad)), g.fused_nch))
+        fm_pad = jnp.zeros((g.fused_f_oh,), bool).at[:fm.shape[0]].set(fm)
+        from lightgbm_tpu.models.frontier2 import grow_tree_fused
+        tree, row_leaf = t("grow_tree_fused", lambda: grow_tree_fused(
+            g.fused_bins_T, gh_T, g.fused_meta, fm_pad, g.params,
+            g.max_leaves, g.fused_Bp, g.fused_f_oh, num_rows=g.num_data,
+            nch=g.fused_nch, max_depth=int(g.config.max_depth),
+            extra_levels=int(g.config.tpu_extra_levels),
+            has_cat=g.has_cat, use_mono_bounds=g.use_mono_bounds,
+            use_node_masks=g.use_node_masks,
+            node_masks=g._node_masks_padded(),
+            interpret=g.fused_interpret))
+        t("int(num_leaves)", lambda: int(tree.num_leaves))
+        ht, sf = t("to_host_tree", g._to_host_tree, tree, g.shrinkage_rate)
+        ht.apply_shrinkage(g.shrinkage_rate)
+        lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
+        delta = t("table_lookup", lambda: table_lookup(
+            row_leaf[:g.num_data][None, :], lv_dev)[0])
+        t("score add", lambda: g.scores.at[0].add(delta))
+        print(f"  {'TOTAL':34s} {(time.perf_counter()-t0_all)*1e3:9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
