@@ -33,6 +33,7 @@ def _make_data(n_rows: int, n_feat: int):
 
 
 def _run(engine: str, X, y, n_iters: int):
+    import jax
     import lightgbm_tpu as lgb
     params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
               "learning_rate": 0.1, "min_data_in_leaf": 1,
@@ -40,10 +41,21 @@ def _run(engine: str, X, y, n_iters: int):
               "metric": "None", "tpu_engine": engine}
     ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
     booster = lgb.Booster(params=params, train_set=ds)
+    g = booster._gbdt
+
+    def settle():
+        # the driver pipelines iterations asynchronously; timing is only
+        # honest if the host model list AND the device queue are settled
+        if hasattr(g, "drain_pending"):
+            g.drain_pending()
+        jax.block_until_ready(g.scores)
+
     booster.update()  # warmup: compile + first tree
+    settle()
     t0 = time.perf_counter()
     for _ in range(n_iters):
         booster.update()
+    settle()
     return (time.perf_counter() - t0) / n_iters
 
 

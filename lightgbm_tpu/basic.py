@@ -403,11 +403,18 @@ class Booster:
         self._model_version += 1
         return self
 
+    def _drain(self) -> None:
+        """Materialise any device trees still queued by the training fast
+        path before reading the host model list."""
+        if self._gbdt is not None:
+            self._gbdt.drain_pending()
+
     def current_iteration(self) -> int:
         return self._gbdt.iter if self._gbdt is not None else \
             len(self.models) // max(1, self.num_tree_per_iteration)
 
     def num_trees(self) -> int:
+        self._drain()
         return len(self.models)
 
     def num_model_per_iteration(self) -> int:
@@ -436,6 +443,7 @@ class Booster:
 
     def _eval_set(self, name: str, valid_idx: Optional[int], feval) -> List:
         """Returns [(dataset_name, metric_name, value, is_higher_better)]."""
+        self._drain()
         g = self._gbdt
         out = []
         if valid_idx is None:
@@ -468,6 +476,7 @@ class Booster:
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         """(ref: basic.py:3449 Booster.predict → predictor.hpp)"""
+        self._drain()
         X = _to_2d_numpy(data).astype(np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
@@ -589,6 +598,7 @@ class Booster:
     def model_to_string(self, start_iteration: int = 0,
                         num_iteration: int = -1,
                         importance_type: Union[int, str] = "split") -> str:
+        self._drain()
         it = 0 if importance_type in (0, "split") else 1
         return model_io.save_model_to_string(self, start_iteration,
                                              num_iteration, it)
@@ -603,6 +613,7 @@ class Booster:
 
     def dump_model(self, start_iteration: int = 0,
                    num_iteration: int = -1) -> dict:
+        self._drain()
         import json as _json
         return _json.loads(model_io.dump_model_json(self, start_iteration,
                                                     num_iteration))
@@ -626,6 +637,7 @@ class Booster:
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         it = 0 if importance_type == "split" else 1
+        self._drain()
         models = self.models
         if iteration is not None and iteration > 0:
             models = models[:iteration * self.num_tree_per_iteration]
@@ -645,6 +657,7 @@ class Booster:
         X = _to_2d_numpy(data).astype(np.float64)
         label = np.asarray(label, np.float64).reshape(-1)
         import copy
+        self._drain()
         new_booster = copy.deepcopy(self)
         # leaf assignment per tree, then leaf values blended:
         # new = decay * old + (1-decay) * newly-fitted mean residual value

@@ -111,6 +111,13 @@ class GBDT:
         self.num_init_iteration = 0
         self.average_output = False
         self._last_cat = None  # host cat arrays from the latest _to_host_tree
+        # async pipeline state (see _train_one_iter_fast): device trees not
+        # yet materialised as HostTrees, scores checkpoint for stop rollback
+        self._pending: List[Tuple] = []
+        self._fast_step_fn = None
+        self._fast_ok_cache = None
+        self._scores_ckpt = None
+        self._stopped_early = False
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TpuDataset, objective,
@@ -347,6 +354,9 @@ class GBDT:
         init and again by reset_config so reset_parameter can switch
         engines)."""
         from ..ops.pallas_histogram import HAS_PALLAS
+        self._fast_step_fn = None     # engine/params changed: re-derive
+        self._fast_ok_cache = None
+        self._fast_fm_pads = None
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
@@ -486,6 +496,8 @@ class GBDT:
     def add_valid_data(self, valid_data: TpuDataset, name: str,
                        metrics: Sequence) -> None:
         """(ref: gbdt.cpp AddValidDataset)"""
+        self.drain_pending()          # replay below needs the full model
+        self._fast_ok_cache = None    # valid sets force the sync path
         self.valid_data.append(valid_data)
         self.valid_bins.append(jnp.asarray(valid_data.bins))
         k = self.num_tree_per_iteration
@@ -512,7 +524,8 @@ class GBDT:
     def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
         """(ref: gbdt.cpp:346 BoostFromAverage)"""
         cfg = self.config
-        if (self.models or self.has_init_score or self.objective is None):
+        if (self.models or self._pending or self.has_init_score
+                or self.objective is None):
             return 0.0
         if not (cfg.boost_from_average or self.train_data.num_features == 0):
             if self.objective.name in ("regression_l1", "quantile", "mape"):
@@ -897,9 +910,215 @@ class GBDT:
         return score.at[tree_id].set(new_row)
 
     # ------------------------------------------------------------------
+    # Async pipelined fast path.
+    #
+    # Through a remote-attached TPU every host synchronisation costs
+    # ~25 us-80 ms of round-trip latency; the reference's per-tree host
+    # bookkeeping (gbdt.cpp:371 TrainOneIter is all host code) translated
+    # naively into 2-3 blocking syncs per tree (int(num_leaves),
+    # device_get(tree), score-update data dependency) — ~0.3 s/tree of pure
+    # latency at 255 leaves. Instead: ONE jit-compiled step per iteration
+    # (gradients -> gh pack -> tree growth -> on-device score update) with
+    # NO host read-back; the device TreeArrays are queued and materialised
+    # as HostTrees in batches ("drained") only when something actually
+    # needs the host model list. Device->host copies are started
+    # asynchronously at enqueue time so drains mostly find the data ready.
+    _FAST_SYNC_EVERY = 32
+
+    def _fast_path_ok(self) -> bool:
+        """Per-tree host work forces the synchronous path: subclass drivers
+        (DART drop-sets, GOSS resampling, RF), leaf renewal, linear leaves,
+        CEGB feature accounting, forced splits, per-node mask key folding,
+        and valid sets (their score updates still run through HostTree
+        conversion)."""
+        if self._fast_ok_cache is None:
+            obj = self.objective
+            self._fast_ok_cache = bool(
+                type(self) is GBDT
+                and self.use_fused
+                and obj is not None
+                and not obj.is_renew_tree_output
+                and not bool(self.config.linear_tree)
+                and not getattr(self, "use_cegb", False)
+                and not getattr(self, "n_forced", 0)
+                and not self.use_node_masks
+                and not self.valid_scores
+                and all(self.class_need_train))
+        return self._fast_ok_cache
+
+    def _make_fast_step(self):
+        from ..models.frontier2 import grow_tree_fused
+        from ..ops.fused_level import pack_gh, table_lookup
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        pad = self.fused_Rp - n
+        shrink = jnp.float32(self.shrinkage_rate)
+        max_depth = int(self.config.max_depth)
+        extra = int(self.config.tpu_extra_levels)
+        interp = self.fused_interpret
+
+        # bins_T/grad/hess are ARGUMENTS, not closures: a closed-over device
+        # array of O(rows) size would be embedded in the lowered program as
+        # a constant (bins alone: 336 MB of HLO at 10.5M rows) and stall
+        # remote compilation. Gradients are computed eagerly outside for the
+        # same reason — the objective closes over its label/weight arrays.
+        @jax.jit
+        def step(bins_T, scores, grad, hess, bag_weight, fm_pads):
+            trees = []
+            for tid in range(k):
+                gh_T = pack_gh(
+                    jnp.pad(grad[tid] * bag_weight, (0, pad)),
+                    jnp.pad(hess[tid] * bag_weight, (0, pad)),
+                    jnp.pad(bag_weight, (0, pad)), self.fused_nch)
+                tree, row_leaf = grow_tree_fused(
+                    bins_T, gh_T, self.fused_meta, fm_pads[tid],
+                    self.params, self.max_leaves, self.fused_Bp,
+                    self.fused_f_oh, num_rows=n, nch=self.fused_nch,
+                    max_depth=max_depth, extra_levels=extra,
+                    has_cat=self.has_cat,
+                    use_mono_bounds=self.use_mono_bounds,
+                    interpret=interp)
+                delta = table_lookup(row_leaf[None, :],
+                                     tree.leaf_value * shrink,
+                                     interpret=interp)[0, :n]
+                # a dried-up class (no split found) contributes NOTHING:
+                # the sync path appends a zero constant tree for it
+                # (gbdt.cpp:421-437 beyond the first iteration) and keeps
+                # boosting the other classes
+                delta = jnp.where(tree.num_leaves > 1, delta, 0.0)
+                scores = scores.at[tid].add(delta)
+                trees.append(tree)
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees)
+            return scores, stacked
+        return step
+
+    def _train_one_iter_fast(self) -> bool:
+        k = self.num_tree_per_iteration
+        if not self._pending:
+            # models list is complete up to here (fresh start, post-drain,
+            # or after synchronous iterations): checkpoint the scores so a
+            # later stop-replay starts from a consistent state. Taken
+            # BEFORE boost_from_average: iteration-0 trees fold the init
+            # bias into their leaf values at drain time, so a replay of
+            # ckpt + kept trees reproduces the training scores exactly
+            self._scores_ckpt = self.scores
+        init_scores = [self._boost_from_average(tid, True)
+                       for tid in range(k)]
+        grad, hess = self._get_gradients()
+        grad, hess = self._bagging(self.iter, grad, hess)
+        if self._fast_step_fn is None:
+            self._fast_step_fn = self._make_fast_step()
+        F_oh = self.fused_f_oh
+        if float(self.config.feature_fraction) >= 1.0:
+            if getattr(self, "_fast_fm_pads", None) is None:
+                self._fast_fm_pads = jnp.ones((k, F_oh), bool).at[
+                    :, self.train_data.num_features:].set(False)
+            fm_pads = self._fast_fm_pads
+        else:
+            fm_pads = jnp.stack([
+                jnp.zeros((F_oh,), bool).at[:self.train_data.num_features]
+                .set(self._feature_mask()) for _ in range(k)])
+        self.scores, trees = self._fast_step_fn(
+            self.fused_bins_T, self.scores, grad, hess, self.bag_weight,
+            fm_pads)
+        for leaf in jax.tree_util.tree_leaves(trees):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._pending.append((trees, init_scores))
+        self.iter += 1
+        if len(self._pending) >= self._FAST_SYNC_EVERY:
+            self.drain_pending()
+            if self._stopped_early:
+                return True
+        return False
+
+    def drain_pending(self) -> None:
+        """Materialise queued device trees as HostTrees (ref bookkeeping of
+        gbdt.cpp:393-445, deferred). Detects the no-more-splits stop
+        condition after the fact: the stopping iteration's trees are
+        discarded and the scores are rebuilt from the last checkpoint +
+        replay of the kept trees (bin-space routing is bit-identical to
+        training routing, so the replay reproduces the training scores)."""
+        if not self._pending:
+            return
+        pend, self._pending = self._pending, []
+        k = self.num_tree_per_iteration
+        base_iter = self.iter - len(pend)
+        n0 = len(self.models)
+        trees_host = jax.device_get([t for t, _ in pend])
+        stop_i = None
+        for i, (trees_h, (_, init_scores)) in enumerate(zip(trees_host,
+                                                            pend)):
+            iter_models = []
+            any_grew = False
+            for tid in range(k):
+                ta = TreeArrays(*[np.asarray(a)[tid] for a in trees_h])
+                if int(ta.num_leaves) <= 1:
+                    # dried-up class: zero constant tree, no score change
+                    # (matches gbdt.cpp:421-437 beyond the first iteration;
+                    # the fast step zeroed this class's delta in-jit)
+                    ht = HostTree(1)
+                    iter_models.append((ht, _DeviceTree(
+                        ht, np.zeros(0, np.int32))))
+                    continue
+                any_grew = True
+                ht, sf_inner = self._to_host_tree(ta, self.shrinkage_rate)
+                ht.apply_shrinkage(self.shrinkage_rate)
+                cf, cm = self._last_cat or (None, None)
+                dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
+                if abs(init_scores[tid]) > K_EPSILON:
+                    ht.add_bias(init_scores[tid])
+                    dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
+                iter_models.append((ht, dt))
+            if not any_grew:
+                stop_i = i
+                break
+            for ht, dt in iter_models:
+                self.models.append(ht)
+                self.device_trees.append(dt)
+        if stop_i is not None:
+            # scores include contributions from iterations >= stop_i;
+            # rebuild from the checkpoint + the kept trees (bin-space
+            # routing is training-identical, and iteration-0 trees carry
+            # the folded init bias, so the replay is exact)
+            scores = self._scores_ckpt
+            for j in range(n0, len(self.models)):
+                scores = self._add_tree_to_score(
+                    scores, self.bins_dev, self.device_trees[j], j % k)
+            if not self.models:
+                # first-ever iteration: the reference keeps one constant
+                # tree per class carrying the init score, with the score
+                # updated by BOTH BoostFromAverage and the constant
+                # branch's AddScore (gbdt.cpp:377,433 — 2x init; matched
+                # bug-for-bug by the synchronous path). The checkpoint is
+                # pre-boost, so both updates are applied here.
+                init_scores = pend[stop_i][1]
+                for tid in range(k):
+                    ht = HostTree(1)
+                    ht.leaf_value[0] = init_scores[tid]
+                    scores = scores.at[tid].add(
+                        2.0 * float(init_scores[tid]))
+                    self.models.append(ht)
+                    self.device_trees.append(
+                        _DeviceTree(ht, np.zeros(0, np.int32)))
+            self.scores = scores
+            self.iter = base_iter + stop_i
+            self._stopped_early = True
+            log.warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+        self._scores_ckpt = self.scores
+
+    # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration (ref: gbdt.cpp:371 TrainOneIter).
         Returns True if training should stop."""
+        if (gradients is None and hessians is None
+                and not self._stopped_early and self._fast_path_ok()):
+            return self._train_one_iter_fast()
+        self.drain_pending()
+        if self._stopped_early:
+            return True
         k, n = self.num_tree_per_iteration, self.num_data
         init_scores = [0.0] * k
         if gradients is None or hessians is None:
@@ -1030,10 +1249,12 @@ class GBDT:
     def reset_config(self, config: Config) -> None:
         """Re-derive training state from an updated config
         (ref: gbdt.cpp:686-839 ResetConfig/ResetBaggingConfig)."""
+        self.drain_pending()
         self.config = config
         self.shrinkage_rate = float(config.learning_rate)
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
+        self._stopped_early = False   # a relaxed config may split again
         self._setup_cegb(config)
         self._setup_forced_splits(config, self.train_data)
         self._setup_engine(config)
@@ -1058,6 +1279,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """(ref: gbdt.cpp:456 RollbackOneIter)"""
+        self.drain_pending()
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration
@@ -1146,6 +1368,7 @@ class GBDT:
     # ------------------------------------------------------------------
     @property
     def num_iterations_trained(self) -> int:
+        self.drain_pending()
         return len(self.models) // max(1, self.num_tree_per_iteration)
 
 
