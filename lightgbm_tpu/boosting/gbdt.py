@@ -116,7 +116,6 @@ class GBDT:
         self._pending: List[Tuple] = []
         self._fast_step_fn = None
         self._fast_ok_cache = None
-        self._scores_ckpt = None
         self._stopped_early = False
 
     # ------------------------------------------------------------------
@@ -995,14 +994,6 @@ class GBDT:
 
     def _train_one_iter_fast(self) -> bool:
         k = self.num_tree_per_iteration
-        if not self._pending:
-            # models list is complete up to here (fresh start, post-drain,
-            # or after synchronous iterations): checkpoint the scores so a
-            # later stop-replay starts from a consistent state. Taken
-            # BEFORE boost_from_average: iteration-0 trees fold the init
-            # bias into their leaf values at drain time, so a replay of
-            # ckpt + kept trees reproduces the training scores exactly
-            self._scores_ckpt = self.scores
         init_scores = [self._boost_from_average(tid, True)
                        for tid in range(k)]
         grad, hess = self._get_gradients()
@@ -1036,31 +1027,39 @@ class GBDT:
     def drain_pending(self) -> None:
         """Materialise queued device trees as HostTrees (ref bookkeeping of
         gbdt.cpp:393-445, deferred). Detects the no-more-splits stop
-        condition after the fact: the stopping iteration's trees are
-        discarded and the scores are rebuilt from the last checkpoint +
-        replay of the kept trees (bin-space routing is bit-identical to
-        training routing, so the replay reproduces the training scores)."""
+        condition after the fact: the stopping iteration contributed
+        nothing to the scores (dried deltas are zeroed in-jit), and later
+        iterations' contributions are subtracted back out of the live
+        scores (bin-space routing is training-identical, so each
+        subtraction reverses the training add up to f32 rounding)."""
         if not self._pending:
             return
         pend, self._pending = self._pending, []
         k = self.num_tree_per_iteration
         base_iter = self.iter - len(pend)
-        n0 = len(self.models)
         trees_host = jax.device_get([t for t, _ in pend])
         stop_i = None
+        converted = []   # per drained iteration: [(ht, dt, grew)] * k
         for i, (trees_h, (_, init_scores)) in enumerate(zip(trees_host,
                                                             pend)):
             iter_models = []
+            dried_first = []   # tids of first-k constant trees
             any_grew = False
             for tid in range(k):
                 ta = TreeArrays(*[np.asarray(a)[tid] for a in trees_h])
                 if int(ta.num_leaves) <= 1:
-                    # dried-up class: zero constant tree, no score change
-                    # (matches gbdt.cpp:421-437 beyond the first iteration;
-                    # the fast step zeroed this class's delta in-jit)
+                    # dried-up class (the fast step zeroed its delta
+                    # in-jit): zero constant tree — except within the
+                    # first k models, where the reference stores the init
+                    # score in it and adds it to the scorer on top of
+                    # BoostFromAverage's update (gbdt.cpp:421-437);
+                    # applied after the loop once the iteration is known
+                    # to be kept
                     ht = HostTree(1)
-                    iter_models.append((ht, _DeviceTree(
-                        ht, np.zeros(0, np.int32))))
+                    if stop_i is None \
+                            and len(self.models) + len(iter_models) < k:
+                        dried_first.append(tid)
+                    iter_models.append((ht, None, False))
                     continue
                 any_grew = True
                 ht, sf_inner = self._to_host_tree(ta, self.shrinkage_rate)
@@ -1070,35 +1069,47 @@ class GBDT:
                 if abs(init_scores[tid]) > K_EPSILON:
                     ht.add_bias(init_scores[tid])
                     dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
-                iter_models.append((ht, dt))
+                iter_models.append((ht, dt, True))
+            converted.append(iter_models)
+            if stop_i is not None:
+                continue
             if not any_grew:
                 stop_i = i
-                break
-            for ht, dt in iter_models:
+                continue
+            for tid in dried_first:
+                ht = iter_models[tid][0]
+                ht.leaf_value[0] = init_scores[tid]
+                self.scores = self.scores.at[tid].add(
+                    float(init_scores[tid]))
+            for ht, dt, _ in iter_models:
+                if dt is None:
+                    dt = _DeviceTree(ht, np.zeros(0, np.int32))
                 self.models.append(ht)
                 self.device_trees.append(dt)
         if stop_i is not None:
-            # scores include contributions from iterations >= stop_i;
-            # rebuild from the checkpoint + the kept trees (bin-space
-            # routing is training-identical, and iteration-0 trees carry
-            # the folded init bias, so the replay is exact)
-            scores = self._scores_ckpt
-            for j in range(n0, len(self.models)):
-                scores = self._add_tree_to_score(
-                    scores, self.bins_dev, self.device_trees[j], j % k)
+            # the stopping iteration contributed nothing to the scores
+            # (every class's delta was zeroed in-jit); iterations after it
+            # must be discarded — subtract their contributions from the
+            # live scores (bin-space routing is training-identical, so
+            # each subtraction reverses the training add up to f32
+            # rounding)
+            scores = self.scores
+            for iter_models in converted[stop_i + 1:]:
+                for tid, (_, dt, grew) in enumerate(iter_models):
+                    if grew:
+                        scores = self._add_tree_to_score(
+                            scores, self.bins_dev, dt, tid, scale=-1.0)
             if not self.models:
-                # first-ever iteration: the reference keeps one constant
-                # tree per class carrying the init score, with the score
-                # updated by BOTH BoostFromAverage and the constant
-                # branch's AddScore (gbdt.cpp:377,433 — 2x init; matched
-                # bug-for-bug by the synchronous path). The checkpoint is
-                # pre-boost, so both updates are applied here.
+                # first-ever iteration stopped outright: the reference
+                # keeps one constant tree per class carrying the init
+                # score, updating the scorer a second time on top of
+                # BoostFromAverage (gbdt.cpp:377,433 — 2x init total;
+                # matched bug-for-bug by the synchronous path)
                 init_scores = pend[stop_i][1]
                 for tid in range(k):
                     ht = HostTree(1)
                     ht.leaf_value[0] = init_scores[tid]
-                    scores = scores.at[tid].add(
-                        2.0 * float(init_scores[tid]))
+                    scores = scores.at[tid].add(float(init_scores[tid]))
                     self.models.append(ht)
                     self.device_trees.append(
                         _DeviceTree(ht, np.zeros(0, np.int32)))
@@ -1107,7 +1118,6 @@ class GBDT:
             self._stopped_early = True
             log.warning("Stopped training because there are no more "
                         "leaves that meet the split requirements")
-        self._scores_ckpt = self.scores
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:

@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.fused_level import (NCH_PRECISE, build_route_table, hist_planes,
-                               level_pass, max_slot_cap, table_lookup)
+                               level_pass, max_slot_cap, route_pass,
+                               table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output)
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
@@ -202,14 +203,14 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
                            use_mono_bounds, use_node_masks, node_masks,
-                           li + 1, interpret)
+                           li + 1, li == len(caps) - 1, interpret)
     tree, leaf_T = state[0], state[1]
     return tree, leaf_T[0]
 
 
 def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                S_d, nch, max_depth, has_cat, use_mono_bounds,
-               use_node_masks, node_masks, fold, interpret):
+               use_node_masks, node_masks, fold, is_last, interpret):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
      leaf_lo, leaf_hi, leaf_groups) = state
     Sp = max(8, S_d)
@@ -224,6 +225,16 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
     n_sel = jnp.sum(selected.astype(jnp.int32))
 
     def do_level(op):
+        return _apply_level(op, False)
+
+    def do_level_route(op):
+        # this pass's histograms can never be consumed (no split search
+        # will ever run again): route rows + record the splits, skip the
+        # histogram dot / pool updates / child scans (~60% of the cost of
+        # a deep pass)
+        return _apply_level(op, True)
+
+    def _apply_level(op, route_only):
         (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
          leaf_lo, leaf_hi, leaf_groups) = op
         sel_i32 = selected.astype(jnp.int32)
@@ -261,31 +272,36 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         tbl = tbl.at[:, 1].set(delta_s)
         tbl = tbl.at[:, 2].set(small_left_s.astype(jnp.int32))
 
-        # ---- THE level pass: route + smaller-child histograms
-        hist, leaf_T2 = level_pass(
-            bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=B,
-            f_oh=f_oh, nch=nch, interpret=interpret)
-        sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, f_oh, B)
+        # ---- THE level pass: route (+ smaller-child histograms)
+        if route_only:
+            leaf_T2 = route_pass(bins_T, leaf_T, W, tbl, num_slots=Sp,
+                                 num_bins=B, f_oh=f_oh, interpret=interpret)
+            pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
+        else:
+            hist, leaf_T2 = level_pass(
+                bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=B,
+                f_oh=f_oh, nch=nch, interpret=interpret)
+            sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, f_oh, B)
 
-        # ---- sibling by subtraction from the parent pool
-        par_g = _pool_read(pool_g, lof_safe, Sp)
-        par_h = _pool_read(pool_h, lof_safe, Sp)
-        par_c = _pool_read(pool_c, lof_safe, Sp)
-        sb_g, sb_h, sb_c = par_g - sm_g, par_h - sm_h, par_c - sm_c
-        sl = small_left_s[:, None, None]
-        left_g = jnp.where(sl, sm_g, sb_g)
-        left_h = jnp.where(sl, sm_h, sb_h)
-        left_c = jnp.where(sl, sm_c, sb_c)
-        right_g = jnp.where(sl, sb_g, sm_g)
-        right_h = jnp.where(sl, sb_h, sm_h)
-        right_c = jnp.where(sl, sb_c, sm_c)
+            # ---- sibling by subtraction from the parent pool
+            par_g = _pool_read(pool_g, lof_safe, Sp)
+            par_h = _pool_read(pool_h, lof_safe, Sp)
+            par_c = _pool_read(pool_c, lof_safe, Sp)
+            sb_g, sb_h, sb_c = par_g - sm_g, par_h - sm_h, par_c - sm_c
+            sl = small_left_s[:, None, None]
+            left_g = jnp.where(sl, sm_g, sb_g)
+            left_h = jnp.where(sl, sm_h, sb_h)
+            left_c = jnp.where(sl, sm_c, sb_c)
+            right_g = jnp.where(sl, sb_g, sm_g)
+            right_h = jnp.where(sl, sb_h, sm_h)
+            right_c = jnp.where(sl, sb_c, sm_c)
 
-        pool_g2 = _pool_write(pool_g, lof_safe, left_g, lof_on)
-        pool_g2 = _pool_write(pool_g2, new_s, right_g, lof_on)
-        pool_h2 = _pool_write(pool_h, lof_safe, left_h, lof_on)
-        pool_h2 = _pool_write(pool_h2, new_s, right_h, lof_on)
-        pool_c2 = _pool_write(pool_c, lof_safe, left_c, lof_on)
-        pool_c2 = _pool_write(pool_c2, new_s, right_c, lof_on)
+            pool_g2 = _pool_write(pool_g, lof_safe, left_g, lof_on)
+            pool_g2 = _pool_write(pool_g2, new_s, right_g, lof_on)
+            pool_h2 = _pool_write(pool_h, lof_safe, left_h, lof_on)
+            pool_h2 = _pool_write(pool_h2, new_s, right_h, lof_on)
+            pool_c2 = _pool_write(pool_c, lof_safe, left_c, lof_on)
+            pool_c2 = _pool_write(pool_c2, new_s, right_c, lof_on)
 
         # ---- tree bookkeeping (ref: tree.h:62 Tree::Split; same node
         # array conventions as models/frontier.py round 1)
@@ -333,6 +349,35 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             leaf_depth=upd2(tree.leaf_depth, new_depth, new_depth),
         )
 
+        # ---- bound/group propagation (cheap [L]-sized state upkeep,
+        # shared by both variants)
+        if use_mono_bounds:
+            mono_dir = jnp.where(best.feature >= 0,
+                                 meta.monotone[jnp.maximum(best.feature, 0)],
+                                 0)
+            leaf_lo2, leaf_hi2 = mono_child_bounds(
+                leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
+                best.left_output, best.right_output,
+                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
+        else:
+            leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
+        if use_node_masks:
+            leaf_groups2 = update_leaf_groups(
+                node_masks, leaf_groups, best.feature, selected,
+                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
+        else:
+            leaf_groups2 = leaf_groups
+
+        if route_only:
+            # no split search will ever run again; just bar the fresh
+            # leaves (and the reused parent slots) from re-selection
+            neg = jnp.full((L,), NEG_INF, jnp.float32)
+            g2 = _masked_scatter(best.gain, slots, neg, selected)
+            g2 = _masked_scatter(g2, new_of_leaf, neg, selected)
+            best2 = best._replace(gain=g2)
+            return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2,
+                    lpn2, lil2, leaf_lo2, leaf_hi2, leaf_groups2)
+
         # ---- best splits for the 2*Sp fresh children only; each child's
         # own post-split output is the parent_output for path smoothing of
         # its prospective grandchildren (matches learner.py:208 and ref
@@ -343,23 +388,12 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         ch_h = jnp.concatenate([left_h, right_h], axis=0)
         ch_c = jnp.concatenate([left_c, right_c], axis=0)
         if use_mono_bounds:
-            mono_dir = jnp.where(best.feature >= 0,
-                                 meta.monotone[jnp.maximum(best.feature, 0)],
-                                 0)
-            leaf_lo2, leaf_hi2 = mono_child_bounds(
-                leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
-                best.left_output, best.right_output,
-                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
             ch_lo = jnp.concatenate([leaf_lo2[lof_safe], leaf_lo2[new_s]])
             ch_hi = jnp.concatenate([leaf_hi2[lof_safe], leaf_hi2[new_s]])
         else:
-            leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
             ch_lo = ch_hi = None
         ch_mask = feature_mask[None, :]
         if use_node_masks:
-            leaf_groups2 = update_leaf_groups(
-                node_masks, leaf_groups, best.feature, selected,
-                jnp.arange(L, dtype=jnp.int32), new_of_leaf)
             ch_groups = jnp.concatenate([leaf_groups2[lof_safe],
                                          leaf_groups2[new_s]])
             # per-node sampling identity: creating node id + side bit
@@ -367,8 +401,6 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                                       2 * (node_of_leaf[lof_safe] + 1)])
             ch_mask = ch_mask & node_feature_mask(node_masks, ch_groups,
                                                   ch_ids)
-        else:
-            leaf_groups2 = leaf_groups
         ch_depth = jnp.concatenate([tree2.leaf_depth[lof_safe],
                                     tree2.leaf_depth[new_s]])
         bs = best_split_cm(
@@ -385,9 +417,19 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2, lpn2,
                 lil2, leaf_lo2, leaf_hi2, leaf_groups2)
 
-    return jax.lax.cond(n_sel > 0, do_level, lambda op: op,
-                        (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn,
-                         lil, leaf_lo, leaf_hi, leaf_groups))
+    op0 = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
+           leaf_lo, leaf_hi, leaf_groups)
+
+    def dispatch(op):
+        if is_last:
+            # final scheduled pass: its histograms are never consumed
+            return do_level_route(op)
+        # dynamic: once the leaf budget will be exhausted by this level's
+        # splits, no later split search can select anything
+        budget_after = budget - n_sel
+        return jax.lax.cond(budget_after > 0, do_level, do_level_route, op)
+
+    return jax.lax.cond(n_sel > 0, dispatch, lambda op: op, op0)
 
 
 def add_leaf_values_to_score(score: jax.Array, row_leaf: jax.Array,

@@ -305,6 +305,70 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
     return hist, new_leaf
 
 
+def _route_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, newleaf_ref,
+                  oh_ref, *, B: int, F_oh: int, Sp: int):
+    """Routing-only sibling of _level_kernel: updates row->leaf without
+    accumulating histograms. Used for passes whose histograms can never be
+    consumed (the leaf budget is exhausted, or no further pass follows) —
+    the histogram dot is ~60% of a deep pass's cost."""
+    C = bins_ref.shape[1]
+    FB = F_oh * B
+    bins_val = bins_ref[:].astype(jnp.int32)
+    big = jnp.repeat(bins_val[:F_oh], B, axis=0)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
+    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+    leafb = leaf_ref[:]
+    D = jax.lax.dot_general(w_ref[:], oh_ref[:], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    left_i = (D > 0.5).astype(jnp.int32)
+    leaf_of_slot = tbl_ref[:, 0:1]
+    right_delta = tbl_ref[:, 1:2]
+    P_i = (jnp.broadcast_to(leafb, (Sp, C))
+           == leaf_of_slot).astype(jnp.int32)
+    go_right = P_i * (1 - left_i)
+    delta = jnp.sum(go_right * jnp.broadcast_to(right_delta, (Sp, C)),
+                    axis=0, keepdims=True)
+    newleaf_ref[:] = leafb + delta
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_slots", "num_bins", "f_oh", "tile_rows",
+                     "interpret"))
+def route_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
+               tbl: jax.Array, *, num_slots: int, num_bins: int,
+               f_oh: int, tile_rows: int = 0,
+               interpret: bool = False) -> jax.Array:
+    """Row->leaf update only (same W/tbl contract as level_pass)."""
+    if not HAS_PALLAS:
+        raise ImportError("jax.experimental.pallas is unavailable on this "
+                          "backend; use the XLA histogram path instead")
+    Fp, R = bins_T.shape
+    B = num_bins
+    FB = f_oh * B
+    Sp = tbl.shape[0]
+    C = tile_rows or default_tile_rows(Sp, FB, NCH_FAST)
+    assert R % C == 0, f"rows {R} not padded to tile {C}"
+    kernel = functools.partial(_route_kernel, B=B, F_oh=f_oh, Sp=Sp)
+    new_leaf = pl.pallas_call(
+        kernel,
+        grid=(R // C,),
+        in_specs=[
+            pl.BlockSpec((Fp, C), lambda t: (0, t)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
+            pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((1, R), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(bins_T, leaf_T, W, tbl)
+    return new_leaf
+
+
 def _lookup_kernel(idx_ref, tbl_ref, out_ref, *, Lp: int):
     C = idx_ref.shape[1]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)

@@ -1,0 +1,183 @@
+"""One-hot build strategy shootout for the fused level kernel.
+
+The current build materialises jnp.repeat(bins_i32, B) — an [FB, C] i32
+intermediate (~84 GB of VMEM traffic per pass at 10.5M rows) before the
+compare. Variants tried here:
+  A: current (bulk repeat + iota compare)
+  B: per-feature unrolled loop (no repeated i32 intermediate)
+  C: MXU broadcast (repeat matrix @ bins_bf16, compare in f32)
+  D: current with tile_rows=2048
+Each runs the FULL level kernel (build + routing + hist dots) so wins
+here translate directly. Run: ROWS=10500000 python scripts/ablate_build.py
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops import fused_level as fl
+
+
+def make_kernel(build: str, B, F_oh, Sp, nch):
+    def kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
+               hist_ref, newleaf_ref, oh_ref, *, rep_ref=None):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _init():
+            hist_ref[:] = jnp.zeros_like(hist_ref)
+
+        C = bins_ref.shape[1]
+        FB = F_oh * B
+
+        if build == "A":
+            bins_val = bins_ref[:].astype(jnp.int32)
+            big = jnp.repeat(bins_val[:F_oh], B, axis=0)
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
+            oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+        elif build == "B":
+            iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0)
+            bins_val = bins_ref[:].astype(jnp.int32)
+            for f in range(F_oh):
+                bf = jnp.broadcast_to(bins_val[f:f + 1, :], (B, C))
+                oh_ref[f * B:(f + 1) * B, :] = (bf == iota).astype(
+                    jnp.bfloat16)
+        elif build == "C":
+            bins_bf = bins_ref[:F_oh].astype(jnp.bfloat16)      # [F, C]
+            big = jax.lax.dot_general(
+                rep_ref[:], bins_bf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [FB, C]
+            iota_b = (jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0)
+                      % B).astype(jnp.float32)
+            oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+
+        leafb = leaf_ref[:]
+        oh = oh_ref[:]
+        D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        left_i = (D > 0.5).astype(jnp.int32)
+        leaf_of_slot = tbl_ref[:, 0:1]
+        right_delta = tbl_ref[:, 1:2]
+        small_left_i = (tbl_ref[:, 2:3] > 0).astype(jnp.int32)
+        P_i = (jnp.broadcast_to(leafb, (Sp, C))
+               == leaf_of_slot).astype(jnp.int32)
+        same_i = 1 - jnp.bitwise_xor(left_i, small_left_i)
+        in_small = (P_i * same_i).astype(jnp.bfloat16)
+        chans = []
+        for ch in range(nch):
+            g = gh_ref[ch:ch + 1, :]
+            chans.append(in_small * jnp.broadcast_to(g, (Sp, C)))
+        ghs = jnp.concatenate(chans, axis=0)
+        hist_ref[:] += jax.lax.dot_general(
+            oh, ghs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        go_right = P_i * (1 - left_i)
+        delta = jnp.sum(go_right * jnp.broadcast_to(right_delta, (Sp, C)),
+                        axis=0, keepdims=True)
+        newleaf_ref[:] = leafb + delta
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("build", "B", "F_oh", "Sp",
+                                             "nch", "C"))
+def level_pass_variant(bins_T, leaf_T, gh_T, W, tbl, rep, *, build, B,
+                       F_oh, Sp, nch, C):
+    Fp, R = bins_T.shape
+    FB = F_oh * B
+    T = R // C
+    kern = make_kernel(build, B, F_oh, Sp, nch)
+    in_specs = [
+        pl.BlockSpec((Fp, C), lambda t: (0, t)),
+        pl.BlockSpec((1, C), lambda t: (0, t)),
+        pl.BlockSpec((8, C), lambda t: (0, t)),
+        pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
+        pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
+    ]
+    args = [bins_T, leaf_T, gh_T, W, tbl]
+    if build == "C":
+        kern0 = kern
+
+        def kern_c(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref, rep_ref,
+                   hist_ref, newleaf_ref, oh_ref):
+            kern0(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
+                  hist_ref, newleaf_ref, oh_ref, rep_ref=rep_ref)
+        kern = kern_c
+        in_specs.append(pl.BlockSpec((FB, Fp), lambda t: (0, 0)))
+        args.append(rep)
+    hist, nl = pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((FB, nch * Sp), lambda t: (0, 0)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((FB, nch * Sp), jnp.float32),
+            jax.ShapeDtypeStruct((1, R), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(*args)
+    return hist, nl
+
+
+def main():
+    R = int(os.environ.get("ROWS", 10_500_000))
+    reps = int(os.environ.get("REPS", 3))
+    F, B = fl.feature_layout(28, 63)
+    Fp = max(F, 8)
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(R).astype(np.float32))
+    ones = jnp.ones((R,), jnp.float32)
+    rep_np = np.zeros((F * B, Fp), np.float32)
+    for f in range(F):
+        rep_np[f * B:(f + 1) * B, f] = 1
+    rep = jnp.asarray(rep_np, jnp.bfloat16)
+
+    ref_hist = None
+    for build, C in [("A", 1024), ("B", 1024), ("C", 1024),
+                     ("A", 2048), ("B", 2048)]:
+        Rp = ((R + C - 1) // C) * C
+        bins_T = jnp.asarray(
+            rng.randint(0, 63, size=(Fp, Rp)).astype(np.int8))
+        leaf_T = jnp.where(jnp.arange(Rp)[None, :] < R, 0, -1).astype(
+            jnp.int32)
+        gh_T = fl.pack_gh(jnp.pad(g, (0, Rp - R)),
+                          jnp.pad(ones, (0, Rp - R)),
+                          jnp.pad(ones, (0, Rp - R)), 5)
+        for Sp in (8, 128):
+            W = jnp.zeros((Sp, F * B), jnp.bfloat16).at[0, :B].set(1)
+            tbl = (jnp.zeros((Sp, 128), jnp.int32)
+                   .at[:, 0].set(-2).at[0, 0].set(0).at[0, 2].set(1))
+            try:
+                def one(lt):
+                    return level_pass_variant(
+                        bins_T, lt, gh_T, W, tbl, rep, build=build, B=B,
+                        F_oh=F, Sp=Sp, nch=5, C=C)
+                h, nl = one(leaf_T)
+                s = float(jnp.sum(h))
+                t0 = time.perf_counter()
+                lt = leaf_T
+                for _ in range(reps):
+                    h, lt = one(lt)
+                float(jnp.sum(h))
+                dt = (time.perf_counter() - t0) / reps
+                print(f"  build={build} C={C} Sp={Sp:4d}"
+                      f"  {dt*1e3:8.1f} ms/pass  (sum={s:.1f})")
+            except Exception as e:
+                print(f"  build={build} C={C} Sp={Sp:4d}  FAILED "
+                      f"{type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()
