@@ -75,29 +75,66 @@ def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
         max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
     mean_bin_size = total_cnt / max_bin
 
-    big = [counts[i] >= mean_bin_size for i in range(n)]
-    rest_bins = max_bin - sum(big)
-    rest_cnt = total_cnt - sum(c for c, b in zip(counts, big) if b)
+    # Event-driven form of the sequential greedy packer: a bin closes at
+    # the first index hitting one of three events (a big value, the
+    # running count reaching the mean, or the count reaching half the
+    # mean right before a big value), so each closure is found with a
+    # prefix-sum search instead of walking every distinct value. The
+    # comparisons are re-checked exactly at the landing index (the
+    # searchsorted threshold base+mean can round) so the boundaries are
+    # bit-identical to the sequential walk.
+    dv = np.asarray(distinct_values, np.float64)
+    cnts = np.asarray(counts, np.int64)
+    big = cnts >= mean_bin_size
+    rest_bins = max_bin - int(np.sum(big))
+    rest_cnt0 = total_cnt - int(np.sum(cnts[big]))
+    rest_cnt = rest_cnt0
     mean_bin_size = rest_cnt / rest_bins if rest_bins > 0 else math.inf
 
+    cum = np.cumsum(cnts)                       # inclusive prefix counts
+    cum_rest = np.cumsum(np.where(big, 0, cnts))
+    big_idx = np.nonzero(big)[0]
+
+    def first_cum_at_least(s, base, thr):
+        """Smallest i >= s with cum[i] - base >= thr (exact), or n."""
+        if math.isinf(thr):
+            return n
+        i = int(np.searchsorted(cum, base + thr, side="left"))
+        while i > s and cum[i - 1] - base >= thr:
+            i -= 1
+        while i < n and cum[i] - base < thr:
+            i += 1
+        return max(i, s)
+
     uppers: List[float] = []
-    lowers: List[float] = [distinct_values[0]]
-    cur_cnt = 0
-    for i in range(n - 1):
-        if not big[i]:
-            rest_cnt -= counts[i]
-        cur_cnt += counts[i]
-        need_new = (big[i] or cur_cnt >= mean_bin_size
-                    or (big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5)))
-        if need_new:
-            uppers.append(distinct_values[i])
-            lowers.append(distinct_values[i + 1])
-            if len(uppers) >= max_bin - 1:
-                break
-            cur_cnt = 0
-            if not big[i]:
-                rest_bins -= 1
-                mean_bin_size = rest_cnt / rest_bins if rest_bins > 0 else math.inf
+    lowers: List[float] = [float(dv[0])]
+    s = 0
+    while s <= n - 2 and len(uppers) < max_bin - 1:
+        base = int(cum[s - 1]) if s > 0 else 0
+        bp = int(np.searchsorted(big_idx, s))
+        c_big = int(big_idx[bp]) if bp < len(big_idx) else n
+        c_mean = first_cum_at_least(s, base, mean_bin_size)
+        half = max(1.0, mean_bin_size * 0.5)
+        # the only half-mean candidate that can precede c_big is the index
+        # right before the first big value (later bigs are dominated)
+        c_half = n
+        if s + 1 <= c_big < n:
+            ch = first_cum_at_least(s, base, half)
+            if ch <= c_big - 1:
+                c_half = c_big - 1
+        closure = min(c_big, c_mean, c_half)
+        if closure > n - 2:
+            break
+        uppers.append(float(dv[closure]))
+        lowers.append(float(dv[closure + 1]))
+        if len(uppers) >= max_bin - 1:
+            break
+        if not big[closure]:
+            rest_bins -= 1
+            rest_cnt = rest_cnt0 - int(cum_rest[closure])
+            mean_bin_size = rest_cnt / rest_bins if rest_bins > 0 \
+                else math.inf
+        s = closure + 1
 
     for i in range(len(uppers)):
         val = _next_after((uppers[i] + lowers[i + 1]) / 2.0)
@@ -108,14 +145,13 @@ def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
 
 
 def _split_zero_counts(distinct_values, counts):
-    left_cnt_data = cnt_zero = right_cnt_data = 0
-    for v, c in zip(distinct_values, counts):
-        if v <= -K_ZERO_THRESHOLD:
-            left_cnt_data += c
-        elif v > K_ZERO_THRESHOLD:
-            right_cnt_data += c
-        else:
-            cnt_zero += c
+    dv = np.asarray(distinct_values, np.float64)
+    c = np.asarray(counts, np.int64)
+    left = dv <= -K_ZERO_THRESHOLD
+    right = dv > K_ZERO_THRESHOLD
+    left_cnt_data = int(c[left].sum())
+    right_cnt_data = int(c[right].sum())
+    cnt_zero = int(c.sum()) - left_cnt_data - right_cnt_data
     return left_cnt_data, cnt_zero, right_cnt_data
 
 
@@ -124,11 +160,12 @@ def find_bin_zero_as_one(distinct_values: List[float], counts: List[int],
                          min_data_in_bin: int) -> List[float]:
     """Numerical bin bounds with a dedicated zero bin (ref: bin.cpp:256)."""
     n = len(distinct_values)
+    dv = np.asarray(distinct_values, np.float64)
     left_cnt_data, cnt_zero, right_cnt_data = _split_zero_counts(
         distinct_values, counts)
 
-    left_cnt = next((i for i in range(n)
-                     if distinct_values[i] > -K_ZERO_THRESHOLD), n)
+    # first index with value > -K_ZERO_THRESHOLD (distinct is sorted)
+    left_cnt = int(np.searchsorted(dv, -K_ZERO_THRESHOLD, side="right"))
 
     bounds: List[float] = []
     if left_cnt > 0 and max_bin > 1:
@@ -140,8 +177,9 @@ def find_bin_zero_as_one(distinct_values: List[float], counts: List[int],
         if bounds:
             bounds[-1] = -K_ZERO_THRESHOLD
 
-    right_start = next((i for i in range(left_cnt, n)
-                        if distinct_values[i] > K_ZERO_THRESHOLD), -1)
+    right_start = int(np.searchsorted(dv, K_ZERO_THRESHOLD, side="right"))
+    if right_start >= n:
+        right_start = -1
     right_max_bin = max_bin - 1 - len(bounds)
     if right_start >= 0 and right_max_bin > 0:
         right = greedy_find_bin(distinct_values[right_start:],
@@ -160,10 +198,11 @@ def find_bin_with_forced(distinct_values: List[float], counts: List[int],
     """Numerical bin bounds honoring user-forced boundaries
     (ref: bin.cpp:157 FindBinWithPredefinedBin)."""
     n = len(distinct_values)
-    left_cnt = next((i for i in range(n)
-                     if distinct_values[i] > -K_ZERO_THRESHOLD), n)
-    right_start = next((i for i in range(left_cnt, n)
-                        if distinct_values[i] > K_ZERO_THRESHOLD), -1)
+    dv = np.asarray(distinct_values, np.float64)
+    left_cnt = int(np.searchsorted(dv, -K_ZERO_THRESHOLD, side="right"))
+    right_start = int(np.searchsorted(dv, K_ZERO_THRESHOLD, side="right"))
+    if right_start >= n:
+        right_start = -1
 
     bounds: List[float] = []
     if max_bin == 2:
@@ -256,32 +295,38 @@ class BinMapper:
         zero_cnt = int(total_sample_cnt - finite.size - na_cnt)
 
         # distinct values with zero inserted at its sorted position, merging
-        # float-equal neighbors (keeping the larger; ref: bin.cpp:357-389)
+        # float-equal neighbors (keeping the larger; ref: bin.cpp:357-389).
+        # Vectorized: a group BREAK happens exactly where the next raw value
+        # exceeds nextafter(previous raw value), and each group keeps its
+        # last (largest) member — identical to the sequential chain-merge.
         sv = np.sort(finite, kind="stable")
-        distinct: List[float] = []
-        counts: List[int] = []
-        if sv.size == 0 or (sv[0] > 0.0 and zero_cnt > 0):
-            distinct.append(0.0)
-            counts.append(zero_cnt)
-        for i, v in enumerate(sv):
-            if i == 0:
-                distinct.append(float(v))
-                counts.append(1)
-            elif not _double_equal_ordered(sv[i - 1], v):
-                if sv[i - 1] < 0.0 and v > 0.0:
-                    distinct.append(0.0)
-                    counts.append(zero_cnt)
-                distinct.append(float(v))
-                counts.append(1)
-            else:
-                distinct[-1] = float(v)
-                counts[-1] += 1
-        if sv.size > 0 and sv[-1] < 0.0 and zero_cnt > 0:
-            distinct.append(0.0)
-            counts.append(zero_cnt)
+        if sv.size == 0:
+            distinct = np.array([0.0])
+            counts = np.array([zero_cnt], dtype=np.int64)
+        else:
+            brk = sv[1:] > np.nextafter(sv[:-1], np.inf)
+            starts = np.concatenate(([0], np.nonzero(brk)[0] + 1))
+            ends = np.concatenate((starts[1:], [sv.size]))  # exclusive
+            distinct = sv[ends - 1]
+            counts = (ends - starts).astype(np.int64)
+            if zero_cnt > 0:
+                first_vals = sv[starts]
+                if sv[0] > 0.0:
+                    zero_at = 0
+                elif sv[-1] < 0.0:
+                    zero_at = len(distinct)
+                else:
+                    # the break where the previous group ends negative and
+                    # the next starts positive (sequential insertion point)
+                    hits = np.nonzero((distinct[:-1] < 0.0)
+                                      & (first_vals[1:] > 0.0))[0]
+                    zero_at = int(hits[0]) + 1 if hits.size else -1
+                if zero_at >= 0:
+                    distinct = np.insert(distinct, zero_at, 0.0)
+                    counts = np.insert(counts, zero_at, zero_cnt)
 
-        self.min_val = distinct[0] if distinct else 0.0
-        self.max_val = distinct[-1] if distinct else 0.0
+        self.min_val = float(distinct[0]) if len(distinct) else 0.0
+        self.max_val = float(distinct[-1]) if len(distinct) else 0.0
 
         cnt_in_bin: List[int] = []
         if bin_type == BIN_NUMERICAL:
@@ -302,33 +347,42 @@ class BinMapper:
                 bounds.append(math.nan)
             self.bin_upper_bound = np.asarray(bounds)
             self.num_bin = len(bounds)
-            cnt_in_bin = [0] * self.num_bin
-            i_bin = 0
-            for v, c in zip(distinct, counts):
-                while i_bin < self.num_bin - 1 and v > bounds[i_bin]:
-                    i_bin += 1
-                cnt_in_bin[i_bin] += c
+            # bin of each distinct value = first bound >= value (the NaN
+            # sentinel bound, when present, is last and never reached
+            # since the numeric bounds end at +inf)
+            numeric_bounds = np.asarray(bounds[:self.num_bin - 1],
+                                        np.float64)
+            dbin = np.searchsorted(numeric_bounds, np.asarray(distinct),
+                                   side="left")
+            cnt_in_bin = np.bincount(
+                dbin, weights=np.asarray(counts, np.float64),
+                minlength=self.num_bin).astype(np.int64).tolist()
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[-1] = na_cnt
         else:
             # categorical: count-sorted vocabulary, bin 0 = NaN/other
             # (ref: bin.cpp:424-491)
-            cat_counts: Dict[int, int] = {}
-            for v, c in zip(distinct, counts):
-                iv = int(v)
-                if iv < 0:
-                    na_cnt += c
-                    log.warning("Met negative value in categorical features, "
-                                "will convert it to NaN")
-                else:
-                    cat_counts[iv] = cat_counts.get(iv, 0) + c
+            dvi = np.asarray(distinct, np.float64).astype(np.int64)
+            ci = np.asarray(counts, np.int64)
+            neg = dvi < 0
+            if np.any(neg):
+                na_cnt += int(ci[neg].sum())
+                log.warning("Met negative value in categorical features, "
+                            "will convert it to NaN")
+            # aggregate per integer value (distinct floats can alias the
+            # same int); unique is sorted, so a stable argsort by -count
+            # keeps ascending-value order among ties like the dict walk
+            vals, inv = np.unique(dvi[~neg], return_inverse=True)
+            agg = np.bincount(inv, weights=ci[~neg].astype(np.float64)) \
+                .astype(np.int64) if vals.size else np.zeros(0, np.int64)
             rest_cnt = total_sample_cnt - na_cnt
             self.categorical_2_bin = {-1: 0}
             self.bin_2_categorical = [-1]
             cnt_in_bin = [0]
             self.num_bin = 1
             if rest_cnt > 0:
-                order = sorted(cat_counts.items(), key=lambda kv: -kv[1])
+                perm = np.argsort(-agg, kind="stable")
+                order = [(int(vals[p]), int(agg[p])) for p in perm]
                 cut_cnt = int(round(rest_cnt * 0.99))
                 distinct_cnt = len(order) + (1 if na_cnt > 0 else 0)
                 eff_max_bin = min(distinct_cnt, max_bin)
@@ -385,24 +439,50 @@ class BinMapper:
             return False
 
     # ------------------------------------------------------------------
+    def _bounds_f32(self, n_numeric: int) -> np.ndarray:
+        """Largest-float32-not-above each f64 bound: for float32 inputs v,
+        v <= bound_f64 iff v <= bound_f32, so binning float32 data against
+        these is bit-identical to the f64 comparison without upcasting the
+        whole column."""
+        cached = getattr(self, "_bounds_f32_cache", None)
+        if cached is not None and len(cached) == n_numeric:
+            return cached
+        b = np.asarray(self.bin_upper_bound[:n_numeric], np.float64)
+        b32 = b.astype(np.float32)
+        over = b32.astype(np.float64) > b
+        b32[over] = np.nextafter(b32[over], np.float32(-np.inf))
+        self._bounds_f32_cache = b32
+        return b32
+
     def value_to_bin(self, value):
         """Vectorized value→bin (ref: bin.h:457-495 ValueToBin)."""
         scalar = np.isscalar(value)
-        v = np.atleast_1d(np.asarray(value, dtype=np.float64))
+        arr = np.atleast_1d(np.asarray(value))
         if self.bin_type == BIN_CATEGORICAL:
-            out = np.zeros(v.shape, dtype=np.int32)
+            v = arr.astype(np.float64, copy=False)
             iv = np.where(np.isnan(v), -1, v).astype(np.int64)
-            for cat, b in self.categorical_2_bin.items():
-                out[iv == cat] = b
+            cats = np.array(sorted(self.categorical_2_bin), np.int64)
+            cbins = np.array([self.categorical_2_bin[c] for c in cats],
+                             np.int32)
+            pos = np.clip(np.searchsorted(cats, iv), 0, len(cats) - 1)
+            out = np.where(cats[pos] == iv, cbins[pos], 0).astype(np.int32)
             return out[0] if scalar else out
+        # float32 columns bin against pre-rounded f32 bounds (exact; see
+        # _bounds_f32) — no 2x column upcast copy on the hot ingest path
+        n_numeric = self.num_bin - (1 if self.missing_type == MISSING_NAN
+                                    else 0)
+        if arr.dtype == np.float32:
+            v = arr
+            bounds = self._bounds_f32(n_numeric)
+            zero = np.float32(0.0)
+        else:
+            v = arr.astype(np.float64, copy=False)
+            bounds = self.bin_upper_bound[:n_numeric]
+            zero = 0.0
         nan_mask = np.isnan(v)
-        if self.missing_type == MISSING_ZERO:
-            v = np.where(nan_mask, 0.0, v)
-        n_numeric = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
-        bounds = self.bin_upper_bound[:n_numeric]
         # bin = smallest i with value <= bin_upper_bound[i]; searchsorted
         # side='left' returns exactly the first index whose bound >= value
-        safe_v = np.where(nan_mask, 0.0, v)
+        safe_v = np.where(nan_mask, zero, v)
         out = np.searchsorted(bounds, safe_v, side="left").astype(np.int32)
         out = np.minimum(out, n_numeric - 1)
         if self.missing_type == MISSING_NAN:

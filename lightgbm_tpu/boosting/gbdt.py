@@ -442,10 +442,13 @@ class GBDT:
         Fp = max(F_oh, 8)
         # int8 covers bins <= 127; larger max_bin needs int16 (a uint8 bin
         # index >= 128 would wrap negative in int8 and corrupt the one-hot)
-        dtype = np.int8 if Bp <= 128 else np.int16
-        bins_T = np.zeros((Fp, Rp), dtype)
-        bins_T[:F, :R] = np.asarray(train_data.bins).T
-        self.fused_bins_T = jnp.asarray(bins_T)
+        dtype = jnp.int8 if Bp <= 128 else jnp.int16
+        # transpose + pad ON DEVICE from the already-uploaded bin matrix:
+        # a second 300+ MB host transpose + host->device transfer through
+        # the remote tunnel costs ~10 s at Higgs scale
+        self.fused_bins_T = (
+            jnp.zeros((Fp, Rp), dtype)
+            .at[:F, :R].set(self.bins_dev.T.astype(dtype)))
         self.fused_f_oh = F_oh
         self.fused_Bp = Bp
         self.fused_Rp = Rp
@@ -951,18 +954,26 @@ class GBDT:
         k = self.num_tree_per_iteration
         n = self.num_data
         pad = self.fused_Rp - n
+        obj = self.objective
+        in_jit_grads = (obj is not None
+                        and obj.supports_traced_gradients())
         shrink = jnp.float32(self.shrinkage_rate)
         max_depth = int(self.config.max_depth)
         extra = int(self.config.tpu_extra_levels)
         interp = self.fused_interpret
 
-        # bins_T/grad/hess are ARGUMENTS, not closures: a closed-over device
-        # array of O(rows) size would be embedded in the lowered program as
-        # a constant (bins alone: 336 MB of HLO at 10.5M rows) and stall
-        # remote compilation. Gradients are computed eagerly outside for the
-        # same reason — the objective closes over its label/weight arrays.
+        # bins_T/gradient operands are ARGUMENTS, not closures: a
+        # closed-over device array of O(rows) size would be embedded in
+        # the lowered program as a constant (bins alone: 336 MB of HLO at
+        # 10.5M rows) and stall remote compilation. Objectives exposing
+        # the gradient_operands protocol compute gradients IN-jit (XLA
+        # fuses them with the gh pack); others compute eagerly outside.
         @jax.jit
-        def step(bins_T, scores, grad, hess, bag_weight, fm_pads):
+        def step(bins_T, scores, grad_in, hess_in, bag_weight, fm_pads):
+            if in_jit_grads:
+                grad, hess = obj.gradients_from(scores, grad_in)
+            else:
+                grad, hess = grad_in, hess_in
             trees = []
             for tid in range(k):
                 gh_T = pack_gh(
@@ -996,8 +1007,16 @@ class GBDT:
         k = self.num_tree_per_iteration
         init_scores = [self._boost_from_average(tid, True)
                        for tid in range(k)]
-        grad, hess = self._get_gradients()
-        grad, hess = self._bagging(self.iter, grad, hess)
+        operands = (self.objective.gradient_operands()
+                    if self.objective is not None
+                    and self.objective.supports_traced_gradients()
+                    else None)
+        if operands is not None:     # gradients traced into the step
+            grad_in, hess_in = operands, None
+            self._bagging(self.iter, None, None)
+        else:
+            grad_in, hess_in = self._get_gradients()
+            grad_in, hess_in = self._bagging(self.iter, grad_in, hess_in)
         if self._fast_step_fn is None:
             self._fast_step_fn = self._make_fast_step()
         F_oh = self.fused_f_oh
@@ -1011,8 +1030,8 @@ class GBDT:
                 jnp.zeros((F_oh,), bool).at[:self.train_data.num_features]
                 .set(self._feature_mask()) for _ in range(k)])
         self.scores, trees = self._fast_step_fn(
-            self.fused_bins_T, self.scores, grad, hess, self.bag_weight,
-            fm_pads)
+            self.fused_bins_T, self.scores, grad_in, hess_in,
+            self.bag_weight, fm_pads)
         for leaf in jax.tree_util.tree_leaves(trees):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()

@@ -205,11 +205,16 @@ class TpuDataset:
 
     def _push_data(self, data: np.ndarray) -> None:
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-        out = np.empty((self.num_data, len(self.used_features)), dtype=dtype)
+        # transpose copies on both sides keep every inner loop contiguous
+        # (strided per-column access to the row-major matrices dominates
+        # otherwise); float32 input stays float32 — value_to_bin bins it
+        # exactly against pre-rounded f32 bounds
+        dataT = np.ascontiguousarray(data.T)
+        outT = np.empty((len(self.used_features), self.num_data), dtype=dtype)
         for k, j in enumerate(self.used_features):
-            out[:, k] = self.mappers[j].value_to_bin(
-                np.asarray(data[:, j], dtype=np.float64)).astype(dtype)
-        self.bins = out
+            outT[k] = self.mappers[j].value_to_bin(dataT[j]).astype(
+                dtype, copy=False)
+        self.bins = np.ascontiguousarray(outT.T)
 
     # ------------------------------------------------------------------
     def add_features_from(self, other: "TpuDataset") -> None:

@@ -82,6 +82,33 @@ class ObjectiveFunction:
     def get_gradients(self, score) -> Tuple:
         raise NotImplementedError
 
+    # -- in-jit gradient protocol -------------------------------------
+    # The boosting fast path traces gradients into its per-iteration jit.
+    # O(num_data) arrays must enter that jit as ARGUMENTS (closed-over
+    # device arrays embed into the lowered program as constants — 100s of
+    # MB of HLO at Higgs scale). Objectives that support this return
+    # their large arrays from gradient_operands() and compute from them
+    # in gradients_from(); get_gradients stays the eager entry point.
+    def gradient_operands(self):
+        """Pytree of device arrays for gradients_from, or None if this
+        objective's gradients cannot be traced (host state, RNG)."""
+        return None
+
+    def gradients_from(self, score, operands) -> Tuple:
+        raise NotImplementedError
+
+    def supports_traced_gradients(self) -> bool:
+        """True only when the class providing the most-derived
+        get_gradients ALSO provides its own gradients_from — a subclass
+        overriding just get_gradients (huber/fair/poisson/... on top of
+        L2) must not inherit the base pair, or the traced path would
+        silently train with the base objective's gradients."""
+        for k in type(self).__mro__:
+            if "get_gradients" in k.__dict__:
+                return ("gradients_from" in k.__dict__
+                        and self.gradient_operands() is not None)
+        return False
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 

@@ -60,11 +60,18 @@ class BinaryLogloss(ObjectiveFunction):
         self._label_weight = jnp.asarray(lw)
 
     def get_gradients(self, score):
+        return self.gradients_from(score, self.gradient_operands())
+
+    def gradient_operands(self):
+        return (self._label_val, self._label_weight)
+
+    def gradients_from(self, score, operands):
         # ref: binary_objective.hpp:107-136
         if not self.need_train:
             return jnp.zeros_like(score), jnp.zeros_like(score)
-        lv = self._label_val[None, :]
-        lw = self._label_weight[None, :]
+        label_val, label_weight = operands
+        lv = label_val[None, :]
+        lw = label_weight[None, :]
         response = -lv * self.sigmoid / (1.0 + jnp.exp(lv * self.sigmoid
                                                        * score))
         abs_resp = jnp.abs(response)

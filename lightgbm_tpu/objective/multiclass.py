@@ -47,13 +47,20 @@ class MulticlassSoftmax(ObjectiveFunction):
                           if self.weight is not None else None)
 
     def get_gradients(self, score):
+        return self.gradients_from(score, self.gradient_operands())
+
+    def gradient_operands(self):
+        return (self._onehot, self._weight_j)
+
+    def gradients_from(self, score, operands):
         # ref: multiclass_objective.hpp:86-130
+        onehot, weight = operands
         p = jnp.exp(score - jnp.max(score, axis=0, keepdims=True))
         p = p / jnp.sum(p, axis=0, keepdims=True)
-        grad = p - self._onehot
+        grad = p - onehot
         hess = self.factor * p * (1.0 - p)
-        if self._weight_j is not None:
-            w = self._weight_j[None, :]
+        if weight is not None:
+            w = weight[None, :]
             grad, hess = grad * w, hess * w
         return grad, hess
 

@@ -39,10 +39,17 @@ class RegressionL2Loss(ObjectiveFunction):
                           if self.weight is not None else None)
 
     def get_gradients(self, score):
-        diff = score - self._label_j[None, :]
-        if self._weight_j is None:
+        return self.gradients_from(score, self.gradient_operands())
+
+    def gradient_operands(self):
+        return (self._label_j, self._weight_j)
+
+    def gradients_from(self, score, operands):
+        label, weight = operands
+        diff = score - label[None, :]
+        if weight is None:
             return diff, jnp.ones_like(diff)
-        w = self._weight_j[None, :]
+        w = weight[None, :]
         return diff * w, jnp.broadcast_to(w, diff.shape)
 
     def boost_from_score(self, class_id):

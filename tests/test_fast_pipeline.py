@@ -206,6 +206,28 @@ def test_multiclass_fast_matches_sync():
     assert acc1 > 0.95 and abs(acc1 - acc2) < 0.01
 
 
+def test_subclassed_objective_not_trained_with_base_gradients():
+    # huber subclasses L2 overriding only get_gradients; the fast path
+    # must NOT pair the inherited gradient_operands with L2's
+    # gradients_from (it would silently train unclipped L2)
+    rng = np.random.RandomState(13)
+    X = rng.rand(2000, 6).astype(np.float32)
+    y = (X[:, 0] * 3 + 0.1 * rng.randn(2000)).astype(np.float32)
+    y[:20] += 50.0    # outliers huber must resist
+    params = {"objective": "huber", "alpha": 0.5, "num_leaves": 15,
+              "learning_rate": 0.2, "verbose": -1, "min_data_in_leaf": 5,
+              "tpu_engine": "fused"}
+    b1 = lgb.Booster(params=dict(params),
+                     train_set=lgb.Dataset(X, label=y))
+    b2 = lgb.Booster(params=dict(params),
+                     train_set=lgb.Dataset(X, label=y))
+    b2._gbdt._fast_ok_cache = False
+    for _ in range(10):
+        b1.update()
+        b2.update()
+    assert np.abs(b1.predict(X) - b2.predict(X)).max() < 1e-4
+
+
 def test_engine_train_uses_fast_path():
     X, y = _data()
     bst = lgb.train(dict(FUSED), lgb.Dataset(X, label=y),
