@@ -476,6 +476,17 @@ class Booster:
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         """(ref: basic.py:3449 Booster.predict → predictor.hpp)"""
+        from .utils.timer import global_timer as _timer
+        with _timer.section("Predictor::Predict"):
+            return self._predict_body(
+                data, start_iteration, num_iteration, raw_score, pred_leaf,
+                pred_contrib, pred_early_stop, pred_early_stop_freq,
+                pred_early_stop_margin)
+
+    def _predict_body(self, data, start_iteration, num_iteration, raw_score,
+                      pred_leaf, pred_contrib, pred_early_stop,
+                      pred_early_stop_freq,
+                      pred_early_stop_margin) -> np.ndarray:
         self._drain()
         X = _to_2d_numpy(data).astype(np.float64)
         n = X.shape[0]

@@ -309,21 +309,27 @@ class BinMapper:
             ends = np.concatenate((starts[1:], [sv.size]))  # exclusive
             distinct = sv[ends - 1]
             counts = (ends - starts).astype(np.int64)
-            if zero_cnt > 0:
-                first_vals = sv[starts]
-                if sv[0] > 0.0:
+            zero_at = -1
+            if sv[0] > 0.0:
+                if zero_cnt > 0:     # leading zero group is gated
                     zero_at = 0
-                elif sv[-1] < 0.0:
+            elif sv[-1] < 0.0:
+                if zero_cnt > 0:     # trailing zero group is gated
                     zero_at = len(distinct)
-                else:
-                    # the break where the previous group ends negative and
-                    # the next starts positive (sequential insertion point)
-                    hits = np.nonzero((distinct[:-1] < 0.0)
-                                      & (first_vals[1:] > 0.0))[0]
-                    zero_at = int(hits[0]) + 1 if hits.size else -1
-                if zero_at >= 0:
-                    distinct = np.insert(distinct, zero_at, 0.0)
-                    counts = np.insert(counts, zero_at, zero_cnt)
+            else:
+                # the break where the previous group ends negative and the
+                # next starts positive — inserted UNCONDITIONALLY like the
+                # sequential walk (a zero entry with count 0 still lands
+                # in the distinct list and can shift forced/categorical
+                # binning)
+                first_vals = sv[starts]
+                hits = np.nonzero((distinct[:-1] < 0.0)
+                                  & (first_vals[1:] > 0.0))[0]
+                if hits.size:
+                    zero_at = int(hits[0]) + 1
+            if zero_at >= 0:
+                distinct = np.insert(distinct, zero_at, 0.0)
+                counts = np.insert(counts, zero_at, zero_cnt)
 
         self.min_val = float(distinct[0]) if len(distinct) else 0.0
         self.max_val = float(distinct[-1]) if len(distinct) else 0.0
@@ -461,9 +467,14 @@ class BinMapper:
         if self.bin_type == BIN_CATEGORICAL:
             v = arr.astype(np.float64, copy=False)
             iv = np.where(np.isnan(v), -1, v).astype(np.int64)
-            cats = np.array(sorted(self.categorical_2_bin), np.int64)
-            cbins = np.array([self.categorical_2_bin[c] for c in cats],
-                             np.int32)
+            cached = getattr(self, "_cat_lookup_cache", None)
+            if cached is None or len(cached[0]) != len(
+                    self.categorical_2_bin):
+                cats = np.array(sorted(self.categorical_2_bin), np.int64)
+                cbins = np.array([self.categorical_2_bin[c] for c in cats],
+                                 np.int32)
+                cached = self._cat_lookup_cache = (cats, cbins)
+            cats, cbins = cached
             pos = np.clip(np.searchsorted(cats, iv), 0, len(cats) - 1)
             out = np.where(cats[pos] == iv, cbins[pos], 0).astype(np.int32)
             return out[0] if scalar else out

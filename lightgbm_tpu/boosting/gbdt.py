@@ -24,6 +24,8 @@ from ..models.tree import HostTree, TreeArrays
 from ..ops.predict import add_tree_score
 from ..ops.split import SplitParams
 from ..utils import log
+from ..utils.timer import global_timer as timer
+from ..utils import random as ref_random
 
 K_EPSILON = 1e-15
 
@@ -188,8 +190,12 @@ class GBDT:
             for i in range(self.num_tree_per_iteration)]
 
         # bagging state (ref: gbdt.cpp:686-758 ResetBaggingConfig)
-        self.bag_rng = np.random.RandomState(config.bagging_seed)
-        self.feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        # reference-parity streams (ref: utils/random.h LCG; gbdt.cpp:804
+        # per-block bagging generators; col_sampler.hpp:26 by-tree stream)
+        self.bag_streams = ref_random.BlockBaggingStreams(
+            int(config.bagging_seed), n)
+        self.bag_rng = np.random.RandomState(config.bagging_seed)  # GOSS
+        self.feat_rng = ref_random.Random(int(config.feature_fraction_seed))
         self.balanced_bagging = False
         self.is_bagging = False
         if config.bagging_freq > 0:
@@ -563,13 +569,18 @@ class GBDT:
                 or it % cfg.bagging_freq != 0:
             return grad, hess
         n = self.num_data
+        # reference-parity draws: one float per row per round from the
+        # row's 1024-block LCG stream (ref: gbdt.cpp:192 BaggingHelper) —
+        # the in-bag SET matches the reference bit-for-bit
+        draws = self.bag_streams.next_floats()
         if self.balanced_bagging:
             label = self.train_data.metadata.label
-            frac = np.where(label > 0, cfg.pos_bagging_fraction,
-                            cfg.neg_bagging_fraction)
-            mask = self.bag_rng.random_sample(n) < frac
+            frac = np.where(label > 0,
+                            np.float32(cfg.pos_bagging_fraction),
+                            np.float32(cfg.neg_bagging_fraction))
+            mask = draws < frac
         else:
-            mask = self.bag_rng.random_sample(n) < cfg.bagging_fraction
+            mask = draws < np.float32(cfg.bagging_fraction)
         self.bag_cnt = int(mask.sum())
         log.debug("Re-bagging, using %d data to train", self.bag_cnt)
         self.bag_weight = jnp.asarray(mask.astype(np.float32))
@@ -734,8 +745,11 @@ class GBDT:
         frac = float(self.config.feature_fraction)
         if frac >= 1.0:
             return jnp.ones((F,), bool)
-        k = max(1, int(round(F * frac)))
-        chosen = self.feat_rng.choice(F, size=k, replace=False)
+        # reference-parity by-tree sampling: one persistent LCG stream,
+        # Sample(valid_count, RoundInt(count*fraction)) per tree
+        # (ref: col_sampler.hpp:33 GetCnt, :78 ResetByTree)
+        k = max(ref_random.round_int(F * frac), min(1, F))
+        chosen = self.feat_rng.sample(F, k)
         mask = np.zeros(F, bool)
         mask[chosen] = True
         return jnp.asarray(mask)
@@ -1004,6 +1018,14 @@ class GBDT:
         return step
 
     def _train_one_iter_fast(self) -> bool:
+        with timer.section("GBDT::TrainOneIterFast"):
+            stop = self._fast_iter_body()
+        if stop is None:    # batch full: drain outside the fast section
+            self.drain_pending()
+            return self._stopped_early
+        return stop
+
+    def _fast_iter_body(self):
         k = self.num_tree_per_iteration
         init_scores = [self._boost_from_average(tid, True)
                        for tid in range(k)]
@@ -1038,9 +1060,7 @@ class GBDT:
         self._pending.append((trees, init_scores))
         self.iter += 1
         if len(self._pending) >= self._FAST_SYNC_EVERY:
-            self.drain_pending()
-            if self._stopped_early:
-                return True
+            return None     # signal the wrapper to drain
         return False
 
     def drain_pending(self) -> None:
@@ -1053,6 +1073,10 @@ class GBDT:
         subtraction reverses the training add up to f32 rounding)."""
         if not self._pending:
             return
+        with timer.section("GBDT::DrainPending"):
+            self._drain_body()
+
+    def _drain_body(self) -> None:
         pend, self._pending = self._pending, []
         k = self.num_tree_per_iteration
         base_iter = self.iter - len(pend)
@@ -1148,6 +1172,10 @@ class GBDT:
         self.drain_pending()
         if self._stopped_early:
             return True
+        with timer.section("GBDT::TrainOneIter"):
+            return self._sync_iter_body(gradients, hessians)
+
+    def _sync_iter_body(self, gradients, hessians) -> bool:
         k, n = self.num_tree_per_iteration, self.num_data
         init_scores = [0.0] * k
         if gradients is None or hessians is None:
@@ -1302,6 +1330,10 @@ class GBDT:
         if not self.is_bagging:
             self.bag_weight = jnp.ones((n,), jnp.float32)
             self.bag_cnt = n
+        # the reference recreates its per-block bagging generators on
+        # every config reset (gbdt.cpp ResetBaggingConfig)
+        self.bag_streams = ref_random.BlockBaggingStreams(
+            int(config.bagging_seed), n)
         self.early_stopping_round = int(config.early_stopping_round)
         self.es_first_metric_only = bool(config.first_metric_only)
 
@@ -1408,7 +1440,7 @@ class DART(GBDT):
 
     def init(self, config, train_data, objective, training_metrics=()):
         super().init(config, train_data, objective, training_metrics)
-        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.drop_rng = ref_random.Random(int(config.drop_seed))
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self.drop_index: List[int] = []
@@ -1422,7 +1454,7 @@ class DART(GBDT):
     def _dropping_trees(self):
         cfg = self.config
         self.drop_index = []
-        is_skip = self.drop_rng.random_sample() < cfg.skip_drop
+        is_skip = self.drop_rng.next_float() < cfg.skip_drop
         if not is_skip:
             drop_rate = cfg.drop_rate
             if not cfg.uniform_drop:
@@ -1433,7 +1465,7 @@ class DART(GBDT):
                                         cfg.max_drop * inv_avg
                                         / self.sum_weight)
                     for i in range(self.iter):
-                        if (self.drop_rng.random_sample()
+                        if (self.drop_rng.next_float()
                                 < drop_rate * self.tree_weight[i] * inv_avg):
                             self.drop_index.append(self.num_init_iteration + i)
                             if len(self.drop_index) >= cfg.max_drop > 0:
@@ -1442,7 +1474,7 @@ class DART(GBDT):
                 if cfg.max_drop > 0 and self.iter > 0:
                     drop_rate = min(drop_rate, cfg.max_drop / self.iter)
                 for i in range(self.iter):
-                    if self.drop_rng.random_sample() < drop_rate:
+                    if self.drop_rng.next_float() < drop_rate:
                         self.drop_index.append(self.num_init_iteration + i)
                         if len(self.drop_index) >= cfg.max_drop > 0:
                             break

@@ -126,6 +126,14 @@ class TpuDataset:
         mappers per feature (ref: ConstructBinMappersFromTextData :988), then
         push binned values (ref: ExtractFeaturesFromMemory :1180).
         """
+        from .utils.timer import global_timer as timer
+        with timer.section("DatasetLoader::Construct"):
+            return cls._from_data(data, config, categorical_feature,
+                                  feature_names, reference, forced_bounds)
+
+    @classmethod
+    def _from_data(cls, data, config, categorical_feature=(),
+                   feature_names=None, reference=None, forced_bounds=None):
         self = cls()
         data = np.asarray(data)
         if data.ndim != 2:

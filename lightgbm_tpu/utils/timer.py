@@ -1,0 +1,121 @@
+"""Named-section timing + profiler integration.
+
+Behavioral analog of the reference's TIMETAG-gated section timer
+(ref: include/LightGBM/utils/common.h:978 Timer, :1042 FunctionTimer —
+Start/Stop accumulate per-name wall time, printed once at shutdown).
+Disabled timers are no-ops, so instrumentation can stay in the hot
+driver paths permanently like the reference's.
+
+Enable with env ``LIGHTGBM_TPU_TIMETAG=1`` (the analog of compiling the
+reference with -DTIMETAG) or ``global_timer.enable()``. On-device work is
+asynchronous under JAX, so sections measure DISPATCH time unless
+``sync=True`` is passed, which blocks on the given arrays first — the
+honest way to attribute device time to a section.
+
+``profiler_trace`` wraps ``jax.profiler.trace`` for XLA-level traces
+viewable in TensorBoard/Perfetto — the deep-dive path the reference
+lacks (SURVEY §5: profiling gap).
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import threading
+import time
+from typing import Dict
+
+from . import log
+
+
+class Timer:
+    """Accumulates wall-clock per named section (thread-safe)."""
+
+    def __init__(self, enabled: bool = False):
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._acc: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------------
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc.clear()
+            self._counts.clear()
+
+    # ------------------------------------------------------------------
+    def start(self, name: str) -> None:
+        if not self._enabled:
+            return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = {}
+        stack[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        if not self._enabled:
+            return
+        stack = getattr(self._tls, "stack", {})
+        t0 = stack.pop(name, None)
+        if t0 is None:
+            return
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    @contextlib.contextmanager
+    def section(self, name: str, sync=None):
+        """Time a block. ``sync`` = array/pytree to block on before
+        closing the section (attributes asynchronous device work here)."""
+        self.start(name)
+        try:
+            yield
+        finally:
+            if self._enabled and sync is not None:
+                import jax
+                jax.block_until_ready(sync)
+            self.stop(name)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._acc)
+
+    def print(self) -> None:
+        """(ref: common.h:1011 Timer::Print — '%s costs: %f' per name,
+        name-ordered)"""
+        if not self._acc:
+            return
+        for name in sorted(self._acc):
+            log.info("%s costs: %f seconds (%d calls)", name,
+                     self._acc[name], self._counts.get(name, 0))
+
+
+global_timer = Timer(enabled=bool(int(
+    os.environ.get("LIGHTGBM_TPU_TIMETAG", "0") or "0")))
+
+
+@atexit.register
+def _print_at_exit() -> None:  # ref: common.h:988 ~Timer() { Print(); }
+    if global_timer.enabled:
+        global_timer.print()
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """XLA-level trace via jax.profiler (TensorBoard/Perfetto viewable)."""
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Build the reference LightGBM (/root/reference, read-only) out-of-tree so
+# parity fixtures can be (re)generated with scripts/gen_parity_fixtures.py.
+#
+# The reference's external_libs/ submodules are empty in this image:
+#  - fmt: taken from TensorFlow's bundled spdlog copy (same namespace/API)
+#  - fast_double_parser: minimal strtod-backed stand-in (identical API;
+#    only used by the reference's text parser, not by anything we compare)
+#  - eigen: TensorFlow's bundled Eigen (needs -std=c++17, the only flag
+#    change vs the reference's default build)
+#
+# Produces an importable package at /tmp/refpkg:
+#   python -c "import sys; sys.path.insert(0, '/tmp/refpkg'); import lightgbm"
+set -e
+rm -rf /tmp/refsrc /tmp/refbuild
+cp -r /root/reference /tmp/refsrc
+chmod -R u+w /tmp/refsrc
+SPDLOG_FMT=/opt/venv/lib/python3.12/site-packages/tensorflow/include/external/spdlog/include/spdlog/fmt/bundled
+mkdir -p /tmp/refsrc/external_libs/fmt/include/fmt
+cp "$SPDLOG_FMT"/*.h /tmp/refsrc/external_libs/fmt/include/fmt/
+mkdir -p /tmp/refsrc/external_libs/fast_double_parser/include
+cat > /tmp/refsrc/external_libs/fast_double_parser/include/fast_double_parser.h <<'HDR'
+// minimal strtod-backed stand-in for fast_double_parser (API-compatible)
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  *out = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  return end;
+}
+}  // namespace fast_double_parser
+HDR
+rm -rf /tmp/refsrc/external_libs/eigen
+ln -s /opt/venv/lib/python3.12/site-packages/tensorflow/include \
+    /tmp/refsrc/external_libs/eigen
+cd /tmp/refsrc
+cmake -B /tmp/refbuild -S . -DCMAKE_BUILD_TYPE=Release \
+    -DBUILD_STATIC_LIB=OFF -DCMAKE_CXX_STANDARD=17 \
+    -DCMAKE_CXX_FLAGS="-std=gnu++17" > /tmp/refcmake.log 2>&1
+cmake --build /tmp/refbuild -j16 >> /tmp/refcmake.log 2>&1
+cp /tmp/refsrc/lib_lightgbm.so /tmp/refsrc/python-package/lightgbm/
+mkdir -p /tmp/refpkg
+ln -sfn /tmp/refsrc/python-package/lightgbm /tmp/refpkg/lightgbm
+echo "reference built: import via sys.path.insert(0, '/tmp/refpkg')"

@@ -121,3 +121,24 @@ bst = ref_lgb.train({"objective": "lambdarank", "num_leaves": 15,
 bst.save_model(f"{OUT}/ref_model_rank.txt")
 np.save(f"{OUT}/ref_pred_rank.npy", bst.predict(X[:n_q * per_q]))
 print("fixtures2 written")
+
+
+# ---- bagging-parity fixture: the per-iteration root internal_count is the
+# exact in-bag row count, a direct observable of the reference's per-block
+# LCG bagging streams (gbdt.cpp:192 BaggingHelper, utils/random.h)
+params_bag = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+              "max_bin": 63, "min_data_in_leaf": 20, "verbose": -1,
+              "deterministic": True, "force_row_wise": True, "seed": 7,
+              "bagging_fraction": 0.6, "bagging_freq": 1, "bagging_seed": 5,
+              "num_threads": 4}
+Xb = np.load(f"{OUT}/parity_X.npy")[:, :5].astype(np.float64)  # numerical
+yb = np.load(f"{OUT}/parity_y.npy").astype(np.float64)
+dsb = ref_lgb.Dataset(Xb, label=yb, params={"max_bin": 63, "verbose": -1})
+bb = ref_lgb.train(params_bag, dsb, num_boost_round=6)
+bb.save_model(f"{OUT}/ref_model_bagging.txt")
+dump = bb.dump_model()
+root_counts = [t["tree_structure"].get("internal_count",
+                                       t["tree_structure"].get("leaf_count"))
+               for t in dump["tree_info"]]
+np.save(f"{OUT}/ref_bag_root_counts.npy", np.asarray(root_counts, np.int64))
+print("bagging root counts:", root_counts)

@@ -1,0 +1,48 @@
+"""Section timer subsystem (utils/timer.py — analog of the reference's
+TIMETAG Timer, ref: include/LightGBM/utils/common.h:978)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.timer import Timer, global_timer
+
+
+def test_timer_disabled_is_noop():
+    t = Timer(enabled=False)
+    t.start("x")
+    t.stop("x")
+    assert t.stats() == {}
+
+
+def test_timer_accumulates_sections():
+    t = Timer(enabled=True)
+    with t.section("a"):
+        sum(range(1000))
+    with t.section("a"):
+        pass
+    with t.section("b"):
+        pass
+    s = t.stats()
+    assert set(s) == {"a", "b"} and s["a"] >= 0.0
+    t.reset()
+    assert t.stats() == {}
+
+
+def test_training_sections_recorded():
+    rng = np.random.RandomState(0)
+    X = rng.rand(500, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    global_timer.enable()
+    global_timer.reset()
+    try:
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=3)
+        bst.predict(X)
+        s = global_timer.stats()
+        assert "DatasetLoader::Construct" in s
+        assert ("GBDT::TrainOneIter" in s
+                or "GBDT::TrainOneIterFast" in s)
+        assert "Predictor::Predict" in s
+    finally:
+        global_timer.disable()
+        global_timer.reset()
