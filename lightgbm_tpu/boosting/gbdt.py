@@ -219,15 +219,27 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _setup_bundles(self, config: Config, train_data) -> None:
-        """Exclusive feature bundling for the depthwise XLA grower (ref:
-        src/io/dataset.cpp FindGroups/FastFeatureBundling). Engaged only
-        when bundling actually reduces the column count; opt-in via
-        tpu_enable_bundle until the fused engine integration lands (the
-        reference's enable_bundle default stays accepted but maps to the
-        logical layout elsewhere)."""
+        """Exclusive feature bundling for the fused and depthwise growers
+        (ref: src/io/dataset.cpp FindGroups/FastFeatureBundling). On by
+        default like the reference's enable_bundle; engages only when
+        bundling actually reduces the column count (dense data is
+        unaffected — conflict-free bundles simply don't form)."""
         self.use_bundles = False
-        if not bool(config.tpu_enable_bundle):
+        if not (bool(config.tpu_enable_bundle)
+                and bool(config.enable_bundle)):
             return
+        if "tpu_enable_bundle" not in getattr(config, "_user_set", set()):
+            # default-on only where it cannot change the grow policy: the
+            # fused engine is depth-wise regardless. On the xla engine
+            # bundling would force depth-wise growth and silently diverge
+            # from the leaf-wise reference default on sparse data, so
+            # there it stays opt-in.
+            from ..ops.pallas_histogram import HAS_PALLAS
+            eng = config.tpu_engine
+            on_tpu = jax.default_backend() == "tpu"
+            if not (eng == "fused"
+                    or (eng == "auto" and on_tpu and HAS_PALLAS)):
+                return
         if self.has_cat:
             log.warning("feature bundling with categorical features is "
                         "not supported yet; disabled")
@@ -368,9 +380,10 @@ class GBDT:
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
             engine = "xla"
-        if getattr(self, "use_bundles", False) and engine != "xla":
-            log.info("feature bundling uses the depthwise XLA engine")
-            engine = "xla"
+        if getattr(self, "use_bundles", False) and engine == "frontier":
+            log.info("feature bundling is not wired into the frontier-v1 "
+                     "engine; using the fused engine")
+            engine = "fused"
         if getattr(self, "use_cegb", False) and engine != "xla":
             # CEGB gain deltas are wired into the depthwise XLA grower;
             # must override BEFORE the engine flags are derived
@@ -439,22 +452,63 @@ class GBDT:
     # ------------------------------------------------------------------
     def _init_fused(self, train_data: TpuDataset) -> None:
         """int8 transposed bin matrix + f_oh-padded metadata for the fused
-        route+histogram level kernel (ops/fused_level.py)."""
+        route+histogram level kernel (ops/fused_level.py). With EFB the
+        matrix holds bundle COLUMNS (kernel layout) while split search
+        stays on the logical feature layout."""
         from ..ops.fused_level import NCH_FAST, NCH_PRECISE, feature_layout
         F = train_data.num_features
         F_oh, Bp = feature_layout(F, self.max_bins)
         R = self.num_data
         Rp = ((R + 1023) // 1024) * 1024
-        Fp = max(F_oh, 8)
-        # int8 covers bins <= 127; larger max_bin needs int16 (a uint8 bin
-        # index >= 128 would wrap negative in int8 and corrupt the one-hot)
-        dtype = jnp.int8 if Bp <= 128 else jnp.int16
-        # transpose + pad ON DEVICE from the already-uploaded bin matrix:
-        # a second 300+ MB host transpose + host->device transfer through
-        # the remote tunnel costs ~10 s at Higgs scale
-        self.fused_bins_T = (
-            jnp.zeros((Fp, Rp), dtype)
-            .at[:F, :R].set(self.bins_dev.T.astype(dtype)))
+        if getattr(self, "use_bundles", False):
+            n_cols = int(self.bundle_bins_dev.shape[1])
+            C_oh, Bc_p = feature_layout(n_cols, self.bundle_col_bins)
+            Fp = max(C_oh, 8)
+            dtype = jnp.int8 if Bc_p <= 128 else jnp.int16
+            self.fused_bins_T = (
+                jnp.zeros((Fp, Rp), dtype)
+                .at[:n_cols, :R].set(self.bundle_bins_dev.T.astype(dtype)))
+            self.fused_bundle_cols = C_oh
+            self.fused_bundle_col_bins = Bc_p
+            # decode tables padded to the logical f_oh (padding features:
+            # invalid everywhere, residual suppressed by bundle_plane_views)
+            from ..models.learner import BundleCfg
+            bc = self.bundle_cfg
+            # logical plane layout is [f_oh, Bp] (pow2-padded bins like the
+            # unbundled fused pool); kernel flat stride is the padded Bc_p
+            fi = jnp.zeros((F_oh, Bp), jnp.int32)
+            va = jnp.zeros((F_oh, Bp), bool)
+            db = jnp.zeros((F_oh,), jnp.int32)
+            cof = jnp.full((F_oh,), -1, jnp.int32)
+            off = jnp.zeros((F_oh,), jnp.int32)
+            col = bc.col_of_feat
+            offs = bc.offset_of_feat
+            b_i = jnp.arange(Bp, dtype=jnp.int32)[None, :]
+            fi = fi.at[:F].set(jnp.minimum(
+                col[:, None] * Bc_p + offs[:, None] + b_i,
+                C_oh * Bc_p - 1))
+            va = va.at[:F, :bc.valid.shape[1]].set(bc.valid)
+            db = db.at[:F].set(bc.default_bin)
+            cof = cof.at[:F].set(col)
+            off = off.at[:F].set(offs)
+            self.fused_bundle_cfg = BundleCfg(
+                flat_idx=fi, valid=va, default_bin=db, col_of_feat=cof,
+                offset_of_feat=off)
+        else:
+            Fp = max(F_oh, 8)
+            # int8 covers bins <= 127; larger max_bin needs int16 (a uint8
+            # bin index >= 128 would wrap negative in int8 and corrupt the
+            # one-hot)
+            dtype = jnp.int8 if Bp <= 128 else jnp.int16
+            # transpose + pad ON DEVICE from the already-uploaded bin
+            # matrix: a second 300+ MB host transpose + host->device
+            # transfer through the remote tunnel costs ~10 s at Higgs scale
+            self.fused_bins_T = (
+                jnp.zeros((Fp, Rp), dtype)
+                .at[:F, :R].set(self.bins_dev.T.astype(dtype)))
+            self.fused_bundle_cols = 0
+            self.fused_bundle_col_bins = 0
+            self.fused_bundle_cfg = None
         self.fused_f_oh = F_oh
         self.fused_Bp = Bp
         self.fused_Rp = Rp
@@ -668,6 +722,9 @@ class GBDT:
                 use_mono_bounds=self.use_mono_bounds,
                 use_node_masks=self.use_node_masks,
                 node_masks=self._node_masks_padded(),
+                bundle_cols=self.fused_bundle_cols,
+                bundle_col_bins=self.fused_bundle_col_bins,
+                bundle_cfg=self.fused_bundle_cfg,
                 interpret=self.fused_interpret)
             return tree, row_leaf[:n]
         if self.use_frontier:
@@ -1001,6 +1058,9 @@ class GBDT:
                     max_depth=max_depth, extra_levels=extra,
                     has_cat=self.has_cat,
                     use_mono_bounds=self.use_mono_bounds,
+                    bundle_cols=self.fused_bundle_cols,
+                    bundle_col_bins=self.fused_bundle_col_bins,
+                    bundle_cfg=self.fused_bundle_cfg,
                     interpret=interp)
                 delta = table_lookup(row_leaf[None, :],
                                      tree.leaf_value * shrink,

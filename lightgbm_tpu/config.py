@@ -239,10 +239,11 @@ _PARAMS: List[_Param] = [
     _p("tpu_hist_precision", str, "bf16x2",
        desc="histogram input precision: bf16x2 (hi/lo split, fp32-grade, "
             "default) or bf16 (fastest)"),
-    _p("tpu_enable_bundle", bool, False,
-       desc="exclusive feature bundling on the depthwise XLA grower "
-            "(sparse mutually-exclusive features share histogram columns); "
-            "off by default until the fused-engine integration lands"),
+    _p("tpu_enable_bundle", bool, True,
+       desc="exclusive feature bundling (sparse mutually-exclusive "
+            "features share histogram columns) on the fused and depthwise "
+            "growers; engages only when it reduces the column count, and "
+            "requires enable_bundle too (the reference's switch)"),
     _p("tpu_extra_levels", int, 3, check=(">=", 0),
        desc="extra fused-level passes after the pow2 frontier levels so "
             "skewed trees can spend the remaining leaf budget"),

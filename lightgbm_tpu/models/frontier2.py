@@ -32,9 +32,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.fused_level import (NCH_PRECISE, build_route_table, hist_planes,
-                               level_pass, max_slot_cap, route_pass,
-                               table_lookup)
+from ..ops.fused_level import (NCH_PRECISE, build_route_table,
+                               build_route_table_bundled,
+                               bundle_plane_views, hist_planes, level_pass,
+                               max_slot_cap, route_pass, table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output)
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
@@ -108,7 +109,8 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "f_oh", "num_rows",
                      "nch", "max_depth", "extra_levels", "has_cat",
-                     "use_mono_bounds", "use_node_masks", "interpret"))
+                     "use_mono_bounds", "use_node_masks", "interpret",
+                     "bundle_cols", "bundle_col_bins"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -116,14 +118,17 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     max_depth: int = -1, extra_levels: int = 3,
                     has_cat: bool = False, use_mono_bounds: bool = False,
                     use_node_masks: bool = False, node_masks=None,
-                    interpret: bool = False,
+                    bundle_cols: int = 0, bundle_col_bins: int = 0,
+                    bundle_cfg=None, interpret: bool = False,
                     ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with fused level passes.
 
     Args:
-      bins_T: [Fp, Rp] int8 transposed binned matrix; Rp a multiple of 1024;
-        padded feature rows all-zero; padded row COLUMNS can be anything
-        (their gh is zero and their leaf starts at -1).
+      bins_T: [Fp, Rp] int8/int16 transposed binned matrix; Rp a multiple
+        of 1024; padded feature rows all-zero; padded row COLUMNS can be
+        anything (their gh is zero and their leaf starts at -1). With EFB
+        (``bundle_cols > 0``) the rows are BUNDLE columns carrying
+        ``bundle_col_bins`` bins each; splits/histograms stay logical.
       gh_T: [8, Rp] bfloat16 from ops.fused_level.pack_gh (zeros in padding
         columns).
       meta: FeatureMeta with arrays sized f_oh (padding features must carry
@@ -132,6 +137,9 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
       num_rows: real row count R (0 = all Rp rows are real). Padding rows
         [R:] are pinned to leaf -1 so they never route, histogram, or
         receive score updates.
+      bundle_cols/bundle_col_bins: kernel layout when the matrix holds EFB
+        bundle columns (0 = unbundled); ``bundle_cfg`` is the
+        models.learner.BundleCfg decode table plus meta.most-freq bins.
 
     Returns (TreeArrays, row_leaf [Rp] int32 — caller slices to R; padding
     rows stay at -1).
@@ -139,8 +147,14 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     Fp, Rp = bins_T.shape
     L = num_leaves
     B = max_bins
+    use_bundles = bundle_cols > 0
+    if use_bundles:
+        assert not has_cat, "EFB with categorical features is unsupported"
+        k_foh, k_B = bundle_cols, bundle_col_bins   # kernel layout
+    else:
+        k_foh, k_B = f_oh, B
     caps = level_caps(L, max_depth, extra_levels,
-                      slot_cap=max_slot_cap(f_oh * B, nch))
+                      slot_cap=max_slot_cap(k_foh * k_B, nch))
 
     R = num_rows or Rp
     # padding rows sit at leaf -1; inactive slots use leaf_of_slot = -2 so
@@ -153,18 +167,22 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     pool_c = jnp.zeros((L, f_oh, B), jnp.float32)
 
     # ---------------- root pass: slot 0 collects the full-data histogram
+    # (W0[0, bins of column 0] = 1 sends every row "left" on slot 0 —
+    # each row's one-hot holds exactly one bin of column 0)
     Sp0 = 8
-    feat0 = jnp.where(jnp.arange(Sp0) == 0, 0, -1).astype(jnp.int32)
-    W0 = build_route_table(
-        feat0, jnp.full((Sp0,), B - 1, jnp.int32), jnp.ones((Sp0,), bool),
-        meta.num_bin, meta.missing_type, meta.default_bin, Sp0, f_oh, B)
+    W0 = jnp.zeros((Sp0, k_foh * k_B), jnp.bfloat16).at[0, :k_B].set(1)
     tbl0 = jnp.zeros((Sp0, 128), jnp.int32)
     tbl0 = tbl0.at[:, 0].set(jnp.where(jnp.arange(Sp0) == 0, 0, -2))
     tbl0 = tbl0.at[0, 2].set(1)
     hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
-                          num_bins=B, f_oh=f_oh, nch=nch,
+                          num_bins=k_B, f_oh=k_foh, nch=nch,
                           interpret=interpret)
-    g0, h0, c0 = hist_planes(hist0, nch, Sp0, f_oh, B)
+    g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
+    if use_bundles:
+        v = bundle_plane_views(jnp.stack([g0, h0, c0], axis=-1),
+                               bundle_cfg.flat_idx, bundle_cfg.valid,
+                               bundle_cfg.default_bin)
+        g0, h0, c0 = v[..., 0], v[..., 1], v[..., 2]
     pool_g = pool_g.at[0].set(g0[0])
     pool_h = pool_h.at[0].set(h0[0])
     pool_c = pool_c.at[0].set(c0[0])
@@ -203,16 +221,20 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
                            use_mono_bounds, use_node_masks, node_masks,
-                           li + 1, li == len(caps) - 1, interpret)
+                           li + 1, li == len(caps) - 1,
+                           bundle_cols, bundle_col_bins, bundle_cfg,
+                           interpret)
     tree, leaf_T = state[0], state[1]
     return tree, leaf_T[0]
 
 
 def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                S_d, nch, max_depth, has_cat, use_mono_bounds,
-               use_node_masks, node_masks, fold, is_last, interpret):
+               use_node_masks, node_masks, fold, is_last,
+               bundle_cols, bundle_col_bins, bundle_cfg, interpret):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
      leaf_lo, leaf_hi, leaf_groups) = state
+    use_bundles = bundle_cols > 0
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
 
@@ -262,26 +284,42 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         new_s = jnp.where(lof_on, tree.num_leaves + jnp.arange(Sp), 0)
         delta_s = jnp.where(lof_on, new_s - lof_safe, 0)
 
-        W = build_route_table(feat_s, thr_s, dl_s, meta.num_bin,
-                              meta.missing_type, meta.default_bin,
-                              Sp, f_oh, B,
-                              cat_flag=cf_s if has_cat else None,
-                              cat_mask=cm_s if has_cat else None)
+        if use_bundles:
+            W = build_route_table_bundled(
+                feat_s, thr_s, dl_s, meta.num_bin, meta.missing_type,
+                meta.default_bin, bundle_cfg.default_bin,
+                bundle_cfg.col_of_feat, bundle_cfg.offset_of_feat,
+                bundle_cols, bundle_col_bins)
+        else:
+            W = build_route_table(feat_s, thr_s, dl_s, meta.num_bin,
+                                  meta.missing_type, meta.default_bin,
+                                  Sp, f_oh, B,
+                                  cat_flag=cf_s if has_cat else None,
+                                  cat_mask=cm_s if has_cat else None)
         tbl = jnp.zeros((Sp, 128), jnp.int32)
         tbl = tbl.at[:, 0].set(lof)
         tbl = tbl.at[:, 1].set(delta_s)
         tbl = tbl.at[:, 2].set(small_left_s.astype(jnp.int32))
 
+        k_foh = bundle_cols if use_bundles else f_oh
+        k_B = bundle_col_bins if use_bundles else B
         # ---- THE level pass: route (+ smaller-child histograms)
         if route_only:
             leaf_T2 = route_pass(bins_T, leaf_T, W, tbl, num_slots=Sp,
-                                 num_bins=B, f_oh=f_oh, interpret=interpret)
+                                 num_bins=k_B, f_oh=k_foh,
+                                 interpret=interpret)
             pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
         else:
             hist, leaf_T2 = level_pass(
-                bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=B,
-                f_oh=f_oh, nch=nch, interpret=interpret)
-            sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, f_oh, B)
+                bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=k_B,
+                f_oh=k_foh, nch=nch, interpret=interpret)
+            sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh, k_B)
+            if use_bundles:
+                v = bundle_plane_views(
+                    jnp.stack([sm_g, sm_h, sm_c], axis=-1),
+                    bundle_cfg.flat_idx, bundle_cfg.valid,
+                    bundle_cfg.default_bin)
+                sm_g, sm_h, sm_c = v[..., 0], v[..., 1], v[..., 2]
 
             # ---- sibling by subtraction from the parent pool
             par_g = _pool_read(pool_g, lof_safe, Sp)

@@ -207,21 +207,11 @@ class BundleCfg(NamedTuple):
 
 def bundle_views(bundle_hist: jax.Array, cfg: BundleCfg) -> jax.Array:
     """[S, C, Bc, ch] bundle histograms -> [S, F, B, ch] logical views
-    with the FixHistogram default-bin residual (ref: dataset.cpp:1265).
-    Slot totals come from column 0 (bundle bin 0 is a catch-all, so every
-    column partitions all rows)."""
-    S, C, Bc, ch = bundle_hist.shape
-    F, B = cfg.flat_idx.shape
-    flat = bundle_hist.reshape(S, C * Bc, ch)
-    view = jnp.take(flat, cfg.flat_idx.reshape(-1), axis=1)         .reshape(S, F, B, ch)
-    view = jnp.where(cfg.valid[None, :, :, None], view, 0.0)
-    totals = jnp.sum(bundle_hist[:, 0, :, :], axis=1)          # [S, ch]
-    residual = totals[:, None, :] - jnp.sum(view, axis=2)      # [S, F, ch]
-    add = jnp.zeros_like(view).at[
-        jnp.arange(S)[:, None],
-        jnp.arange(F)[None, :],
-        cfg.default_bin[None, :]].add(residual)
-    return view + add
+    with the FixHistogram default-bin residual (ref: dataset.cpp:1265);
+    delegates to the shared ops/fused_level implementation."""
+    from ..ops.fused_level import bundle_plane_views
+    return bundle_plane_views(bundle_hist, cfg.flat_idx, cfg.valid,
+                              cfg.default_bin)
 
 
 def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,

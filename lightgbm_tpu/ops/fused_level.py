@@ -180,6 +180,77 @@ def build_route_table(feature: jax.Array, threshold: jax.Array,
     return w.reshape(Sp, F_oh * B).astype(jnp.bfloat16)
 
 
+def build_route_table_bundled(feature: jax.Array, threshold: jax.Array,
+                              default_left: jax.Array, num_bin: jax.Array,
+                              missing_type: jax.Array,
+                              default_bin: jax.Array,
+                              most_freq_bin: jax.Array,
+                              col_of_feat: jax.Array,
+                              offset_of_feat: jax.Array,
+                              C_cols: int, Bp: int) -> jax.Array:
+    """W [Sp, C_cols*Bp] for LOGICAL splits over EFB bundle columns.
+
+    A bundle-bin bb of column c decodes to logical feature f's bin as
+    ``bb - offset_f`` when bb lies in f's window, and to f's
+    most-frequent bin otherwise (rows default in every bundled feature
+    share bundle bin 0 — ops/efb.py encoding). Only the owning column
+    carries the decision; all other columns stay zero so the routing dot
+    D = W @ one_hot still reads each row's verdict from exactly one
+    lane. Missing-bin semantics follow the numerical rule on the DECODED
+    bin (ref: src/io/dense_bin.hpp Split)."""
+    F = num_bin.shape[0]
+    Sp = feature.shape[0]
+    c_iota = jnp.arange(C_cols, dtype=jnp.int32)[None, :, None]
+    b_iota = jnp.arange(Bp, dtype=jnp.int32)[None, None, :]
+
+    feat_safe = jnp.maximum(feature, 0)
+    nb = num_bin[feat_safe][:, None, None]
+    mt = missing_type[feat_safe][:, None, None]
+    db = default_bin[feat_safe][:, None, None]
+    mfb = most_freq_bin[feat_safe][:, None, None]
+    col = col_of_feat[feat_safe][:, None, None]
+    off = offset_of_feat[feat_safe][:, None, None]
+    thr = threshold[:, None, None]
+    dl = default_left[:, None, None]
+
+    in_window = (b_iota >= off) & (b_iota < off + nb)
+    logical_bin = jnp.where(in_window, b_iota - off, mfb)
+    is_missing = (((mt == 1) & (logical_bin == db))
+                  | ((mt == 2) & (logical_bin == nb - 1)))
+    go_left = jnp.where(is_missing, dl, logical_bin <= thr)
+    w = (c_iota == col) & go_left & (feature[:, None, None] >= 0)
+    return w.reshape(Sp, C_cols * Bp).astype(jnp.bfloat16)
+
+
+def bundle_plane_views(plane: jax.Array, flat_idx: jax.Array,
+                       valid: jax.Array, default_bin: jax.Array
+                       ) -> jax.Array:
+    """Bundle histogram -> logical per-feature view with the FixHistogram
+    residual on each feature's most-frequent bin (ref:
+    src/io/dataset.cpp:1265). The single shared implementation for both
+    the fused engine and models/learner.bundle_views.
+
+    plane: [Sp, C_cols, Bp] or [Sp, C_cols, Bp, ch]. Returns the same
+    rank with (C_cols, Bp) -> (F, B). Slot totals come from column 0 —
+    every row lands in some bin of every column. Padding features (no
+    valid bins) stay all-zero."""
+    squeeze = plane.ndim == 3
+    if squeeze:
+        plane = plane[..., None]
+    Sp, C, Bp, ch = plane.shape
+    F, B = flat_idx.shape
+    flat = plane.reshape(Sp, C * Bp, ch)
+    view = jnp.take(flat, flat_idx.reshape(-1), axis=1) \
+        .reshape(Sp, F, B, ch)
+    view = jnp.where(valid[None, :, :, None], view, 0.0)
+    totals = jnp.sum(plane[:, 0, :, :], axis=1)                 # [Sp, ch]
+    residual = totals[:, None, :] - jnp.sum(view, axis=2)       # [Sp, F, ch]
+    residual = residual * jnp.any(valid, axis=1)[None, :, None]
+    out = view.at[jnp.arange(Sp)[:, None], jnp.arange(F)[None, :],
+                  default_bin[None, :]].add(residual)
+    return out[..., 0] if squeeze else out
+
+
 def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
                   hist_ref, newleaf_ref, oh_ref, *,
                   B: int, F_oh: int, Sp: int, nch: int):

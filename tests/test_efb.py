@@ -129,3 +129,116 @@ def test_bundled_nonzero_mode_routing():
         aucs[bundle] = roc_auc_score(y, bst.predict(X))
     assert aucs[True] > 0.95, aucs
     assert abs(aucs[True] - aucs[False]) < 0.02, aucs
+
+
+def test_fused_engine_with_bundles_matches_unbundled():
+    """EFB on the FUSED engine: conflict-free bundling must reproduce the
+    unbundled fused trees exactly (routing via bundle-decoded W tables,
+    histograms via logical-view reconstruction)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(21)
+    n = 3000
+    # mutually exclusive sparse features: each row non-default in at most
+    # one of the first 6 features
+    X = np.zeros((n, 8), np.float32)
+    owner = rng.randint(0, 6, n)
+    vals = rng.rand(n).astype(np.float32) + 0.5
+    X[np.arange(n), owner] = vals
+    X[:, 6] = rng.rand(n)          # dense
+    X[:, 7] = rng.rand(n)          # dense
+    y = ((X[:, 6] + X[:, 0] - X[:, 1] > 0.6)).astype(np.float32)
+
+    common = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 5, "max_bin": 63,
+              "tpu_engine": "fused"}
+    p_bundled = dict(common, tpu_enable_bundle=True)
+    b1 = lgb.Booster(params=p_bundled,
+                     train_set=lgb.Dataset(X, label=y))
+    assert b1._gbdt.use_bundles and b1._gbdt.use_fused
+    assert b1._gbdt.fused_bundle_cols > 0
+    b2 = lgb.Booster(params=dict(common, tpu_enable_bundle=False),
+                     train_set=lgb.Dataset(X, label=y))
+    assert not b2._gbdt.use_bundles
+    for _ in range(10):
+        b1.update()
+        b2.update()
+    assert b1.num_trees() == b2.num_trees() == 10
+    # FixHistogram computes each feature's most-frequent bin as
+    # total - window_sum; the different f32 rounding can flip near-tie
+    # splits exactly like the reference's enable_bundle on/off, so the
+    # contract is same-quality models, and the count channel (exact
+    # integer sums) must agree on the first split
+    assert b1.models[0].split_feature[0] == b2.models[0].split_feature[0]
+    assert int(b1.models[0].internal_count[0]) == \
+        int(b2.models[0].internal_count[0])
+    p1, p2 = b1.predict(X), b2.predict(X)
+    assert np.abs(p1 - p2).max() < 0.05
+    from sklearn.metrics import roc_auc_score
+    a1, a2 = roc_auc_score(y, p1), roc_auc_score(y, p2)
+    assert abs(a1 - a2) < 0.005 and a1 > 0.9
+
+
+def test_fused_bundles_with_missing_values():
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    n = 2000
+    X = np.zeros((n, 6), np.float32)
+    owner = rng.randint(0, 4, n)
+    X[np.arange(n), owner] = rng.rand(n).astype(np.float32) + 0.5
+    X[:, 4] = rng.rand(n)
+    X[:, 4][::9] = np.nan          # NaN routing through the dense feature
+    X[:, 5] = rng.rand(n)
+    y = (X[:, 4] > 0.5).astype(np.float32)
+    y[np.isnan(X[:, 4])] = 1.0
+    common = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "tpu_engine": "fused"}
+    b1 = lgb.Booster(params=dict(common, tpu_enable_bundle=True),
+                     train_set=lgb.Dataset(X, label=y))
+    assert b1._gbdt.use_bundles and b1._gbdt.fused_bundle_cols > 0
+    b2 = lgb.Booster(params=dict(common, tpu_enable_bundle=False),
+                     train_set=lgb.Dataset(X, label=y))
+    for _ in range(8):
+        b1.update()
+        b2.update()
+    p1, p2 = b1.predict(X), b2.predict(X)
+    assert np.abs(p1 - p2).max() < 0.05
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, p1) > 0.95
+
+
+def test_bundle_plane_views_matches_numpy_oracle():
+    """ops/fused_level.bundle_plane_views vs the numpy logical-view
+    reconstruction (ops/efb.logical_histograms) on random histograms."""
+    from lightgbm_tpu.ops.efb import BundleLayout, logical_histograms
+    from lightgbm_tpu.ops.fused_level import bundle_plane_views
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    nb = [4, 3, 5, 6]                      # logical bins per feature
+    layout = BundleLayout([[0, 2], [1, 3]], nb)
+    Bc = max(layout.col_num_bin)
+    Bc_p = 16                              # padded kernel stride
+    S, B = 3, 8
+    F = 4
+    # random bundle histogram [S, C, Bc_p] with zero padding bins
+    bh = np.zeros((S, 2, Bc_p), np.float32)
+    for c in range(2):
+        bh[:, c, :layout.col_num_bin[c]] = rng.rand(
+            S, layout.col_num_bin[c]).astype(np.float32)
+    # equalize column totals (every row lands in every column)
+    tot = bh[:, 0].sum(axis=1)
+    bh[:, 1, 0] += tot - bh[:, 1].sum(axis=1)
+    mfb = [1, 0, 2, 3]
+    flat_idx = np.zeros((F, B), np.int32)
+    valid = np.zeros((F, B), bool)
+    for f in range(F):
+        ci, off = int(layout.col_of_feat[f]), int(layout.offset_of_feat[f])
+        for b in range(nb[f]):
+            flat_idx[f, b] = ci * Bc_p + off + b
+            valid[f, b] = True
+    got = np.asarray(bundle_plane_views(
+        jnp.asarray(bh), jnp.asarray(flat_idx), jnp.asarray(valid),
+        jnp.asarray(mfb, np.int32)))
+    # oracle works on the unpadded [S, C, Bc, 1] layout
+    want = logical_histograms(bh[:, :, :Bc, None], tot[:, None], layout,
+                              nb, mfb, B)[..., 0]
+    assert np.allclose(got, want, atol=1e-5), np.abs(got - want).max()
