@@ -1,0 +1,15 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+x = jnp.asarray(np.random.RandomState(0).randn(4096, 4096).astype(np.float32)).astype(jnp.bfloat16)
+@jax.jit
+def chain(x):
+    for _ in range(8):
+        x = (x @ x) * 1e-3
+    return jnp.sum(x.astype(jnp.float32))
+float(chain(x))
+t0 = time.perf_counter()
+float(chain(x))
+dt = time.perf_counter() - t0
+print(f"chained 8x4096^3 matmul: {dt*1e3:.1f} ms -> {8*2*4096**3/dt/1e12:.1f} TF/s")
