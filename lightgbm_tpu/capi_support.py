@@ -1,0 +1,156 @@
+"""Python side of the C ABI (native/capi.cpp).
+
+The C layer passes raw buffer addresses and scalar metadata; this module
+wraps them with numpy (zero-copy via ctypes) and drives the normal
+package objects. Handles crossing the ABI are ordinary Python objects
+whose references the C layer owns (Py_DECREF on *Free).
+
+Field/data type codes follow the reference C API
+(ref: include/LightGBM/c_api.h: C_API_DTYPE_FLOAT32=0, FLOAT64=1,
+INT32=2, INT64=3; predict types: NORMAL=0, RAW_SCORE=1, LEAF_INDEX=2,
+CONTRIB=3).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .basic import Booster, Dataset
+
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _wrap(ptr: int, count: int, type_code: int) -> np.ndarray:
+    dt = np.dtype(_DTYPES[type_code])
+    buf = (ctypes.c_char * (count * dt.itemsize)).from_address(ptr)
+    return np.frombuffer(buf, dtype=dt)
+
+
+def _parse_params(parameters: str) -> dict:
+    out = {}
+    for tok in parameters.replace("\t", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------- dataset
+def dataset_create_from_mat(ptr, data_type, nrow, ncol, is_row_major,
+                            parameters, reference):
+    if not ptr or nrow <= 0 or ncol <= 0:
+        raise ValueError("DatasetCreateFromMat: data pointer is null or "
+                         f"shape ({nrow}, {ncol}) is empty")
+    arr = _wrap(ptr, nrow * ncol, data_type)
+    X = arr.reshape(nrow, ncol) if is_row_major else \
+        arr.reshape(ncol, nrow).T
+    # COPY before returning: the reference's CreateFromMat owns its data
+    # from this point on, and Dataset.construct() runs lazily — a view
+    # would read caller memory that may already be freed
+    ds = Dataset(np.array(X, copy=True),
+                 params=_parse_params(parameters),
+                 reference=reference if isinstance(reference, Dataset)
+                 else None)
+    return ds
+
+
+def dataset_set_field(ds, name, ptr, num_element, type_code):
+    vals = _wrap(ptr, num_element, type_code).copy()
+    if name == "label":
+        ds.set_label(vals)
+    elif name == "weight":
+        ds.set_weight(vals)
+    elif name in ("group", "query"):
+        ds.set_group(vals.astype(np.int64))
+    elif name == "init_score":
+        ds.init_score = vals
+        if ds._inner is not None:
+            ds._inner.metadata.set_init_score(vals)
+    else:
+        raise ValueError(f"unknown field name {name!r}")
+    return True
+
+
+def dataset_num_data(ds):
+    ds.construct()
+    return int(ds._inner.num_data)
+
+
+def dataset_num_feature(ds):
+    ds.construct()
+    return int(ds._inner.num_total_features)
+
+
+# ---------------------------------------------------------------- booster
+def booster_create(train_ds, parameters):
+    return Booster(params=_parse_params(parameters), train_set=train_ds)
+
+
+def booster_from_modelfile(filename):
+    bst = Booster(model_file=filename)
+    return bst, bst.current_iteration()
+
+
+def booster_add_valid(bst, valid_ds):
+    bst.add_valid(valid_ds, f"valid_{len(bst.valid_sets)}")
+    return True
+
+
+def booster_update(bst):
+    return int(bool(bst.update()))
+
+
+def booster_current_iteration(bst):
+    return int(bst.current_iteration())
+
+
+def booster_num_classes(bst):
+    return int(bst.num_class)
+
+
+def booster_calc_num_predict(bst, num_row, predict_type, start_iteration,
+                             num_iteration):
+    """(ref: c_api.cpp LGBM_BoosterCalcNumPredict semantics)"""
+    k = max(1, bst.num_tree_per_iteration)
+    total_iter = bst.num_trees() // k
+    if num_iteration <= 0:
+        num_iteration = total_iter - start_iteration
+    num_iteration = max(0, min(num_iteration, total_iter - start_iteration))
+    if predict_type == 2:      # leaf index: one value per tree
+        return int(num_row * num_iteration * k)
+    if predict_type == 3:      # contrib: per feature + bias, per class
+        return int(num_row * k * (bst.num_feature() + 1))
+    return int(num_row * max(1, bst.num_class))
+
+
+def booster_predict_for_mat(bst, ptr, data_type, nrow, ncol, is_row_major,
+                            predict_type, start_iteration, num_iteration,
+                            parameter, out_ptr):
+    arr = _wrap(ptr, nrow * ncol, data_type)
+    X = arr.reshape(nrow, ncol) if is_row_major else \
+        arr.reshape(ncol, nrow).T
+    kwargs = dict(start_iteration=start_iteration,
+                  num_iteration=(num_iteration if num_iteration > 0
+                                 else None))
+    if predict_type == 1:
+        pred = bst.predict(X, raw_score=True, **kwargs)
+    elif predict_type == 2:
+        pred = bst.predict(X, pred_leaf=True, **kwargs)
+    elif predict_type == 3:
+        pred = bst.predict(X, pred_contrib=True, **kwargs)
+    else:
+        pred = bst.predict(X, **kwargs)
+    flat = np.asarray(pred, np.float64).reshape(-1)
+    out = _wrap(out_ptr, flat.size, 1)
+    out[:] = flat
+    return int(flat.size)
+
+
+def booster_save_model(bst, start_iteration, num_iteration,
+                       feature_importance_type, filename):
+    bst.save_model(filename, start_iteration=start_iteration,
+                   num_iteration=num_iteration,
+                   importance_type=("gain" if feature_importance_type == 1
+                                    else "split"))
+    return True

@@ -1,0 +1,289 @@
+// C ABI for lightgbm_tpu — the stable embedding surface.
+//
+// Behavioral analog of the reference's C API core
+// (ref: include/LightGBM/c_api.h, src/c_api.cpp): same symbol names,
+// argument conventions, 0/-1 return codes, and LGBM_GetLastError
+// contract for the subset that covers the train/predict/save/load
+// lifecycle. Where the reference's C API fronts a C++ runtime, this one
+// fronts the in-process Python/JAX runtime: each call enters the
+// interpreter (initializing an embedded one if the host is a plain C
+// program) and delegates to lightgbm_tpu.capi_support, which wraps the
+// raw buffers with numpy without copying.
+//
+// Thread-safety matches the reference's "not thread-safe per handle"
+// stance; calls serialize on the GIL.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() {
+    if (!Py_IsInitialized()) {
+      // pure-C host: bring up an embedded interpreter once, then RELEASE
+      // the GIL the init acquired so other host threads can enter
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+    state = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+PyObject* support() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("lightgbm_tpu.capi_support");
+  }
+  return mod;
+}
+
+int fail_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error = c ? c : "unknown python error";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+// call capi_support.<fn>(args...); returns new ref or nullptr
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* mod = support();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+}  // namespace
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// data_type: 0 = float32 (C_API_DTYPE_FLOAT32), 1 = float64
+LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          void* reference, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(KiiiisO)", (unsigned long long)(uintptr_t)data, data_type,
+      (int)nrow, (int)ncol, is_row_major, parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_mat", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;  // owned handle
+  return 0;
+}
+
+// field_data types: 0 float32, 1 float64, 2 int32, 3 int64
+LGBM_EXPORT int LGBM_DatasetSetField(void* handle, const char* field_name,
+                                     const void* field_data,
+                                     int32_t num_element, int type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OsKii)", (PyObject*)handle, field_name,
+      (unsigned long long)(uintptr_t)field_data, (int)num_element, type);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_set_field", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumData(void* handle, int32_t* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)handle);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_num_data", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumFeature(void* handle, int32_t* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)handle);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_num_feature", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetFree(void* handle) {
+  Gil gil;
+  Py_XDECREF((PyObject*)handle);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCreate(void* train_data, const char* parameters,
+                                   void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", (PyObject*)train_data,
+                                 parameters ? parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("booster_create", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", filename);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_from_modelfile", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  PyObject* h = PyTuple_GetItem(r, 0);
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_INCREF(h);
+  Py_DECREF(r);
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterAddValidData(void* booster, void* valid_data) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)booster,
+                                 (PyObject*)valid_data);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_add_valid", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIter(void* booster, int* is_finished) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_update", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(void* booster, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_current_iteration", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// required out_result length for a prediction call (ref: c_api.h
+// LGBM_BoosterCalcNumPredict) — leaf/contrib outputs are larger than
+// nrow, so callers MUST size buffers with this
+LGBM_EXPORT int LGBM_BoosterCalcNumPredict(void* booster, int num_row,
+                                           int predict_type,
+                                           int start_iteration,
+                                           int num_iteration,
+                                           int64_t* out_len) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiiii)", (PyObject*)booster, num_row,
+                                 predict_type, start_iteration,
+                                 num_iteration);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_calc_num_predict", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// predict_type: 0 normal, 1 raw_score, 2 leaf_index, 3 contrib
+LGBM_EXPORT int LGBM_BoosterPredictForMat(
+    void* booster, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int is_row_major, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiiiiiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)data, data_type, (int)nrow, (int)ncol,
+      is_row_major, predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_mat", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(void* booster, int start_iteration,
+                                      int num_iteration,
+                                      int feature_importance_type,
+                                      const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiiis)", (PyObject*)booster,
+                                 start_iteration, num_iteration,
+                                 feature_importance_type, filename);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_save_model", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(void* booster, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_num_classes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(void* handle) {
+  Gil gil;
+  Py_XDECREF((PyObject*)handle);
+  return 0;
+}

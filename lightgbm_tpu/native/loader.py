@@ -24,21 +24,32 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _compile(src: str, out: str, extra: Tuple[str, ...] = (),
+             fallback_extra: Optional[Tuple[str, ...]] = None,
+             timeout: int = 180) -> str:
+    """mtime-cached g++ compile with an atomic publish: build to a
+    process-unique temp path, then rename, so a concurrent process can
+    never dlopen a half-written .so. Callers serialize same-process
+    builds under _LOCK. Raises on failure."""
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    tmp = f"{out}.{os.getpid()}.{threading.get_ident()}.tmp"
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    r = subprocess.run(base[:-2] + list(extra) + base[-2:],
+                       capture_output=True, timeout=timeout)
+    if r.returncode != 0 and fallback_extra is not None:
+        subprocess.run(base[:-2] + list(fallback_extra) + base[-2:],
+                       check=True, capture_output=True, timeout=timeout)
+    elif r.returncode != 0:
+        raise RuntimeError(r.stderr.decode()[-300:])
+    os.replace(tmp, out)
+    return out
+
+
 def _build() -> Optional[str]:
-    src = os.path.join(_HERE, "parser.cpp")
-    if os.path.exists(_SO_PATH) and \
-            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
-        return _SO_PATH
     try:
-        # build to a process-unique temp path, then atomically rename so a
-        # concurrent process can never dlopen a half-written .so
-        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
-             "-o", tmp],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO_PATH)
-        return _SO_PATH
+        return _compile(os.path.join(_HERE, "parser.cpp"), _SO_PATH)
     except Exception as e:  # no toolchain / sandboxed build dir
         log.warning("native parser build failed (%s); using the slower "
                     "numpy text parser", e)
@@ -213,3 +224,30 @@ def _parse_libsvm_numpy(path: str, n_rows: int, n_feat: int):
                     X[i, k] = float(v)
             i += 1
     return X, y
+
+
+# ---------------------------------------------------------------- C ABI
+_CAPI_SO = os.path.join(_HERE, "libcapi.so")
+
+
+def build_capi() -> Optional[str]:
+    """Compile the LGBM_* C ABI library (capi.cpp). Returns the .so path
+    or None when no toolchain is available. Loaded into a Python host it
+    resolves interpreter symbols from the process; a pure-C host gets
+    them from the linked libpython (falls back to not linking it)."""
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    extra = [f"-I{inc}"]
+    if libdir:
+        extra += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    extra += [f"-lpython{ver}"]
+    try:
+        with _LOCK:
+            return _compile(os.path.join(_HERE, "capi.cpp"), _CAPI_SO,
+                            tuple(extra), fallback_extra=(f"-I{inc}",))
+    except Exception as e:
+        log.warning("C ABI build failed (%s)", e)
+        return None

@@ -1,5 +1,4 @@
-import sys, time
-sys.path.insert(0, "/root/repo")
+import time
 import jax, jax.numpy as jnp
 import numpy as np
 x = jnp.asarray(np.random.RandomState(0).randn(4096, 4096).astype(np.float32)).astype(jnp.bfloat16)

@@ -1,0 +1,47 @@
+#include <stdio.h>
+#include <stdint.h>
+extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
+                                     int, const char*, void*, void**);
+extern int LGBM_DatasetSetField(void*, const char*, const void*, int32_t, int);
+extern int LGBM_BoosterCreate(void*, const char*, void**);
+extern int LGBM_BoosterUpdateOneIter(void*, int*);
+extern int LGBM_BoosterPredictForMat(void*, const void*, int, int32_t,
+                                     int32_t, int, int, int, int,
+                                     const char*, int64_t*, double*);
+int main(void) {
+  enum { N = 400, F = 4 };
+  static float X[N * F], y[N];
+  unsigned s = 12345;
+  for (int i = 0; i < N * F; ++i) {
+    s = 1103515245u * s + 12345u;
+    X[i] = (float)((s >> 16) & 0x7FFF) / 32768.0f;
+  }
+  for (int i = 0; i < N; ++i) y[i] = X[i * F] > 0.5f ? 1.0f : 0.0f;
+  void* ds = 0; void* bst = 0; int fin = 0;
+  if (LGBM_DatasetCreateFromMat(X, 0, N, F, 1, "verbose=-1", 0, &ds)) {
+    printf("ds err: %s\n", LGBM_GetLastError()); return 1;
+  }
+  if (LGBM_DatasetSetField(ds, "label", y, N, 0)) {
+    printf("field err: %s\n", LGBM_GetLastError()); return 1;
+  }
+  if (LGBM_BoosterCreate(ds, "objective=binary num_leaves=7 verbose=-1",
+                         &bst)) {
+    printf("bst err: %s\n", LGBM_GetLastError()); return 1;
+  }
+  for (int i = 0; i < 3; ++i)
+    if (LGBM_BoosterUpdateOneIter(bst, &fin)) {
+      printf("update err: %s\n", LGBM_GetLastError()); return 1;
+    }
+  static double out[N]; int64_t out_len = 0;
+  if (LGBM_BoosterPredictForMat(bst, X, 0, N, F, 1, 0, 0, -1, "",
+                                &out_len, out)) {
+    printf("pred err: %s\n", LGBM_GetLastError()); return 1;
+  }
+  int ok = 0;
+  for (int i = 0; i < N; ++i)
+    ok += ((out[i] > 0.5) == (y[i] > 0.5f));
+  printf("C HOST OK: %lld preds, acc %.3f\n", (long long)out_len,
+         (double)ok / N);
+  return 0;
+}

@@ -1,0 +1,165 @@
+"""The LGBM_* C ABI (native/capi.cpp + capi_support.py).
+
+Drives the compiled shared library through ctypes exactly the way the
+reference's own python-package drives lib_lightgbm (ref:
+python-package/lightgbm/basic.py _LIB usage) — create a dataset from a
+raw float matrix, set the label field, train, predict, save, reload.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.native.loader import build_capi
+
+
+@pytest.fixture(scope="module")
+def lib():
+    path = build_capi()
+    if path is None:
+        pytest.skip("no native toolchain")
+    lib = ctypes.CDLL(path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBM_GetLastError().decode()
+
+
+def test_capi_full_lifecycle(lib, tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(1200, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 0, 1200, 6, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 1200, 0))
+
+    n = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+    assert n.value == 1200
+    _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(n)))
+    assert n.value == 6
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 learning_rate=0.2 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(10):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 10
+    nc = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetNumClasses(bst, ctypes.byref(nc)))
+    assert nc.value == 1
+
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, 1200, 0, 0, -1, ctypes.byref(need)))
+    assert need.value == 1200
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, 1200, 2, 0, -1, ctypes.byref(need)))
+    assert need.value == 1200 * 10      # leaf index: one per tree
+
+    out = np.zeros(1200, np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 0, 1200, 6, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == 1200
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, out) > 0.95
+
+    model_path = str(tmp_path / "capi_model.txt").encode()
+    _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, -1, 0, model_path))
+
+    bst2 = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model_path, ctypes.byref(iters), ctypes.byref(bst2)))
+    assert iters.value == 10
+    out2 = np.zeros(1200, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, X.ctypes.data_as(ctypes.c_void_p), 0, 1200, 6, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert np.array_equal(out, out2)
+
+    # raw-score path differs from probabilities
+    raw = np.zeros(1200, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 0, 1200, 6, 1, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert not np.allclose(raw, out)
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_BoosterFree(bst2))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_capi_error_reporting(lib):
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromMat(
+        None, 0, 0, 0, 1, b"", None, ctypes.byref(ds))
+    assert rc != 0
+    assert len(lib.LGBM_GetLastError()) > 0
+
+
+def test_capi_float64_and_colmajor(lib):
+    rng = np.random.RandomState(3)
+    Xc = np.asfortranarray(rng.rand(300, 4).astype(np.float64))
+    y = (Xc[:, 0] > 0.5).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), 1, 300, 4, 0,
+        b"verbose=-1", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_capi_pure_c_host(lib, tmp_path):
+    """A plain C program (no Python host) linking libcapi + libpython
+    trains and predicts through the ABI via the embedded interpreter."""
+    import shutil
+    import subprocess
+    import sys
+    import sysconfig
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "fixtures", "capi_host.c")
+    exe = str(tmp_path / "capi_host")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    native = os.path.dirname(build_capi())
+    r = subprocess.run(
+        ["gcc", "-O2", src, "-o", exe, f"-L{native}", "-l:libcapi.so",
+         f"-L{libdir}", f"-lpython{ver}", f"-Wl,-rpath,{native}",
+         f"-Wl,-rpath,{libdir}"], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"link failed: {r.stderr[-200:]}")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(here)] + sys.path)
+    out = subprocess.run([exe], capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "C HOST OK" in out.stdout
