@@ -73,6 +73,29 @@ def main() -> None:
 
     X, y = _make_data(n_rows, n_feat)
 
+    # chip-health probe: the tunnel's delivered throughput swings >10x
+    # over hours (PROFILE.md §5) — record it so the headline number can
+    # be read with its error bar
+    try:
+        import jax
+        import jax.numpy as jnp
+        xp = jnp.asarray(np.random.RandomState(1).randn(4096, 4096)
+                         .astype(np.float32)).astype(jnp.bfloat16)
+
+        @jax.jit
+        def _chain(m):
+            for _ in range(8):
+                m = (m @ m) * 1e-3
+            return jnp.sum(m.astype(jnp.float32))
+        float(_chain(xp))
+        t0 = time.perf_counter()
+        float(_chain(xp))
+        tfs = 8 * 2 * 4096 ** 3 / (time.perf_counter() - t0) / 1e12
+        print(f"chip probe: {tfs:.1f} TF/s (chained bf16 4096^3 matmul; "
+              f"v5e spec 197)", file=sys.stderr)
+    except Exception:
+        pass
+
     sec_per_iter = None
     for engine in ("fused", "frontier", "xla"):
         try:
