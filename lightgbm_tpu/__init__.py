@@ -12,7 +12,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .engine import CVBooster, cv, train
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
-from .utils.log import register_logger
+from .utils.log import LightGBMError, register_logger
 
 try:  # plotting needs matplotlib (optional)
     from .plotting import (create_tree_digraph, plot_importance, plot_metric,
@@ -30,4 +30,5 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "register_logger",
+    "LightGBMError",
 ] + _PLOT

@@ -42,7 +42,9 @@ def feature_meta_from_dataset(ds: TpuDataset) -> FeatureMeta:
         missing_type=jnp.asarray(ds.missing_types),
         default_bin=jnp.asarray(default_bins),
         monotone=jnp.asarray(mono),
-        is_cat=jnp.asarray(ds.is_categorical[ds.used_features]))
+        # already per-USED-feature (unlike monotone_constraints, which the
+        # user supplies per original column)
+        is_cat=jnp.asarray(ds.is_categorical))
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -119,6 +121,12 @@ class GBDT:
         self._fast_step_fn = None
         self._fast_ok_cache = None
         self._stopped_early = False
+        # distribution axis (ref: tree_learner.cpp:17-49 factory matrix)
+        self.parallel_mode = "serial"
+        self.mesh = None
+        self.n_shards = 1
+        self.axis_name = None
+        self._par_fns: Dict[str, object] = {}
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TpuDataset, objective,
@@ -137,8 +145,7 @@ class GBDT:
         self.max_bins = int(train_data.max_num_bin)
         self.params = split_params_from_config(config)
         self.meta = feature_meta_from_dataset(train_data)
-        self.has_cat = bool(np.any(
-            train_data.is_categorical[train_data.used_features]))
+        self.has_cat = bool(np.any(train_data.is_categorical))
         self.use_mono_bounds = bool(np.any(np.asarray(self.meta.monotone)
                                            != 0))
         self._setup_cegb(config)
@@ -160,10 +167,13 @@ class GBDT:
             self.node_masks = make_node_mask_cfg(
                 train_data.num_features, inner_ic, bynode,
                 int(config.feature_fraction_seed) + 12345)
-        self.bins_dev = jnp.asarray(train_data.bins)
+        # lazy: the parallel XLA path holds a SHARDED copy (bins_par) and
+        # only rollback/stop-subtract/DART replay need this replicated one
+        self._bins_dev = None
         # the fused/Pallas paths are the TPU throughput modes; leafwise is
         # the exact reference-parity mode (and the CPU default)
         self.on_tpu = jax.default_backend() == "tpu"
+        self._setup_parallel(config)
         self._setup_engine(config)
 
         md = train_data.metadata
@@ -216,6 +226,12 @@ class GBDT:
         self.es_first_metric_only = bool(config.first_metric_only)
 
 
+
+    @property
+    def bins_dev(self):
+        if self._bins_dev is None:
+            self._bins_dev = jnp.asarray(self.train_data.bins)
+        return self._bins_dev
 
     # ------------------------------------------------------------------
     def _setup_bundles(self, config: Config, train_data) -> None:
@@ -317,7 +333,7 @@ class GBDT:
                 log.warning("forced split on filtered feature %d skipped",
                             real_f)
                 continue
-            if bool(train_data.is_categorical[real_f]):
+            if bool(train_data.is_categorical[inner]):
                 log.fatal("forced splits on categorical features are not "
                           "supported (feature %d)", real_f)
             m = train_data.mappers[real_f]
@@ -366,6 +382,290 @@ class GBDT:
                         "ignoring the lazy per-row penalties")
 
     # ------------------------------------------------------------------
+    def _setup_parallel(self, config: Config) -> None:
+        """Distribution axis of the learner factory (ref:
+        src/treelearner/tree_learner.cpp:17-49 — the learner_type x
+        device_type composition matrix). ``tree_learner=data|voting|
+        feature`` makes every tree grow through shard_map over a named
+        device mesh so ``lgb.train()`` works unchanged across the chips
+        (SURVEY.md north star):
+
+        - data: rows sharded, per-level histogram psum, split decisions
+          replicated by construction (ref:
+          data_parallel_tree_learner.cpp:126-276);
+        - voting: rows sharded, per-level top-k vote caps the exchanged
+          histogram columns (ref: voting_parallel_tree_learner.cpp:151-184);
+        - feature: columns sharded, zero histogram traffic, per-level
+          best-split record merge (ref:
+          feature_parallel_tree_learner.cpp:60-77).
+
+        Combinations the distributed growers don't implement degrade to
+        data-parallel (still distributed, same trees) with a warning.
+        """
+        self.parallel_mode = "serial"
+        self.mesh = None
+        self.n_shards = 1
+        self.axis_name = None
+        self._par_fns = {}
+        if not bool(getattr(config, "is_parallel", False)):
+            return
+        mode = str(config.tree_learner)
+        n_dev = jax.device_count()
+        if n_dev < 2:
+            log.warning(
+                "tree_learner=%s requested but only one device is visible; "
+                "training serially (multi-chip needs a TPU slice or "
+                "XLA_FLAGS=--xla_force_host_platform_device_count)", mode)
+            return
+        if mode == "feature" and (self.use_node_masks
+                                  or getattr(self, "use_cegb", False)
+                                  or getattr(self, "n_forced", 0)
+                                  or getattr(self, "use_bundles", False)):
+            log.warning("tree_learner=feature does not compose with "
+                        "interaction/bynode constraints, CEGB, forced "
+                        "splits or EFB; using data-parallel")
+            mode = "data"
+        if mode == "voting" and self.has_cat:
+            # the vote ranks numerical gains only; categorical columns
+            # would never win — degrade rather than silently mistrain
+            log.warning("voting-parallel does not rank categorical splits; "
+                        "using data-parallel")
+            mode = "data"
+        if mode == "voting" and getattr(self, "n_forced", 0):
+            log.warning("forced splits use the leaf-wise grower; "
+                        "voting-parallel is depth-wise — using data-parallel")
+            mode = "data"
+        from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
+        axis = FEATURE_AXIS if mode == "feature" else DATA_AXIS
+        self.mesh = make_mesh(axis_name=axis)
+        self.axis_name = axis
+        self.n_shards = n_dev
+        self.parallel_mode = mode
+        n = self.num_data
+        # device placement is LAZY (_place_par_data): the fused engine
+        # reads only its own sharded fused_bins_T — materialising a second
+        # padded copy of the binned matrix would waste O(dataset) HBM on
+        # the flagship path
+        self._par_placed = False
+        self.bins_par = None
+        self.bundle_bins_par = None
+        if mode in ("data", "voting"):
+            self.par_rows = ((n + n_dev - 1) // n_dev) * n_dev
+        else:
+            # feature mode: rows replicated, columns padded so every shard
+            # owns an equal slice; pad features are trivial + masked off
+            F = self.train_data.num_features
+            self.par_feats = ((F + n_dev - 1) // n_dev) * n_dev
+            padF = self.par_feats - F
+
+            def padv(a, fill=0):
+                a = np.asarray(a)
+                return jnp.asarray(np.pad(a, (0, padF),
+                                          constant_values=fill))
+            self.par_meta = FeatureMeta(
+                num_bin=padv(self.meta.num_bin, 2),
+                missing_type=padv(self.meta.missing_type),
+                default_bin=padv(self.meta.default_bin),
+                monotone=padv(self.meta.monotone),
+                is_cat=jnp.asarray(np.pad(
+                    np.asarray(self.meta.is_cat), (0, padF))))
+        log.info("Using %s-parallel tree learner over %d devices", mode,
+                 n_dev)
+
+    def _place_par_data(self) -> None:
+        """Mesh placement of the binned matrix for the XLA parallel
+        growers, deferred to first use (the fused engine never needs it)."""
+        if self._par_placed:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        axis = self.axis_name
+        bins_np = np.asarray(self.train_data.bins)
+        if self.parallel_mode in ("data", "voting"):
+            pad = self.par_rows - self.num_data
+            if pad:
+                bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
+            self.bins_par = jax.device_put(
+                bins_np, NamedSharding(self.mesh, P(axis, None)))
+            if getattr(self, "use_bundles", False):
+                bb = np.asarray(self.bundle_bins_dev)
+                if pad:
+                    bb = np.pad(bb, ((0, pad), (0, 0)))
+                self.bundle_bins_par = jax.device_put(
+                    bb, NamedSharding(self.mesh, P(axis, None)))
+        else:
+            padF = self.par_feats - self.train_data.num_features
+            if padF:
+                bins_np = np.pad(bins_np, ((0, 0), (0, padF)))
+            self.bins_par = jax.device_put(
+                bins_np, NamedSharding(self.mesh, P()))
+        self._par_placed = True
+
+    def _get_par_fn(self, kind: str):
+        fn = self._par_fns.get(kind)
+        if fn is None:
+            fn = self._build_par_fn(kind)
+            self._par_fns[kind] = fn
+        return fn
+
+    def _build_par_fn(self, kind: str):
+        """shard_map-wrapped jitted tree growth for the sync path. The
+        small per-tree state (meta, params, bundle tables) rides as
+        closures — replicated constants; the O(rows) operands are
+        explicit sharded arguments."""
+        from jax.sharding import PartitionSpec as P
+        axis = self.axis_name
+        params = self.params
+        L, B = self.max_leaves, self.max_bins
+        md = int(self.config.max_depth)
+        if kind == "fused_sync":
+            from ..models.frontier2 import grow_tree_fused
+            interp = self.fused_interpret
+            use_nm = self.use_node_masks
+
+            def per_shard(bins_T, gh_T, fm_pad, *nm):
+                return grow_tree_fused(
+                    bins_T, gh_T, self.fused_meta, fm_pad, params, L,
+                    self.fused_Bp, self.fused_f_oh, num_rows=0,
+                    nch=self.fused_nch, max_depth=md,
+                    extra_levels=int(self.config.tpu_extra_levels),
+                    has_cat=self.has_cat,
+                    use_mono_bounds=self.use_mono_bounds,
+                    use_node_masks=use_nm,
+                    node_masks=nm[0] if use_nm else None,
+                    bundle_cols=self.fused_bundle_cols,
+                    bundle_col_bins=self.fused_bundle_col_bins,
+                    bundle_cfg=self.fused_bundle_cfg,
+                    interpret=interp, psum_axis=axis)
+            in_specs = (P(None, axis), P(None, axis), P()) + \
+                ((P(),) if use_nm else ())
+            return jax.jit(jax.shard_map(
+                per_shard, mesh=self.mesh, in_specs=in_specs,
+                out_specs=(P(), P(axis)), check_vma=False))
+
+        if kind == "xla_sync":
+            mode = self.parallel_mode
+            grow = (grow_tree_leafwise if self.grow_policy == "leafwise"
+                    and mode == "data" else grow_tree_depthwise)
+            hist_impl = self._xla_hist_impl()
+            use_nm = self.use_node_masks
+            use_cegb = self.use_cegb
+            ub = getattr(self, "use_bundles", False)
+            n_forced = getattr(self, "n_forced", 0) if mode == "data" else 0
+
+            if mode == "feature":
+                n_sh = self.n_shards
+                Fp = self.par_feats
+                Fs = Fp // n_sh
+
+                def per_shard(bins_full, gh, fm_pad):
+                    sid = jax.lax.axis_index(axis)
+                    f0 = sid * Fs
+                    bins_loc = jax.lax.dynamic_slice_in_dim(
+                        bins_full, f0, Fs, axis=1)
+                    sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, f0, Fs, axis=0)
+                    meta_loc = FeatureMeta(
+                        num_bin=sl(self.par_meta.num_bin),
+                        missing_type=sl(self.par_meta.missing_type),
+                        default_bin=sl(self.par_meta.default_bin),
+                        monotone=sl(self.par_meta.monotone),
+                        is_cat=sl(self.par_meta.is_cat))
+                    return grow_tree_depthwise(
+                        bins_loc, gh, meta_loc, sl(fm_pad), params, L, B,
+                        md, hist_impl=hist_impl, psum_axis=axis,
+                        has_cat=self.has_cat, parallel_mode="feature",
+                        route_bins=bins_full, route_meta=self.par_meta,
+                        feature_offset=f0,
+                        use_mono_bounds=self.use_mono_bounds)
+                return jax.jit(jax.shard_map(
+                    per_shard, mesh=self.mesh, in_specs=(P(), P(), P()),
+                    out_specs=(P(), P()), check_vma=False))
+
+            kw = {}
+            if mode == "voting":
+                kw.update(parallel_mode="voting",
+                          top_k=int(self.config.top_k))
+            else:
+                kw.update(parallel_mode="data")
+            if ub:
+                kw.update(use_bundles=True, bundle_cfg=self.bundle_cfg,
+                          bundle_col_bins=self.bundle_col_bins)
+            if grow is grow_tree_leafwise:
+                kw = {k: v for k, v in kw.items()
+                      if k not in ("parallel_mode", "top_k", "use_bundles",
+                                   "bundle_cfg", "bundle_col_bins")}
+                if n_forced:
+                    kw.update(n_forced=n_forced,
+                              forced_leaf=self.forced_leaf,
+                              forced_feat=self.forced_feat,
+                              forced_thr=self.forced_thr)
+
+            def per_shard(bins, gh, fm, *extra):
+                i = 0
+                nm = None
+                if use_nm:
+                    nm = extra[i]
+                    i += 1
+                kw2 = dict(kw)
+                if use_cegb:
+                    kw2.update(use_cegb=True,
+                               cegb_coupled=self.cegb_coupled,
+                               cegb_used=extra[i])
+                    i += 1
+                return grow(bins, gh, self.meta, fm, params, L, B, md,
+                            hist_impl=hist_impl, psum_axis=axis,
+                            has_cat=self.has_cat,
+                            use_mono_bounds=self.use_mono_bounds,
+                            use_node_masks=use_nm, node_masks=nm, **kw2)
+            in_specs = (P(axis, None), P(axis, None), P()) \
+                + ((P(),) if use_nm else ()) \
+                + ((P(),) if use_cegb else ())
+            return jax.jit(jax.shard_map(
+                per_shard, mesh=self.mesh, in_specs=in_specs,
+                out_specs=(P(), P(axis)), check_vma=False))
+        raise KeyError(kind)
+
+    def _grow_parallel(self, gh):
+        """Sync-path tree growth through the mesh (driver semantics of
+        ref: data_parallel_tree_learner.cpp:126-276 — local histograms,
+        global sums, replicated split decisions). ``gh`` is [n, 3]
+        (grad*w, hess*w, w); pad rows carry zero weight so they never
+        contribute to histograms or counts."""
+        n = self.num_data
+        fm = self._feature_mask()
+        extra = []
+        if self.use_node_masks:
+            extra.append(self._node_masks_padded() if self.use_fused
+                         else self._node_masks_for_iter())
+        if self.use_fused:
+            from ..ops.fused_level import pack_gh
+            pad = self.fused_Rp - n
+            gh_T = pack_gh(jnp.pad(gh[:, 0], (0, pad)),
+                           jnp.pad(gh[:, 1], (0, pad)),
+                           jnp.pad(gh[:, 2], (0, pad)), self.fused_nch)
+            fm_pad = jnp.zeros((self.fused_f_oh,), bool) \
+                .at[:fm.shape[0]].set(fm)
+            fn = self._get_par_fn("fused_sync")
+            tree, row_leaf = fn(self.fused_bins_T, gh_T, fm_pad, *extra)
+            return tree, row_leaf[:n]
+        if self.use_cegb:
+            extra.append(jnp.asarray(self.cegb_used))
+        self._place_par_data()
+        if self.parallel_mode == "feature":
+            Fp = self.par_feats
+            fm_pad = jnp.zeros((Fp,), bool).at[:fm.shape[0]].set(fm)
+            fn = self._get_par_fn("xla_sync")
+            tree, row_leaf = fn(self.bins_par, gh, fm_pad, *extra)
+            return tree, row_leaf
+        pad = self.par_rows - n
+        gh_p = jnp.pad(gh, ((0, pad), (0, 0)))
+        bins = (self.bundle_bins_par if getattr(self, "use_bundles", False)
+                else self.bins_par)
+        fn = self._get_par_fn("xla_sync")
+        tree, row_leaf = fn(bins, gh_p, fm, *extra)
+        return tree, row_leaf[:n]
+
+    # ------------------------------------------------------------------
     def _setup_engine(self, config: Config) -> None:
         """Resolve tpu_engine/grow_policy into the learner flags (called by
         init and again by reset_config so reset_parameter can switch
@@ -374,9 +674,20 @@ class GBDT:
         self._fast_step_fn = None     # engine/params changed: re-derive
         self._fast_ok_cache = None
         self._fast_fm_pads = None
+        self._par_fns = {}            # parallel growers close over params
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
+        if self.parallel_mode in ("voting", "feature") and engine != "xla":
+            # the vote/column-slice exchanges live in the depthwise XLA
+            # grower (ref: voting/feature_parallel_tree_learner.cpp)
+            log.info("tree_learner=%s runs on the depthwise XLA grower",
+                     self.parallel_mode)
+            engine = "xla"
+        if self.parallel_mode == "data" and engine == "frontier":
+            log.info("the frontier-v1 engine has no multi-chip path; "
+                     "using the fused engine")
+            engine = "fused"
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
             engine = "xla"
@@ -412,6 +723,11 @@ class GBDT:
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
                                                         config.grow_policy)
+        if self.parallel_mode in ("voting", "feature") \
+                and self.grow_policy != "depthwise":
+            log.warning("tree_learner=%s is implemented on the depthwise "
+                        "grower; switching grow_policy", self.parallel_mode)
+            self.grow_policy = "depthwise"
         if getattr(self, "use_cegb", False) \
                 and self.grow_policy != "depthwise":
             log.warning("CEGB is implemented on the depthwise grower; "
@@ -439,7 +755,11 @@ class GBDT:
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
         if self.use_fused:
-            if not hasattr(self, "fused_bins_T"):
+            if not hasattr(self, "fused_bins_T") \
+                    or getattr(self, "_fused_built_mode", None) \
+                    != self.parallel_mode:
+                # (re)build: the row padding and mesh placement of the
+                # transposed matrix depend on the parallel mode
                 self._init_fused(self.train_data)
             else:
                 from ..ops.fused_level import NCH_FAST, NCH_PRECISE
@@ -459,7 +779,9 @@ class GBDT:
         F = train_data.num_features
         F_oh, Bp = feature_layout(F, self.max_bins)
         R = self.num_data
-        Rp = ((R + 1023) // 1024) * 1024
+        # data-parallel shards each need kernel-tile-aligned local rows
+        blk = 1024 * (self.n_shards if self.parallel_mode == "data" else 1)
+        Rp = ((R + blk - 1) // blk) * blk
         if getattr(self, "use_bundles", False):
             n_cols = int(self.bundle_bins_dev.shape[1])
             C_oh, Bc_p = feature_layout(n_cols, self.bundle_col_bins)
@@ -509,9 +831,16 @@ class GBDT:
             self.fused_bundle_cols = 0
             self.fused_bundle_col_bins = 0
             self.fused_bundle_cfg = None
+        if self.parallel_mode == "data":
+            # place the transposed matrix row-sharded once, not per call
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.fused_bins_T = jax.device_put(
+                self.fused_bins_T,
+                NamedSharding(self.mesh, P(None, self.axis_name)))
         self.fused_f_oh = F_oh
         self.fused_Bp = Bp
         self.fused_Rp = Rp
+        self._fused_built_mode = self.parallel_mode
         self.fused_nch = (NCH_FAST if self.config.tpu_hist_precision == "bf16"
                           else NCH_PRECISE)
         nb = np.zeros(F_oh, np.int32)
@@ -701,6 +1030,8 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _grow(self, gh):
+        if self.parallel_mode != "serial":
+            return self._grow_parallel(gh)
         fm = self._feature_mask()
         if self.use_fused:
             from ..models.frontier2 import grow_tree_fused
@@ -937,11 +1268,13 @@ class GBDT:
         in_bag = np.asarray(self.bag_weight) > 0
         lam = float(self.config.linear_lambda)
         paths = ht.branch_features()
-        is_cat = self.train_data.is_categorical
+        is_cat = self.train_data.is_categorical   # per USED feature
         for leaf in range(L):
+            # paths[] carry inner indices; filter on those BEFORE mapping
+            # to the real column ids the raw matrix is indexed by
+            inner_feats = [f for f in paths[leaf] if not is_cat[f]]
             feats = [self.train_data.real_feature_index(f)
-                     for f in paths[leaf]]
-            feats = [f for f in feats if not is_cat[f]]
+                     for f in inner_feats]
             if not feats:
                 continue
             rows = np.nonzero((row_leaf == leaf) & in_bag)[0]
@@ -1009,6 +1342,7 @@ class GBDT:
             self._fast_ok_cache = bool(
                 type(self) is GBDT
                 and self.use_fused
+                and self.parallel_mode in ("serial", "data")
                 and obj is not None
                 and not obj.is_renew_tree_output
                 and not bool(self.config.linear_tree)
@@ -1033,6 +1367,38 @@ class GBDT:
         extra = int(self.config.tpu_extra_levels)
         interp = self.fused_interpret
 
+        # data-parallel: the grow + leaf-value lookup run inside a
+        # shard_map region (rows sharded, per-level histogram psum inside
+        # grow_tree_fused); the [L]-sized tree comes out replicated, the
+        # per-row delta row-sharded (ref composition:
+        # data_parallel_tree_learner.cpp:185 reduces the FAST engine's
+        # histograms — the flagship kernel stays in play on the mesh)
+        par = self.parallel_mode == "data"
+        if par:
+            from jax.sharding import PartitionSpec as P
+            axis = self.axis_name
+
+            def grow_one(bins_T, gh_T, fm_pad):
+                tree, row_leaf = grow_tree_fused(
+                    bins_T, gh_T, self.fused_meta, fm_pad,
+                    self.params, self.max_leaves, self.fused_Bp,
+                    self.fused_f_oh, num_rows=0, nch=self.fused_nch,
+                    max_depth=max_depth, extra_levels=extra,
+                    has_cat=self.has_cat,
+                    use_mono_bounds=self.use_mono_bounds,
+                    bundle_cols=self.fused_bundle_cols,
+                    bundle_col_bins=self.fused_bundle_col_bins,
+                    bundle_cfg=self.fused_bundle_cfg,
+                    interpret=interp, psum_axis=axis)
+                delta = table_lookup(row_leaf[None, :],
+                                     tree.leaf_value * shrink,
+                                     interpret=interp)[0]
+                return tree, delta
+            grow_one_sharded = jax.shard_map(
+                grow_one, mesh=self.mesh,
+                in_specs=(P(None, axis), P(None, axis), P()),
+                out_specs=(P(), P(axis)), check_vma=False)
+
         # bins_T/gradient operands are ARGUMENTS, not closures: a
         # closed-over device array of O(rows) size would be embedded in
         # the lowered program as a constant (bins alone: 336 MB of HLO at
@@ -1051,20 +1417,25 @@ class GBDT:
                     jnp.pad(grad[tid] * bag_weight, (0, pad)),
                     jnp.pad(hess[tid] * bag_weight, (0, pad)),
                     jnp.pad(bag_weight, (0, pad)), self.fused_nch)
-                tree, row_leaf = grow_tree_fused(
-                    bins_T, gh_T, self.fused_meta, fm_pads[tid],
-                    self.params, self.max_leaves, self.fused_Bp,
-                    self.fused_f_oh, num_rows=n, nch=self.fused_nch,
-                    max_depth=max_depth, extra_levels=extra,
-                    has_cat=self.has_cat,
-                    use_mono_bounds=self.use_mono_bounds,
-                    bundle_cols=self.fused_bundle_cols,
-                    bundle_col_bins=self.fused_bundle_col_bins,
-                    bundle_cfg=self.fused_bundle_cfg,
-                    interpret=interp)
-                delta = table_lookup(row_leaf[None, :],
-                                     tree.leaf_value * shrink,
-                                     interpret=interp)[0, :n]
+                if par:
+                    tree, delta = grow_one_sharded(bins_T, gh_T,
+                                                   fm_pads[tid])
+                    delta = delta[:n]
+                else:
+                    tree, row_leaf = grow_tree_fused(
+                        bins_T, gh_T, self.fused_meta, fm_pads[tid],
+                        self.params, self.max_leaves, self.fused_Bp,
+                        self.fused_f_oh, num_rows=n, nch=self.fused_nch,
+                        max_depth=max_depth, extra_levels=extra,
+                        has_cat=self.has_cat,
+                        use_mono_bounds=self.use_mono_bounds,
+                        bundle_cols=self.fused_bundle_cols,
+                        bundle_col_bins=self.fused_bundle_col_bins,
+                        bundle_cfg=self.fused_bundle_cfg,
+                        interpret=interp)
+                    delta = table_lookup(row_leaf[None, :],
+                                         tree.leaf_value * shrink,
+                                         interpret=interp)[0, :n]
                 # a dried-up class (no split found) contributes NOTHING:
                 # the sync path appends a zero constant tree for it
                 # (gbdt.cpp:421-437 beyond the first iteration) and keeps
@@ -1310,7 +1681,12 @@ class GBDT:
                     self.device_trees.append(dt)
                     continue
                 lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
-                if self.use_fused:
+                if self.parallel_mode != "serial":
+                    # sharded row_leaf: plain sharded gather (the pallas
+                    # lookup kernel is not SPMD-partitionable from outside
+                    # a shard_map region)
+                    delta = lv_dev[row_leaf]
+                elif self.use_fused:
                     # per-row gathers are slow on TPU; streaming lookup
                     from ..ops.fused_level import table_lookup
                     delta = table_lookup(row_leaf[None, :], lv_dev,
@@ -1374,6 +1750,11 @@ class GBDT:
         self._stopped_early = False   # a relaxed config may split again
         self._setup_cegb(config)
         self._setup_forced_splits(config, self.train_data)
+        # mode-compatibility guards must re-fire: a reset can enable CEGB/
+        # forced splits under tree_learner=feature|voting, which degrades
+        # the mode to data-parallel (the cached shard_map signatures and
+        # data placement change with it)
+        self._setup_parallel(config)
         self._setup_engine(config)
         n = self.num_data
         self.is_bagging = False

@@ -187,8 +187,13 @@ class TpuDataset:
 
         self.used_features = [j for j in range(f) if not self.mappers[j].is_trivial]
         if not self.used_features:
-            log.fatal("cannot construct Dataset: all features are trivial "
-                      "(constant or filtered)")
+            # the reference keeps going and trains constant trees
+            # (ref: src/io/dataset.cpp:336)
+            log.warning("There are no meaningful features which satisfy "
+                        "the provided configuration. Decrease Dataset "
+                        "parameters min_data_in_bin or min_data_in_leaf "
+                        "and re-construct Dataset might resolve this "
+                        "warning.")
         self._finalize_feature_arrays()
         self._push_data(data)
         if config.monotone_constraints:

@@ -110,7 +110,7 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
     static_argnames=("params", "num_leaves", "max_bins", "f_oh", "num_rows",
                      "nch", "max_depth", "extra_levels", "has_cat",
                      "use_mono_bounds", "use_node_masks", "interpret",
-                     "bundle_cols", "bundle_col_bins"))
+                     "bundle_cols", "bundle_col_bins", "psum_axis"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -120,6 +120,7 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     use_node_masks: bool = False, node_masks=None,
                     bundle_cols: int = 0, bundle_col_bins: int = 0,
                     bundle_cfg=None, interpret: bool = False,
+                    psum_axis: str = None,
                     ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with fused level passes.
 
@@ -140,6 +141,19 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
       bundle_cols/bundle_col_bins: kernel layout when the matrix holds EFB
         bundle columns (0 = unbundled); ``bundle_cfg`` is the
         models.learner.BundleCfg decode table plus meta.most-freq bins.
+      psum_axis: when set (running under shard_map over a row-sharded
+        mesh), every level histogram — ONE packed [FB, nch*Sp] f32 tensor
+        per level — is allreduced over that mesh axis before the split
+        scan, so all shards see GLOBAL sums and make identical split
+        decisions; routing stays shard-local. This is the fused-engine
+        analog of the reference's fast-path histogram reduction
+        (ref: src/treelearner/data_parallel_tree_learner.cpp:185 — the
+        GPU learner's histograms are what gets reduce-scattered, not a
+        slow stand-in's). The hi/lo channel decode is linear, so psum
+        before hist_planes preserves fp32-grade precision. Under
+        psum_axis the caller passes ``num_rows=0`` and marks its local
+        padding rows with zero gh weight instead (the global "real row"
+        prefix has no meaning inside a shard).
 
     Returns (TreeArrays, row_leaf [Rp] int32 — caller slices to R; padding
     rows stay at -1).
@@ -177,6 +191,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
                           num_bins=k_B, f_oh=k_foh, nch=nch,
                           interpret=interpret)
+    if psum_axis is not None:
+        hist0 = jax.lax.psum(hist0, psum_axis)
     g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
     if use_bundles:
         v = bundle_plane_views(jnp.stack([g0, h0, c0], axis=-1),
@@ -223,7 +239,7 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                            use_mono_bounds, use_node_masks, node_masks,
                            li + 1, li == len(caps) - 1,
                            bundle_cols, bundle_col_bins, bundle_cfg,
-                           interpret)
+                           interpret, psum_axis)
     tree, leaf_T = state[0], state[1]
     return tree, leaf_T[0]
 
@@ -231,7 +247,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
 def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                S_d, nch, max_depth, has_cat, use_mono_bounds,
                use_node_masks, node_masks, fold, is_last,
-               bundle_cols, bundle_col_bins, bundle_cfg, interpret):
+               bundle_cols, bundle_col_bins, bundle_cfg, interpret,
+               psum_axis=None):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
      leaf_lo, leaf_hi, leaf_groups) = state
     use_bundles = bundle_cols > 0
@@ -313,6 +330,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             hist, leaf_T2 = level_pass(
                 bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=k_B,
                 f_oh=k_foh, nch=nch, interpret=interpret)
+            if psum_axis is not None:
+                hist = jax.lax.psum(hist, psum_axis)
             sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh, k_B)
             if use_bundles:
                 v = bundle_plane_views(

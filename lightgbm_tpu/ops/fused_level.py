@@ -66,17 +66,21 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
-VMEM_BUDGET = 9 * 1024 * 1024  # oh scratch + accumulator; leaves room
-# for W/ghs/D values and pipeline buffers under the ~16 MB VMEM
+VMEM_BUDGET = 15 * 1024 * 1024  # scoped-vmem stack limit is 16 MB; leave
+# headroom for W/ghs/D values and the pipeline's operand double buffers
 
 
 def default_tile_rows(Sp: int, FB: int, nch: int) -> int:
-    """Row-tile width: the [FB, C] bf16 one-hot scratch (2*FB*C bytes,
-    double-buffer-free) plus the [FB, nch*Sp] f32 accumulator must fit the
-    VMEM budget."""
+    """Row-tile width: the [FB, C] bf16 one-hot scratch (2 B/elem), the
+    [FB, C] i32 repeated-bins intermediate (4 B/elem — Mosaic on this
+    target only compiles i32 compares, so the unpack cannot stay in the
+    narrow native dtype) and the [FB, nch*Sp] f32 accumulator must fit the
+    scoped-VMEM stack together. Round 2's formula ignored the i32
+    intermediate and a 255-bin config exceeded the 16 MB stack limit —
+    caught on-chip in round 3."""
     acc = FB * nch * Sp * 4
     avail = max(VMEM_BUDGET - acc, 2 * 1024 * 1024)
-    c = avail // (2 * FB)
+    c = avail // ((2 + 4) * FB)
     c = 1 << max(7, (int(c)).bit_length() - 1)      # floor to pow2, >= 128
     return int(min(1024, c))
 
@@ -264,7 +268,9 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
     FB = F_oh * B
 
     # ---- bin one-hot [FB, C]: bulk int8->int32 unpack once, sublane
-    # repeat, one compare (measured fastest variant; see PROFILE.md)
+    # repeat, one compare (i32 is the only compare dtype Mosaic compiles
+    # on this target; its 4 B/elem VMEM cost is charged in
+    # default_tile_rows)
     bins_val = bins_ref[:].astype(jnp.int32)                   # [Fp, C]
     big = jnp.repeat(bins_val[:F_oh], B, axis=0)               # [FB, C]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B

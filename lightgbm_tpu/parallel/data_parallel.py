@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from ..models.learner import (FeatureMeta, grow_tree_depthwise,
                               grow_tree_leafwise)
@@ -53,11 +52,11 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                         "forced_thr": forced_thr}
                        if policy == "leafwise" and n_forced else {}))
 
-    sharded = shard_map(
+    sharded = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
         out_specs=(P(), P(axis_name)),
-        check_rep=False)
+        check_vma=False)
     return jax.jit(sharded)
 
 
@@ -105,10 +104,10 @@ def train_step_data_parallel(mesh: Mesh, params: SplitParams,
         new_score = score + 0.1 * tree.leaf_value[row_leaf]
         return new_score, tree
 
-    sharded = shard_map(
+    sharded = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
                   P(axis_name), P(), P()),
         out_specs=(P(axis_name), P()),
-        check_rep=False)
+        check_vma=False)
     return jax.jit(sharded)

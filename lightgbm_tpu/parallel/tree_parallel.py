@@ -25,7 +25,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from ..models.learner import FeatureMeta, grow_tree_depthwise
 from ..models.tree import TreeArrays
@@ -73,11 +72,11 @@ def make_feature_parallel_grow_fn(mesh: Mesh, params: SplitParams,
             has_cat=has_cat, parallel_mode="feature",
             route_bins=bins_full, route_meta=meta, feature_offset=f0)
 
-    sharded = shard_map(
+    sharded = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=(P(), P()),
-        check_rep=False)
+        check_vma=False)
     return jax.jit(sharded)
 
 
@@ -94,9 +93,9 @@ def make_voting_parallel_grow_fn(mesh: Mesh, params: SplitParams,
             max_depth, hist_impl=hist_impl, psum_axis=axis_name,
             parallel_mode="voting", top_k=top_k)
 
-    sharded = shard_map(
+    sharded = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
         out_specs=(P(), P(axis_name)),
-        check_rep=False)
+        check_vma=False)
     return jax.jit(sharded)
