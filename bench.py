@@ -51,12 +51,90 @@ def _run(engine: str, X, y, n_iters: int):
         jax.block_until_ready(g.scores)
 
     booster.update()  # warmup: compile + first tree
+    booster.update()  # second iter compiles the epilogue CONT step
     settle()
     t0 = time.perf_counter()
     for _ in range(n_iters):
         booster.update()
     settle()
     return (time.perf_counter() - t0) / n_iters
+
+
+def _quality_leg(engine: str) -> dict:
+    """Differential AUC vs the rebuilt reference CPU package on identical
+    data + params (VERDICT r2 #4: the bf16 hi/lo histogram precision claim
+    needs a quality number at scale, not a 0.005-tolerance fixture).
+    Ref contract being matched: docs/GPU-Performance.rst:136 — the fp32-
+    histogram GPU build holds AUC to ~5e-4 of the CPU build on Higgs."""
+    import lightgbm_tpu as lgb
+    from sklearn.metrics import roc_auc_score
+
+    n_train = int(os.environ.get("BENCH_QUALITY_ROWS", 1_000_000))
+    n_test = max(100_000, n_train // 5)
+    iters = int(os.environ.get("BENCH_QUALITY_ITERS", 500))
+    rng = np.random.RandomState(7)
+    n_feat = 28
+    X = rng.rand(n_train + n_test, n_feat).astype(np.float32)
+    w = rng.randn(n_feat).astype(np.float32)
+    # interactions make the trees matter; noise keeps AUC off the ceiling
+    margin = X @ w + 0.9 * X[:, 0] * X[:, 1] - 0.9 * X[:, 2] * X[:, 3]
+    y = (margin + 0.8 * rng.randn(len(X)) > np.median(margin)) \
+        .astype(np.float32)
+    Xtr, ytr = X[:n_train], y[:n_train]
+    Xte, yte = X[n_train:], y[n_train:]
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
+              "learning_rate": 0.1, "num_iterations": iters,
+              "verbose": -1, "metric": "None"}
+
+    ds = lgb.Dataset(Xtr, label=ytr, params={"max_bin": 63, "verbose": -1})
+    bst = None
+    # the quality claim is about the fused engine's bf16 hi/lo histograms
+    # — prefer it even when the perf leg degraded to another engine
+    for eng in dict.fromkeys(["fused", engine, "xla"]):
+        for attempt in range(2):
+            try:
+                bst = lgb.train(dict(params, tpu_engine=eng), ds)
+                break
+            except Exception as e:
+                print(f"quality engine {eng} attempt {attempt} failed: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+        if bst is not None:
+            break
+    if bst is None:
+        raise RuntimeError("quality leg: every engine failed to train")
+    auc = float(roc_auc_score(yte, bst.predict(Xte)))
+    out = {"auc": round(auc, 6),
+           "auc_bayes": round(float(roc_auc_score(yte, margin[n_train:])),
+                              6)}
+
+    # the reference package is built out-of-tree by
+    # scripts/build_reference.sh; absent -> report our AUC alone
+    if os.path.isdir("/tmp/refpkg"):
+        import subprocess
+        code = (
+            "import sys, json, numpy as np\n"
+            "sys.path.insert(0, '/tmp/refpkg')\n"
+            "import lightgbm as rl\n"
+            "from sklearn.metrics import roc_auc_score\n"
+            f"d = np.load('/tmp/bench_quality.npz')\n"
+            f"ds = rl.Dataset(d['Xtr'], label=d['ytr'],\n"
+            f"                params={{'max_bin': 63, 'verbose': -1}})\n"
+            f"b = rl.train({params!r}, ds)\n"
+            "auc = roc_auc_score(d['yte'], b.predict(d['Xte']))\n"
+            "print(json.dumps({'auc_ref': round(float(auc), 6)}))\n")
+        np.savez("/tmp/bench_quality.npz", Xtr=Xtr, ytr=ytr, Xte=Xte,
+                 yte=yte)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=3600)
+            ref = json.loads(r.stdout.strip().splitlines()[-1])
+            out.update(ref)
+            out["auc_delta"] = round(out["auc"] - ref["auc_ref"], 6)
+        except Exception as e:
+            print(f"quality leg: reference run failed: {e}",
+                  file=sys.stderr)
+    return out
 
 
 def main() -> None:
@@ -98,23 +176,44 @@ def main() -> None:
 
     sec_per_iter = None
     for engine in ("fused", "frontier", "xla"):
-        try:
-            sec_per_iter = _run(engine, X, y, n_iters)
-            print(f"bench engine: {engine}", file=sys.stderr)
+        # the axon remote-compile tunnel drops connections transiently
+        # ("response body closed", HTTP 500 transport hiccups) — retry
+        # before degrading to a slower engine
+        for attempt in range(3):
+            try:
+                sec_per_iter = _run(engine, X, y, n_iters)
+                print(f"bench engine: {engine}", file=sys.stderr)
+                break
+            except Exception as e:  # degrade, don't zero the round
+                msg = str(e)
+                print(f"bench engine {engine} attempt {attempt} failed: "
+                      f"{type(e).__name__}: {msg[:500]}", file=sys.stderr)
+                transient = ("remote_compile" in msg or "INTERNAL" in msg
+                             or "read body" in msg)
+                if not transient:
+                    break
+                time.sleep(20)
+        if sec_per_iter is not None:
             break
-        except Exception as e:  # degrade, don't zero the round
-            print(f"bench engine {engine} failed: {type(e).__name__}: "
-                  f"{str(e)[:500]}", file=sys.stderr)
     if sec_per_iter is None:
         raise SystemExit("all engines failed")
 
     scaled = sec_per_iter * (10_500_000 / n_rows)
-    print(json.dumps({
+    result = {
         "metric": "higgs_sec_per_iter_10.5M_rows",
         "value": round(scaled, 4),
         "unit": "s",
         "vs_baseline": round(baseline_sec_per_iter / scaled, 3),
-    }))
+    }
+    # quality leg: differential AUC vs the rebuilt reference CPU package
+    # (skippable for smoke runs with BENCH_QUALITY=0)
+    if os.environ.get("BENCH_QUALITY", "1") != "0":
+        try:
+            result.update(_quality_leg(engine))
+        except Exception as e:
+            print(f"quality leg failed: {type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

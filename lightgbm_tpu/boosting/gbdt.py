@@ -121,6 +121,11 @@ class GBDT:
         self._fast_step_fn = None
         self._fast_ok_cache = None
         self._stopped_early = False
+        # fused-epilogue state (see _use_epilogue)
+        self._epi_ok_cache = None
+        self._epi_fns = None
+        self._epi_carry = None
+        self._epi_ops = None
         # distribution axis (ref: tree_learner.cpp:17-49 factory matrix)
         self.parallel_mode = "serial"
         self.mesh = None
@@ -204,6 +209,7 @@ class GBDT:
         # per-block bagging generators; col_sampler.hpp:26 by-tree stream)
         self.bag_streams = ref_random.BlockBaggingStreams(
             int(config.bagging_seed), n)
+        self._bag_round_cache = None
         self.bag_rng = np.random.RandomState(config.bagging_seed)  # GOSS
         self.feat_rng = ref_random.Random(int(config.feature_fraction_seed))
         self.balanced_bagging = False
@@ -675,6 +681,11 @@ class GBDT:
         self._fast_ok_cache = None
         self._fast_fm_pads = None
         self._par_fns = {}            # parallel growers close over params
+        self._epi_ok_cache = None     # epilogue closes over params too
+        self._epi_fns = None
+        self._epi_carry = None
+        self._epi_fm_pad = None
+        self._epi_bag_ones = None
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
@@ -837,6 +848,10 @@ class GBDT:
             self.fused_bins_T = jax.device_put(
                 self.fused_bins_T,
                 NamedSharding(self.mesh, P(None, self.axis_name)))
+        # the replicated [R, F] copy served only as the transpose source;
+        # release it so HBM holds one binned matrix (the property rebuilds
+        # it on the rare rollback/stop-subtract/DART replay paths)
+        self._bins_dev = None
         self.fused_f_oh = F_oh
         self.fused_Bp = Bp
         self.fused_Rp = Rp
@@ -889,6 +904,8 @@ class GBDT:
         """(ref: gbdt.cpp AddValidDataset)"""
         self.drain_pending()          # replay below needs the full model
         self._fast_ok_cache = None    # valid sets force the sync path
+        self._epi_ok_cache = None
+        self._epi_carry = None
         self.valid_data.append(valid_data)
         self.valid_bins.append(jnp.asarray(valid_data.bins))
         k = self.num_tree_per_iteration
@@ -944,6 +961,45 @@ class GBDT:
         return grad, hess
 
     # ------------------------------------------------------------------
+    def _bag_mask_for(self, it: int):
+        """In-bag mask effective at iteration ``it``. Rounds fire at
+        iterations where it % bagging_freq == 0 and are drawn strictly in
+        stream order, cached by firing iteration (two most recent kept) —
+        the fused-epilogue fast path legitimately asks ONE round ahead
+        (the epilogue computes the NEXT iteration's gradients and root
+        histogram, so it needs the next round's weights early; the draw
+        order, and hence reference parity, is unchanged)."""
+        cfg = self.config
+        fire = (it // cfg.bagging_freq) * cfg.bagging_freq
+        cache = getattr(self, "_bag_round_cache", None)
+        if cache is None:
+            cache = self._bag_round_cache = {}
+        if fire not in cache:
+            # requests arrive in nondecreasing firing order, so drawing on
+            # first sight preserves the stream sequence (and a fresh
+            # stream after reset_config starts over at its first round)
+            # reference-parity draws: one float per row per round from the
+            # row's 1024-block LCG stream (ref: gbdt.cpp:192
+            # BaggingHelper) — the in-bag SET matches the reference
+            # bit-for-bit. The float draws are compared against the
+            # DOUBLE fraction, matching the reference's float-vs-double
+            # promotion (gbdt.cpp:192).
+            draws = self.bag_streams.next_floats()
+            if self.balanced_bagging:
+                label = self.train_data.metadata.label
+                frac = np.where(label > 0,
+                                np.float64(cfg.pos_bagging_fraction),
+                                np.float64(cfg.neg_bagging_fraction))
+                mask = draws.astype(np.float64) < frac
+            else:
+                mask = draws.astype(np.float64) < np.float64(
+                    cfg.bagging_fraction)
+            cache[fire] = mask
+            for old in [key for key in cache
+                        if key < fire - cfg.bagging_freq]:
+                del cache[old]
+        return cache[fire]
+
     def _bagging(self, it: int, grad, hess):
         """Recompute the in-bag weight vector (ref: gbdt.cpp:230 Bagging).
         Returns possibly-modified (grad, hess) (GOSS multiplies)."""
@@ -951,23 +1007,21 @@ class GBDT:
         if not self.is_bagging or cfg.bagging_freq <= 0 \
                 or it % cfg.bagging_freq != 0:
             return grad, hess
-        n = self.num_data
-        # reference-parity draws: one float per row per round from the
-        # row's 1024-block LCG stream (ref: gbdt.cpp:192 BaggingHelper) —
-        # the in-bag SET matches the reference bit-for-bit
-        draws = self.bag_streams.next_floats()
-        if self.balanced_bagging:
-            label = self.train_data.metadata.label
-            frac = np.where(label > 0,
-                            np.float32(cfg.pos_bagging_fraction),
-                            np.float32(cfg.neg_bagging_fraction))
-            mask = draws < frac
-        else:
-            mask = draws < np.float32(cfg.bagging_fraction)
+        mask = self._bag_mask_for(it)
         self.bag_cnt = int(mask.sum())
         log.debug("Re-bagging, using %d data to train", self.bag_cnt)
         self.bag_weight = jnp.asarray(mask.astype(np.float32))
         return grad, hess
+
+    def _bag_weight_for_iter(self, it: int):
+        """[n] f32 in-bag weights effective at iteration ``it`` (lookahead
+        helper for the fused epilogue; does not touch the live
+        bag_weight/bag_cnt bookkeeping)."""
+        cfg = self.config
+        if not self.is_bagging or cfg.bagging_freq <= 0:
+            return jnp.ones((self.num_data,), jnp.float32)
+        mask = self._bag_mask_for(it)
+        return jnp.asarray(mask.astype(np.float32))
 
     # ------------------------------------------------------------------
     def _make_fused_step(self):
@@ -1448,9 +1502,150 @@ class GBDT:
             return scores, stacked
         return step
 
+    # ------------------------------------------------------------------
+    # Fused boosting epilogue (ops/fused_level.epilogue_pass): the final
+    # route + score update + gradients + next ROOT histogram run as ONE
+    # streaming kernel, removing two full level passes plus the lookup and
+    # gradient streams from every iteration (the host loop being fused:
+    # ref gbdt.cpp:371 TrainOneIter's UpdateScore -> GetGradients -> next
+    # BeforeTrain). State carried on device between iterations:
+    # (padded score row, next root histogram, next packed gh block).
+    def _use_epilogue(self) -> bool:
+        if self._epi_ok_cache is None:
+            spec = (self.objective.epilogue_spec()
+                    if self.objective is not None else None)
+            self._epi_ok_cache = bool(
+                spec is not None
+                and bool(self.config.tpu_fused_epilogue)
+                and self.num_tree_per_iteration == 1
+                and self.parallel_mode == "serial")
+        return self._epi_ok_cache
+
+    def _make_epi_fns(self):
+        from ..models.frontier2 import grow_tree_fused
+        from ..ops.fused_level import epilogue_pass, pack_gh
+        kind, (op0, op1), sig = self.objective.epilogue_spec()
+        n = self.num_data
+        Rp = self.fused_Rp
+        pad = Rp - n
+        nch = self.fused_nch
+        shrink = jnp.float32(self.shrinkage_rate)
+        max_depth = int(self.config.max_depth)
+        extra = int(self.config.tpu_extra_levels)
+        interp = self.fused_interpret
+        kF = self.fused_bundle_cols or self.fused_f_oh
+        kB = (self.fused_bundle_col_bins if self.fused_bundle_cols
+              else self.fused_Bp)
+        # operand rows padded once; zero padding makes padded-row
+        # gradients vanish under both closed forms
+        self._epi_ops = jnp.zeros((8, Rp), jnp.float32) \
+            .at[0, :n].set(op0).at[1, :n].set(op1)
+
+        def in_jit_grads(score_pad, ops_T):
+            # the objective's own traced closed form; padded rows carry
+            # zero operands and so produce zero gradients under both
+            # kinds (the Pallas kernel copy in _epilogue_kernel is the
+            # only unavoidable duplicate of these formulas)
+            g, h = self.objective.gradients_from(
+                score_pad[None, :], (ops_T[0], ops_T[1]))
+            return g[0], h[0]
+
+        def grow(bins_T, gh_T, fm_pad, hist0):
+            return grow_tree_fused(
+                bins_T, gh_T, self.fused_meta, fm_pad, self.params,
+                self.max_leaves, self.fused_Bp, self.fused_f_oh,
+                num_rows=n, nch=nch, max_depth=max_depth,
+                extra_levels=extra, has_cat=self.has_cat,
+                use_mono_bounds=self.use_mono_bounds,
+                bundle_cols=self.fused_bundle_cols,
+                bundle_col_bins=self.fused_bundle_col_bins,
+                bundle_cfg=self.fused_bundle_cfg, interpret=interp,
+                root_hist=hist0, defer_final_route=True)
+
+        def epilogue(bins_T, leafT, W_l, tbl_l, tree, score_pad, ops_T,
+                     bag_next):
+            lv = jnp.where(tree.num_leaves > 1,
+                           tree.leaf_value * shrink, 0.0)
+            hist0, score2, ghT = epilogue_pass(
+                bins_T, leafT[None, :], W_l, tbl_l, lv,
+                score_pad[None, :], ops_T, bag_next[None, :],
+                num_bins=kB, f_oh=kF, nch=nch, kind=kind,
+                sigmoid=float(sig), interpret=interp)
+            return score2[0], hist0, ghT
+
+        @jax.jit
+        def prime(bins_T, score_pad, ops_T, bag_cur, bag_next, fm_pad):
+            g, h = in_jit_grads(score_pad, ops_T)
+            gh_T = pack_gh(g * bag_cur, h * bag_cur, bag_cur, nch)
+            tree, leafT, W_l, tbl_l = grow(bins_T, gh_T, fm_pad, None)
+            score2, hist0, ghT = epilogue(bins_T, leafT, W_l, tbl_l, tree,
+                                          score_pad, ops_T, bag_next)
+            return score2, hist0, ghT, tree
+
+        @jax.jit
+        def cont(bins_T, score_pad, hist0, gh_T, ops_T, bag_next, fm_pad):
+            tree, leafT, W_l, tbl_l = grow(bins_T, gh_T, fm_pad, hist0)
+            score2, hist0n, ghT_n = epilogue(bins_T, leafT, W_l, tbl_l,
+                                             tree, score_pad, ops_T,
+                                             bag_next)
+            return score2, hist0n, ghT_n, tree
+        return prime, cont
+
+    def _epi_iter_body(self):
+        n = self.num_data
+        Rp = self.fused_Rp
+        init_scores = [self._boost_from_average(0, True)]
+        self._bagging(self.iter, None, None)   # live bookkeeping, iter t
+        if self._epi_fns is None:
+            self._epi_fns = self._make_epi_fns()
+        prime, cont = self._epi_fns
+        F_oh = self.fused_f_oh
+        if float(self.config.feature_fraction) >= 1.0:
+            # cached: per-iteration eager dispatches cost ~25us-80ms each
+            # through a remote-attached chip
+            if getattr(self, "_epi_fm_pad", None) is None:
+                self._epi_fm_pad = jnp.ones((F_oh,), bool) \
+                    .at[self.train_data.num_features:].set(False)
+            fm_pad = self._epi_fm_pad
+        else:
+            fm_pad = jnp.zeros((F_oh,), bool) \
+                .at[:self.train_data.num_features].set(self._feature_mask())
+        if not self.is_bagging:
+            if getattr(self, "_epi_bag_ones", None) is None:
+                self._epi_bag_ones = jnp.zeros((Rp,), jnp.float32) \
+                    .at[:n].set(1.0)
+            bag_next = self._epi_bag_ones
+        else:
+            bag_next = jnp.pad(self._bag_weight_for_iter(self.iter + 1),
+                               (0, Rp - n))
+        if self._epi_carry is None:
+            score_pad = jnp.pad(self.scores[0], (0, Rp - n))
+            bag_cur = jnp.pad(self.bag_weight, (0, Rp - n))
+            out = prime(self.fused_bins_T, score_pad, self._epi_ops,
+                        bag_cur, bag_next, fm_pad)
+        else:
+            score_pad, hist0, gh_T = self._epi_carry
+            out = cont(self.fused_bins_T, score_pad, hist0, gh_T,
+                       self._epi_ops, bag_next, fm_pad)
+        score2, hist0n, ghT_n, tree = out
+        self._epi_carry = (score2, hist0n, ghT_n)
+        self.scores = score2[None, :n]
+        trees = jax.tree_util.tree_map(lambda x: jnp.stack([x]), tree)
+        for leaf in jax.tree_util.tree_leaves(trees):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._pending.append((trees, init_scores))
+        self.iter += 1
+        if len(self._pending) >= self._FAST_SYNC_EVERY:
+            return None
+        return False
+
     def _train_one_iter_fast(self) -> bool:
         with timer.section("GBDT::TrainOneIterFast"):
-            stop = self._fast_iter_body()
+            if self._use_epilogue():
+                stop = self._epi_iter_body()
+            else:
+                stop = self._fast_iter_body()
         if stop is None:    # batch full: drain outside the fast section
             self.drain_pending()
             return self._stopped_early
@@ -1567,6 +1762,7 @@ class GBDT:
             # live scores (bin-space routing is training-identical, so
             # each subtraction reverses the training add up to f32
             # rounding)
+            self._epi_carry = None
             scores = self.scores
             for iter_models in converted[stop_i + 1:]:
                 for tid, (_, dt, grew) in enumerate(iter_models):
@@ -1607,6 +1803,7 @@ class GBDT:
             return self._sync_iter_body(gradients, hessians)
 
     def _sync_iter_body(self, gradients, hessians) -> bool:
+        self._epi_carry = None   # sync iterations mutate scores directly
         k, n = self.num_tree_per_iteration, self.num_data
         init_scores = [0.0] * k
         if gradients is None or hessians is None:
@@ -1775,6 +1972,7 @@ class GBDT:
         # every config reset (gbdt.cpp ResetBaggingConfig)
         self.bag_streams = ref_random.BlockBaggingStreams(
             int(config.bagging_seed), n)
+        self._bag_round_cache = None   # round cache follows the streams
         self.early_stopping_round = int(config.early_stopping_round)
         self.es_first_metric_only = bool(config.first_metric_only)
 
@@ -1782,6 +1980,11 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """(ref: gbdt.cpp:456 RollbackOneIter)"""
         self.drain_pending()
+        self._epi_carry = None   # score subtraction invalidates the carry
+        # lookahead rounds drawn past the rollback point must not be
+        # served for earlier iterations — clear so post-rollback firings
+        # draw fresh rounds in stream order (pre-cache behavior)
+        self._bag_round_cache = None
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration

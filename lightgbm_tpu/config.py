@@ -247,6 +247,10 @@ _PARAMS: List[_Param] = [
     _p("tpu_extra_levels", int, 3, check=(">=", 0),
        desc="extra fused-level passes after the pow2 frontier levels so "
             "skewed trees can spend the remaining leaf budget"),
+    _p("tpu_fused_epilogue", bool, True,
+       desc="fuse final-level routing + score update + gradients + next "
+            "root histogram into one kernel pass on the pipelined fast "
+            "path (objectives with a kernel closed form: binary, l2)"),
     _p("tpu_rows_per_shard_pad", int, 8,
        desc="pad row count to a multiple of this per mesh shard"),
     _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),

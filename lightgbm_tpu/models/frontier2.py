@@ -110,7 +110,8 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
     static_argnames=("params", "num_leaves", "max_bins", "f_oh", "num_rows",
                      "nch", "max_depth", "extra_levels", "has_cat",
                      "use_mono_bounds", "use_node_masks", "interpret",
-                     "bundle_cols", "bundle_col_bins", "psum_axis"))
+                     "bundle_cols", "bundle_col_bins", "psum_axis",
+                     "defer_final_route"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -120,8 +121,9 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     use_node_masks: bool = False, node_masks=None,
                     bundle_cols: int = 0, bundle_col_bins: int = 0,
                     bundle_cfg=None, interpret: bool = False,
-                    psum_axis: str = None,
-                    ) -> Tuple[TreeArrays, jax.Array]:
+                    psum_axis: str = None, root_hist: jax.Array = None,
+                    defer_final_route: bool = False,
+                    ):
     """Grow one tree with fused level passes.
 
     Args:
@@ -155,8 +157,18 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         padding rows with zero gh weight instead (the global "real row"
         prefix has no meaning inside a shard).
 
+      root_hist: optional precomputed root histogram [FB, nch*8] in the
+        root-pass layout (slot 0 live) — produced by the previous
+        iteration's fused boosting epilogue (ops/fused_level.epilogue_pass)
+        so the root level_pass is skipped entirely.
+      defer_final_route: when True, the statically-last level pass records
+        its splits in the tree but does NOT route rows; the pass's route
+        tables are returned for the epilogue kernel to apply. The returned
+        row_leaf is then the PRE-final-route assignment.
+
     Returns (TreeArrays, row_leaf [Rp] int32 — caller slices to R; padding
-    rows stay at -1).
+    rows stay at -1). With defer_final_route:
+    (tree, row_leaf, W_last, tbl_last).
     """
     Fp, Rp = bins_T.shape
     L = num_leaves
@@ -182,17 +194,21 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
 
     # ---------------- root pass: slot 0 collects the full-data histogram
     # (W0[0, bins of column 0] = 1 sends every row "left" on slot 0 —
-    # each row's one-hot holds exactly one bin of column 0)
+    # each row's one-hot holds exactly one bin of column 0); skipped
+    # entirely when the previous iteration's epilogue already built it
     Sp0 = 8
-    W0 = jnp.zeros((Sp0, k_foh * k_B), jnp.bfloat16).at[0, :k_B].set(1)
-    tbl0 = jnp.zeros((Sp0, 128), jnp.int32)
-    tbl0 = tbl0.at[:, 0].set(jnp.where(jnp.arange(Sp0) == 0, 0, -2))
-    tbl0 = tbl0.at[0, 2].set(1)
-    hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
-                          num_bins=k_B, f_oh=k_foh, nch=nch,
-                          interpret=interpret)
-    if psum_axis is not None:
-        hist0 = jax.lax.psum(hist0, psum_axis)
+    if root_hist is not None:
+        hist0 = root_hist
+    else:
+        W0 = jnp.zeros((Sp0, k_foh * k_B), jnp.bfloat16).at[0, :k_B].set(1)
+        tbl0 = jnp.zeros((Sp0, 128), jnp.int32)
+        tbl0 = tbl0.at[:, 0].set(jnp.where(jnp.arange(Sp0) == 0, 0, -2))
+        tbl0 = tbl0.at[0, 2].set(1)
+        hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
+                              num_bins=k_B, f_oh=k_foh, nch=nch,
+                              interpret=interpret)
+        if psum_axis is not None:
+            hist0 = jax.lax.psum(hist0, psum_axis)
     g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
     if use_bundles:
         v = bundle_plane_views(jnp.stack([g0, h0, c0], axis=-1),
@@ -231,16 +247,28 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     lpn = jnp.full((L,), -1, jnp.int32)   # leaf -> parent node
     lil = jnp.zeros((L,), bool)           # leaf is left child of its parent
 
+    # deferred terminal-route tables. At most ONE route-only pass ever
+    # fires per tree (the pass that exhausts the leaf budget, or the
+    # statically-last pass): after it, no level can select splits again,
+    # so its routing can safely ride the epilogue kernel instead. Tables
+    # are padded to the widest level (an all-(-2) table routes nothing).
+    Sp_max = max([8] + [max(8, c) for c in caps])
+    def_W = jnp.zeros((Sp_max, k_foh * k_B), jnp.bfloat16)
+    def_tbl = jnp.zeros((Sp_max, 128), jnp.int32) \
+        .at[:, 0].set(-2)
+
     state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-             leaf_lo, leaf_hi, leaf_groups)
+             leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl)
     for li, S_d in enumerate(caps):
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
                            use_mono_bounds, use_node_masks, node_masks,
                            li + 1, li == len(caps) - 1,
                            bundle_cols, bundle_col_bins, bundle_cfg,
-                           interpret, psum_axis)
+                           interpret, psum_axis, defer_final_route)
     tree, leaf_T = state[0], state[1]
+    if defer_final_route:
+        return tree, leaf_T[0], state[11], state[12]
     return tree, leaf_T[0]
 
 
@@ -248,9 +276,9 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                S_d, nch, max_depth, has_cat, use_mono_bounds,
                use_node_masks, node_masks, fold, is_last,
                bundle_cols, bundle_col_bins, bundle_cfg, interpret,
-               psum_axis=None):
+               psum_axis=None, defer_final_route=False):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-     leaf_lo, leaf_hi, leaf_groups) = state
+     leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl) = state
     use_bundles = bundle_cols > 0
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
@@ -275,7 +303,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
 
     def _apply_level(op, route_only):
         (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-         leaf_lo, leaf_hi, leaf_groups) = op
+         leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl) = op
         sel_i32 = selected.astype(jnp.int32)
         k_of_leaf = jnp.cumsum(sel_i32) - sel_i32
         new_of_leaf = jnp.where(selected, tree.num_leaves + k_of_leaf, -1)
@@ -321,7 +349,18 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         k_foh = bundle_cols if use_bundles else f_oh
         k_B = bundle_col_bins if use_bundles else B
         # ---- THE level pass: route (+ smaller-child histograms)
-        if route_only:
+        def_W2, def_tbl2 = def_W, def_tbl
+        if route_only and defer_final_route:
+            # the epilogue kernel applies this pass's routing; hand it the
+            # (width-padded) tables and keep leaf_T at the pre-terminal
+            # assignment. Only one route-only pass can ever fire, so the
+            # single write is never clobbered.
+            leaf_T2 = leaf_T
+            def_W2 = jnp.zeros_like(def_W).at[:Sp].set(W)
+            def_tbl2 = jnp.zeros_like(def_tbl).at[:, 0].set(-2) \
+                .at[:Sp].set(tbl)
+            pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
+        elif route_only:
             leaf_T2 = route_pass(bins_T, leaf_T, W, tbl, num_slots=Sp,
                                  num_bins=k_B, f_oh=k_foh,
                                  interpret=interpret)
@@ -433,7 +472,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             g2 = _masked_scatter(g2, new_of_leaf, neg, selected)
             best2 = best._replace(gain=g2)
             return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2,
-                    lpn2, lil2, leaf_lo2, leaf_hi2, leaf_groups2)
+                    lpn2, lil2, leaf_lo2, leaf_hi2, leaf_groups2,
+                    def_W2, def_tbl2)
 
         # ---- best splits for the 2*Sp fresh children only; each child's
         # own post-split output is the parent_output for path smoothing of
@@ -472,10 +512,10 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         best2 = _merge_best_many(best2, new_s, right_bs, lof_on)
 
         return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2, lpn2,
-                lil2, leaf_lo2, leaf_hi2, leaf_groups2)
+                lil2, leaf_lo2, leaf_hi2, leaf_groups2, def_W2, def_tbl2)
 
     op0 = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-           leaf_lo, leaf_hi, leaf_groups)
+           leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl)
 
     def dispatch(op):
         if is_last:

@@ -446,6 +446,178 @@ def route_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
     return new_leaf
 
 
+def _epilogue_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, lv_ref, score_ref,
+                     op_ref, bag_ref, hist_ref, newscore_ref, gh_ref,
+                     oh_ref, *, B: int, F_oh: int, Sp: int, Lp: int,
+                     nch: int, kind: str, sigmoid: float):
+    """Fused boosting epilogue: final-level routing + leaf-value score
+    update + objective gradients + bf16 hi/lo channel pack + next tree's
+    ROOT histogram, in ONE streaming pass over the rows.
+
+    Replaces four separate O(R) streams of the round-2 driver (the final
+    route_pass, the table_lookup score update, the elementwise gradient/
+    pack, and the next grow's root level_pass) — each of which paid the
+    full per-pass floor (oh-build + narrow-N dot, PROFILE.md §5).
+    The ref host loop being fused: gbdt.cpp:371 TrainOneIter's
+    UpdateScore -> Boosting(GetGradients) -> next BeforeTrain root.
+
+    Output hist layout matches the root pass ([FB, nch*8], slot 0 live)
+    so grow_tree_fused can consume it as ``root_hist`` directly.
+    """
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    C = bins_ref.shape[1]
+    FB = F_oh * B
+    bins_val = bins_ref[:].astype(jnp.int32)
+    big = jnp.repeat(bins_val[:F_oh], B, axis=0)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
+    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+    oh = oh_ref[:]
+
+    # ---- final-level routing (same contract as _route_kernel; an
+    # all-inactive table — leaf_of_slot=-2 — routes nothing)
+    leafb = leaf_ref[:]
+    D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    left_i = (D > 0.5).astype(jnp.int32)
+    leaf_of_slot = tbl_ref[:, 0:1]
+    right_delta = tbl_ref[:, 1:2]
+    P_i = (jnp.broadcast_to(leafb, (Sp, C)) == leaf_of_slot).astype(jnp.int32)
+    go_right = P_i * (1 - left_i)
+    delta_l = jnp.sum(go_right * jnp.broadcast_to(right_delta, (Sp, C)),
+                      axis=0, keepdims=True)
+    leaf2 = leafb + delta_l                                    # [1, C]
+
+    # ---- leaf-value score update (sublane one-hot, as _lookup_kernel;
+    # padding rows at leaf -1 match nothing -> delta 0)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)
+    Pl = jnp.broadcast_to(leaf2, (Lp, C)) == iota_l
+    lvals = jnp.broadcast_to(lv_ref[:, 0:1], (Lp, C))
+    delta = jnp.sum(jnp.where(Pl, lvals, 0.0), axis=0, keepdims=True)
+    score2 = score_ref[:] + delta                              # [1, C] f32
+    newscore_ref[:] = score2
+
+    # ---- objective gradients from the UPDATED score (closed forms of the
+    # epilogue_spec protocol; ref: binary_objective.hpp:107-136,
+    # regression_objective.hpp:127-141)
+    if kind == "binary":
+        lv = op_ref[0:1, :]
+        lw = op_ref[1:2, :]
+        resp = -lv * sigmoid / (1.0 + jnp.exp(lv * sigmoid * score2))
+        ar = jnp.abs(resp)
+        g = resp * lw
+        h = ar * (sigmoid - ar) * lw
+    else:  # "l2"
+        label = op_ref[0:1, :]
+        w_row = op_ref[1:2, :]
+        g = (score2 - label) * w_row
+        h = w_row
+    bag = bag_ref[:]                                           # [1, C]
+    g = g * bag
+    h = h * bag
+
+    # ---- bf16 channel pack (pack_gh layout) + root histogram: slot 0 of
+    # an 8-slot block carries every row, slots 1-7 stay zero so the
+    # output matches the root level_pass layout bit-for-bit
+    zero7 = jnp.zeros((7, C), jnp.bfloat16)
+    if nch == NCH_PRECISE:
+        g_hi = g.astype(jnp.bfloat16)
+        g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        h_hi = h.astype(jnp.bfloat16)
+        h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        w_ch = bag.astype(jnp.bfloat16)
+        rows = [g_hi, g_lo, h_hi, h_lo, w_ch]
+    else:
+        rows = [g.astype(jnp.bfloat16), h.astype(jnp.bfloat16),
+                bag.astype(jnp.bfloat16)]
+    gh_ref[:] = jnp.concatenate(
+        rows + [jnp.zeros((8 - nch, C), jnp.bfloat16)], axis=0)
+    ghs = jnp.concatenate([jnp.concatenate([r, zero7], axis=0)
+                           for r in rows], axis=0)             # [nch*8, C]
+    hist_ref[:] += jax.lax.dot_general(
+        oh, ghs, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                    # [FB, nch*8]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "f_oh", "nch", "kind", "sigmoid",
+                     "tile_rows", "interpret"))
+def epilogue_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
+                  tbl: jax.Array, leaf_values: jax.Array,
+                  score_T: jax.Array, ops_T: jax.Array, bag_T: jax.Array,
+                  *, num_bins: int, f_oh: int, nch: int = NCH_PRECISE,
+                  kind: str = "binary", sigmoid: float = 1.0,
+                  tile_rows: int = 0, interpret: bool = False):
+    """One fused epilogue pass (see _epilogue_kernel).
+
+    Args:
+      bins_T/leaf_T: as level_pass (leaf_T is the PRE-final-route
+        assignment; padding rows carry -1).
+      W/tbl: the deferred final level's route tables (grow_tree_fused with
+        defer_final_route=True); an all-inactive tbl routes nothing.
+      leaf_values: [L] f32 — shrinkage-scaled leaf outputs of the tree
+        just grown (zeroed by the caller when the tree grew no splits).
+      score_T: [1, R] f32 current scores.
+      ops_T: [8, R] f32 objective operand rows (binary: label_val,
+        label_weight; l2: label, weight).
+      bag_T: [1, R] f32 NEXT iteration's bagging weights (0 for padding
+        rows — they zero the histogram and gh channels).
+
+    Returns (hist [FB, nch*8] f32 root histogram for the next tree,
+    new_score [1, R] f32, gh_T [8, R] bf16 pack_gh block for the next
+    tree's level passes).
+    """
+    if not HAS_PALLAS:
+        raise ImportError("jax.experimental.pallas is unavailable on this "
+                          "backend; use the XLA histogram path instead")
+    Fp, R = bins_T.shape
+    B = num_bins
+    FB = f_oh * B
+    Sp = tbl.shape[0]
+    L = leaf_values.shape[0]
+    Lp = _round_up(max(L, 8), 8)
+    C = tile_rows or default_tile_rows(8, FB, nch)
+    assert R % C == 0, f"rows {R} not padded to tile {C}"
+    lvp = jnp.zeros((Lp, 128), jnp.float32).at[:L, 0].set(leaf_values)
+    kernel = functools.partial(_epilogue_kernel, B=B, F_oh=f_oh, Sp=Sp,
+                               Lp=Lp, nch=nch, kind=kind,
+                               sigmoid=float(sigmoid))
+    hist, new_score, gh_T = pl.pallas_call(
+        kernel,
+        grid=(R // C,),
+        in_specs=[
+            pl.BlockSpec((Fp, C), lambda t: (0, t)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
+            pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
+            pl.BlockSpec((Lp, 128), lambda t: (0, 0)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((8, C), lambda t: (0, t)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((FB, nch * 8), lambda t: (0, 0)),
+            pl.BlockSpec((1, C), lambda t: (0, t)),
+            pl.BlockSpec((8, C), lambda t: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((FB, nch * 8), jnp.float32),
+            jax.ShapeDtypeStruct((1, R), jnp.float32),
+            jax.ShapeDtypeStruct((8, R), jnp.bfloat16),
+        ],
+        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(bins_T, leaf_T, W, tbl, lvp, score_T, ops_T, bag_T)
+    return hist, new_score, gh_T
+
+
 def _lookup_kernel(idx_ref, tbl_ref, out_ref, *, Lp: int):
     C = idx_ref.shape[1]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)
