@@ -189,7 +189,8 @@ def main() -> None:
                 print(f"bench engine {engine} attempt {attempt} failed: "
                       f"{type(e).__name__}: {msg[:500]}", file=sys.stderr)
                 transient = ("remote_compile" in msg or "INTERNAL" in msg
-                             or "read body" in msg)
+                             or "read body" in msg
+                             or "response body" in msg)
                 if not transient:
                     break
                 time.sleep(20)

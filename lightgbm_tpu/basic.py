@@ -21,6 +21,7 @@ from .metric import create_metric, default_metric_for_objective
 from .models.tree import HostTree
 from .objective import create_objective, create_objective_from_string
 from .utils import log
+from .utils.log import LightGBMError
 
 __all__ = ["Dataset", "Booster", "Sequence"]
 
@@ -66,7 +67,19 @@ def pred_trees_stale(pred, booster) -> bool:
     return getattr(pred, "model_version", -1) != booster._model_version
 
 
+def _is_scipy_sparse(data) -> bool:
+    try:
+        import scipy.sparse as sp
+    except ImportError:  # pragma: no cover
+        return False
+    return sp.issparse(data)
+
+
 def _to_2d_numpy(data) -> np.ndarray:
+    if _is_scipy_sparse(data):
+        # chunk-free densify is only acceptable at prediction-batch sizes;
+        # Dataset construction routes sparse input to from_sparse instead
+        return np.asarray(data.todense(), np.float64)
     if hasattr(data, "values") and not isinstance(data, np.ndarray):
         data = data.values  # pandas
     arr = np.asarray(data)
@@ -125,7 +138,8 @@ class Dataset:
                 self.group = side["group"]
             if self.init_score is None and "init_score" in side:
                 self.init_score = side["init_score"]
-        data = _to_2d_numpy(self.data)
+        is_sparse = _is_scipy_sparse(self.data)
+        data = self.data if is_sparse else _to_2d_numpy(self.data)
         feature_names = None
         if self.feature_name != "auto" and self.feature_name is not None:
             feature_names = list(self.feature_name)
@@ -144,10 +158,26 @@ class Dataset:
         ref_inner = None
         if self.reference is not None:
             ref_inner = self.reference.construct()._inner
-        self._inner = TpuDataset.from_data(
-            data, cfg, categorical_feature=cats, feature_names=feature_names,
-            reference=ref_inner)
-        if bool(cfg.linear_tree):
+        if is_sparse:
+            # CSR/CSC ingestion without densifying (ref: c_api.cpp:398-520
+            # DatasetCreateFromCSR/CSC; storage answer: ingestion-time EFB,
+            # see TpuDataset.from_sparse)
+            if cats:
+                raise LightGBMError(
+                    "categorical features are not supported for sparse "
+                    "input yet; densify those columns")
+            if bool(cfg.linear_tree):
+                raise LightGBMError(
+                    "linear_tree needs retained raw data and is not "
+                    "supported for sparse input")
+            self._inner = TpuDataset.from_sparse(
+                data, cfg, feature_names=feature_names,
+                reference=ref_inner)
+        else:
+            self._inner = TpuDataset.from_data(
+                data, cfg, categorical_feature=cats,
+                feature_names=feature_names, reference=ref_inner)
+        if not is_sparse and bool(cfg.linear_tree):
             # linear leaves fit ridge models on RAW feature values
             # (ref: dataset raw-data retention for linear_tree)
             self._inner.raw_data = np.asarray(data, np.float32)
@@ -442,30 +472,38 @@ class Booster:
         raise Exception("Data should be added with add_valid first")
 
     def _eval_set(self, name: str, valid_idx: Optional[int], feval) -> List:
-        """Returns [(dataset_name, metric_name, value, is_higher_better)]."""
-        self._drain()
+        """Returns [(dataset_name, metric_name, value, is_higher_better)].
+
+        Metrics with a device formulation evaluate on the live device
+        scores without draining the pipelined driver or pulling the score
+        matrix (one batched scalar fetch at the end); host-only metrics,
+        custom ``feval``s, and RF score averaging take the classic path."""
+        import jax
         g = self._gbdt
         out = []
         if valid_idx is None:
-            score = np.asarray(g.scores, np.float64)
+            score_dev = g.scores
             metrics = g.training_metrics
             dataset = self.train_set
         else:
-            score = np.asarray(g.valid_scores[valid_idx], np.float64)
+            score_dev = g.valid_scores[valid_idx]
             metrics = g.valid_metrics[valid_idx]
             dataset = self.valid_sets[valid_idx]
+        if getattr(g, "average_output", False) or feval is not None:
+            self._drain()   # needs the settled model count / host scores
         if getattr(g, "average_output", False):
-            score = score / max(1, g.num_iterations_trained)
-        for m in metrics:
-            for mn, v in zip(m.names, m.eval(score, self.objective)):
-                out.append((name, mn, v, m.is_bigger_better))
+            score_dev = score_dev / max(1, g.num_iterations_trained)
+        out.extend(g.eval_metric_set(name, metrics, score_dev))
         if feval is not None:
+            host_score = np.asarray(score_dev, np.float64)
             for f in (feval if isinstance(feval, list) else [feval]):
-                ret = f(score.reshape(-1), dataset)
+                ret = f(host_score.reshape(-1), dataset)
                 rets = ret if isinstance(ret, list) else [ret]
                 for mn, v, hb in rets:
                     out.append((name, mn, v, hb))
-        return out
+        fetched = jax.device_get([v for (_, _, v, _) in out])
+        return [(d, n, float(v), b)
+                for (d, n, _, b), v in zip(out, fetched)]
 
     # ------------------------------------------------------------------
     def predict(self, data, start_iteration: int = 0,
@@ -488,7 +526,12 @@ class Booster:
                       pred_early_stop_freq,
                       pred_early_stop_margin) -> np.ndarray:
         self._drain()
-        X = _to_2d_numpy(data).astype(np.float64)
+        if _is_scipy_sparse(data):
+            # the batch predictor densifies per chunk; host-walk paths
+            # (pred_leaf/contrib/early-stop) densify below as needed
+            X = data.tocsr()
+        else:
+            X = _to_2d_numpy(data).astype(np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
         # only num_iteration=None means "use best_iteration"; an explicit
@@ -502,6 +545,11 @@ class Booster:
         num_iteration = min(num_iteration, total_iter - start_iteration)
         lo = start_iteration * k
         hi = (start_iteration + num_iteration) * k
+
+        if _is_scipy_sparse(X) and (pred_leaf or pred_contrib
+                                    or pred_early_stop):
+            # host-walk paths operate row-wise on raw values
+            X = np.asarray(X.todense(), np.float64)
 
         if pred_leaf:
             out = np.zeros((n, hi - lo), np.int32)
@@ -573,6 +621,8 @@ class Booster:
                     self._device_predictor = pred
             if pred is not None and pred.ok:
                 return pred.predict_raw(X, lo, hi)
+        if _is_scipy_sparse(X):
+            X = np.asarray(X.todense(), np.float64)  # host walk needs rows
         raw = np.zeros((k, n), np.float64)
         for i, t in enumerate(self.models[lo:hi]):
             raw[(lo + i) % k] += t.predict_rows(X)
@@ -607,15 +657,20 @@ class Booster:
 
     # ------------------------------------------------------------------
     def model_to_string(self, start_iteration: int = 0,
-                        num_iteration: int = -1,
+                        num_iteration: Optional[int] = None,
                         importance_type: Union[int, str] = "split") -> str:
         self._drain()
+        if num_iteration is None:
+            # stock semantics: default to the early-stopped best iteration
+            # (an explicit <= 0 still means "all trees")
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
         it = 0 if importance_type in (0, "split") else 1
         return model_io.save_model_to_string(self, start_iteration,
                                              num_iteration, it)
 
     def save_model(self, filename: str, start_iteration: int = 0,
-                   num_iteration: int = -1,
+                   num_iteration: Optional[int] = None,
                    importance_type: Union[int, str] = "split") -> "Booster":
         with open(filename, "w") as fh:
             fh.write(self.model_to_string(start_iteration, num_iteration,
@@ -623,8 +678,11 @@ class Booster:
         return self
 
     def dump_model(self, start_iteration: int = 0,
-                   num_iteration: int = -1) -> dict:
+                   num_iteration: Optional[int] = None) -> dict:
         self._drain()
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
         import json as _json
         return _json.loads(model_io.dump_model_json(self, start_iteration,
                                                     num_iteration))

@@ -247,6 +247,27 @@ class GBDT:
         bundling actually reduces the column count (dense data is
         unaffected — conflict-free bundles simply don't form)."""
         self.use_bundles = False
+        self._replay_bundle = None
+        pb = getattr(train_data, "prebundled", None)
+        if pb is not None:
+            # sparse-built dataset: the bundle matrix IS the storage — the
+            # layout arrives from ingestion (TpuDataset.from_sparse), it
+            # is not optional and not recomputed here
+            if getattr(self, "n_forced", 0) > 0:
+                log.fatal("forced splits are not supported on sparse-built "
+                          "(prebundled) datasets")
+            self._install_bundle_layout(
+                train_data, pb,
+                np.asarray(train_data.bins),
+                np.asarray(train_data.most_freq_bins, np.int32))
+            # bundle-aware replay routing for rollback/DART/stop-subtract/
+            # valid updates (ops/predict.route_rows_to_leaves decode)
+            self._replay_bundle = (
+                jnp.asarray(pb.col_of_feat),
+                jnp.asarray(pb.offset_of_feat),
+                jnp.asarray(np.asarray(train_data.most_freq_bins,
+                                       np.int32)))
+            return
         if not (bool(config.tpu_enable_bundle)
                 and bool(config.enable_bundle)):
             return
@@ -286,9 +307,19 @@ class GBDT:
                                num_bin_per_feat=nb_all)
         if len(bundles) >= train_data.num_features:
             return  # nothing to gain
-        nb = nb_all
-        layout = BundleLayout(bundles, nb)
+        layout = BundleLayout(bundles, nb_all)
         enc = encode_bundles(bins_np, mfb, layout)
+        self._install_bundle_layout(train_data, layout, enc,
+                                    np.asarray(mfb, np.int32))
+        log.info("EFB: %d features bundled into %d columns",
+                 train_data.num_features, layout.num_columns)
+
+    def _install_bundle_layout(self, train_data, layout, enc_np,
+                               mfb_np) -> None:
+        """BundleCfg + device bundle matrix from a BundleLayout (shared by
+        the dense default-on EFB path and sparse-built prebundled
+        datasets)."""
+        nb = [int(x) for x in train_data.num_bin_per_feat]
         Bc = max(layout.col_num_bin)
         B = self.max_bins
         F = train_data.num_features
@@ -305,15 +336,13 @@ class GBDT:
         # (the rows encoded as bundle-default), not the zero-default bin
         self.bundle_cfg = BundleCfg(
             flat_idx=jnp.asarray(flat_idx), valid=jnp.asarray(valid),
-            default_bin=jnp.asarray(np.asarray(mfb, np.int32)),
+            default_bin=jnp.asarray(mfb_np),
             col_of_feat=jnp.asarray(layout.col_of_feat),
             offset_of_feat=jnp.asarray(layout.offset_of_feat))
-        self.bundle_bins_dev = jnp.asarray(enc.astype(
+        self.bundle_bins_dev = jnp.asarray(enc_np.astype(
             np.uint8 if Bc <= 256 else np.uint16))
         self.bundle_col_bins = int(Bc)
         self.use_bundles = True
-        log.info("EFB: %d features bundled into %d columns",
-                 F, layout.num_columns)
 
     # ------------------------------------------------------------------
     def _setup_forced_splits(self, config: Config, train_data) -> None:
@@ -488,16 +517,18 @@ class GBDT:
         bins_np = np.asarray(self.train_data.bins)
         if self.parallel_mode in ("data", "voting"):
             pad = self.par_rows - self.num_data
-            if pad:
-                bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
-            self.bins_par = jax.device_put(
-                bins_np, NamedSharding(self.mesh, P(axis, None)))
             if getattr(self, "use_bundles", False):
+                # the bundled grower only ever reads the bundle matrix
                 bb = np.asarray(self.bundle_bins_dev)
                 if pad:
                     bb = np.pad(bb, ((0, pad), (0, 0)))
                 self.bundle_bins_par = jax.device_put(
                     bb, NamedSharding(self.mesh, P(axis, None)))
+            else:
+                if pad:
+                    bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
+                self.bins_par = jax.device_put(
+                    bins_np, NamedSharding(self.mesh, P(axis, None)))
         else:
             padF = self.par_feats - self.train_data.num_features
             if padF:
@@ -598,8 +629,7 @@ class GBDT:
                           bundle_col_bins=self.bundle_col_bins)
             if grow is grow_tree_leafwise:
                 kw = {k: v for k, v in kw.items()
-                      if k not in ("parallel_mode", "top_k", "use_bundles",
-                                   "bundle_cfg", "bundle_col_bins")}
+                      if k not in ("parallel_mode", "top_k")}
                 if n_forced:
                     kw.update(n_forced=n_forced,
                               forced_leaf=self.forced_leaf,
@@ -686,6 +716,7 @@ class GBDT:
         self._epi_carry = None
         self._epi_fm_pad = None
         self._epi_bag_ones = None
+        self._valid_upd_fns = None    # close over shrinkage/depth bound
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
@@ -728,9 +759,7 @@ class GBDT:
             self.use_fused = True
             self.fused_interpret = not self.on_tpu
         default_policy = ("depthwise" if (self.use_fused or self.use_frontier
-                                          or getattr(self, "use_cegb", False)
-                                          or getattr(self, "use_bundles",
-                                                     False))
+                                          or getattr(self, "use_cegb", False))
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
                                                         config.grow_policy)
@@ -745,12 +774,8 @@ class GBDT:
                         "switching grow_policy")
             self.grow_policy = "depthwise"
         if getattr(self, "use_bundles", False) \
-                and self.grow_policy != "depthwise":
-            log.warning("feature bundling is implemented on the depthwise "
-                        "grower; switching grow_policy")
-            self.grow_policy = "depthwise"
-        if getattr(self, "use_bundles", False) \
                 and getattr(self, "n_forced", 0) > 0:
+            # (prebundled datasets already fatal'd in _setup_bundles)
             log.warning("forced splits disable feature bundling")
             self.use_bundles = False
         if getattr(self, "n_forced", 0) > 0 \
@@ -903,7 +928,7 @@ class GBDT:
                        metrics: Sequence) -> None:
         """(ref: gbdt.cpp AddValidDataset)"""
         self.drain_pending()          # replay below needs the full model
-        self._fast_ok_cache = None    # valid sets force the sync path
+        self._fast_ok_cache = None    # (valid sets ride the fast path now)
         self._epi_ok_cache = None
         self._epi_carry = None
         self.valid_data.append(valid_data)
@@ -926,7 +951,8 @@ class GBDT:
         for i, dt in enumerate(self.device_trees):
             tree_id = i % self.num_tree_per_iteration
             self.valid_scores[-1] = self._add_tree_to_score(
-                self.valid_scores[-1], self.valid_bins[-1], dt, tree_id)
+                self.valid_scores[-1], self.valid_bins[-1], dt, tree_id,
+                bundle=self._valid_bundle(len(self.valid_data) - 1))
 
     # ------------------------------------------------------------------
     def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
@@ -1140,8 +1166,10 @@ class GBDT:
                 bundle_cfg=self.bundle_cfg if ub else None,
                 bundle_col_bins=(self.bundle_col_bins if ub else 0))
         n_forced = getattr(self, "n_forced", 0)
+        ub = getattr(self, "use_bundles", False)
         return grow_tree_leafwise(
-            self.bins_dev, gh, self.meta, fm, self.params,
+            self.bundle_bins_dev if ub else self.bins_dev, gh,
+            self.meta, fm, self.params,
             self.max_leaves, self.max_bins, int(self.config.max_depth),
             hist_impl=self._xla_hist_impl(), has_cat=self.has_cat,
             use_mono_bounds=self.use_mono_bounds,
@@ -1150,7 +1178,10 @@ class GBDT:
             n_forced=n_forced,
             forced_leaf=self.forced_leaf if n_forced else None,
             forced_feat=self.forced_feat if n_forced else None,
-            forced_thr=self.forced_thr if n_forced else None)
+            forced_thr=self.forced_thr if n_forced else None,
+            use_bundles=ub,
+            bundle_cfg=self.bundle_cfg if ub else None,
+            bundle_col_bins=(self.bundle_col_bins if ub else 0))
 
     def _node_masks_for_iter(self):
         """Per-tree bynode randomness: fold the boosting iteration into the
@@ -1357,7 +1388,11 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _add_tree_to_score(self, score, bins_dev, dt: _DeviceTree,
-                           tree_id: int, scale: float = 1.0):
+                           tree_id: int, scale: float = 1.0,
+                           bundle=None):
+        """``bundle`` must be self._replay_bundle when ``bins_dev`` holds
+        EFB bundle columns (sparse-built datasets), None for logical
+        bins."""
         if dt.num_leaves <= 1:
             return score.at[tree_id].add(float(dt.leaf_value[0]) * scale)
         steps = _round_up_pow2(dt.max_depth + 1)
@@ -1366,8 +1401,18 @@ class GBDT:
             score[tree_id], bins_dev, lv, dt.split_feature, dt.threshold_bin,
             dt.default_left, dt.left_child, dt.right_child,
             self.meta.num_bin, self.meta.missing_type, self.meta.default_bin,
-            max_steps=steps, cat_flag=dt.cat_flag, cat_mask=dt.cat_mask)
+            max_steps=steps, cat_flag=dt.cat_flag, cat_mask=dt.cat_mask,
+            bundle=bundle)
         return score.at[tree_id].set(new_row)
+
+    def _train_bundle(self):
+        """Replay-decode args for the TRAIN bin matrix (None unless the
+        dataset is sparse-built)."""
+        return getattr(self, "_replay_bundle", None)
+
+    def _valid_bundle(self, vi: int):
+        return (self._replay_bundle
+                if self.valid_data[vi].prebundled is not None else None)
 
     # ------------------------------------------------------------------
     # Async pipelined fast path.
@@ -1388,9 +1433,10 @@ class GBDT:
     def _fast_path_ok(self) -> bool:
         """Per-tree host work forces the synchronous path: subclass drivers
         (DART drop-sets, GOSS resampling, RF), leaf renewal, linear leaves,
-        CEGB feature accounting, forced splits, per-node mask key folding,
-        and valid sets (their score updates still run through HostTree
-        conversion)."""
+        CEGB feature accounting, forced splits, and per-node mask key
+        folding. Valid sets stay on the fast path since round 3: their
+        score updates run in-jit from the device TreeArrays
+        (_update_valid_from_trees) and eval pulls scalars, not matrices."""
         if self._fast_ok_cache is None:
             obj = self.objective
             self._fast_ok_cache = bool(
@@ -1403,9 +1449,68 @@ class GBDT:
                 and not getattr(self, "use_cegb", False)
                 and not getattr(self, "n_forced", 0)
                 and not self.use_node_masks
-                and not self.valid_scores
                 and all(self.class_need_train))
         return self._fast_ok_cache
+
+    def _fast_tree_depth_bound(self) -> int:
+        """Static routing-step bound for trees grown by the fused engine:
+        depth cannot exceed the number of scheduled level passes."""
+        from ..models.frontier2 import level_caps
+        from ..ops.fused_level import max_slot_cap
+        if self.fused_bundle_cols:
+            fb = self.fused_bundle_cols * self.fused_bundle_col_bins
+        else:
+            fb = self.fused_f_oh * self.fused_Bp
+        caps = level_caps(self.max_leaves, int(self.config.max_depth),
+                          int(self.config.tpu_extra_levels),
+                          slot_cap=max_slot_cap(fb, self.fused_nch))
+        return len(caps) + 1
+
+    def _update_valid_from_trees(self, trees) -> None:
+        """In-jit valid-score updates straight from the stacked device
+        TreeArrays — no HostTree materialisation, no per-iteration sync
+        (ref: gbdt.cpp:493 UpdateScore over valid ScoreUpdaters)."""
+        if not self.valid_scores:
+            return
+        if not getattr(self, "_valid_upd_fns", None):
+            self._valid_upd_fns = {}
+
+        def make_upd(bundle):
+            k = self.num_tree_per_iteration
+            shrink = jnp.float32(self.shrinkage_rate)
+            steps = self._fast_tree_depth_bound()
+            meta = self.meta
+
+            @jax.jit
+            def upd(vscore, vbins, trees):
+                for tid in range(k):
+                    new_row = add_tree_score(
+                        vscore[tid], vbins, trees.leaf_value[tid] * shrink,
+                        trees.split_feature[tid], trees.threshold_bin[tid],
+                        trees.default_left[tid], trees.left_child[tid],
+                        trees.right_child[tid], meta.num_bin,
+                        meta.missing_type, meta.default_bin,
+                        max_steps=steps,
+                        cat_flag=(trees.cat_flag[tid] if self.has_cat
+                                  else None),
+                        cat_mask=(trees.cat_mask[tid] if self.has_cat
+                                  else None),
+                        bundle=bundle)
+                    # dried class: zero contribution (matches the training
+                    # score handling)
+                    new_row = jnp.where(trees.num_leaves[tid] > 1, new_row,
+                                        vscore[tid])
+                    vscore = vscore.at[tid].set(new_row)
+                return vscore
+            return upd
+
+        for vi in range(len(self.valid_scores)):
+            bundled = self.valid_data[vi].prebundled is not None
+            if bundled not in self._valid_upd_fns:
+                self._valid_upd_fns[bundled] = make_upd(
+                    self._valid_bundle(vi) if bundled else None)
+            self.valid_scores[vi] = self._valid_upd_fns[bundled](
+                self.valid_scores[vi], self.valid_bins[vi], trees)
 
     def _make_fast_step(self):
         from ..models.frontier2 import grow_tree_fused
@@ -1631,14 +1736,7 @@ class GBDT:
         self._epi_carry = (score2, hist0n, ghT_n)
         self.scores = score2[None, :n]
         trees = jax.tree_util.tree_map(lambda x: jnp.stack([x]), tree)
-        for leaf in jax.tree_util.tree_leaves(trees):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
-        self._pending.append((trees, init_scores))
-        self.iter += 1
-        if len(self._pending) >= self._FAST_SYNC_EVERY:
-            return None
-        return False
+        return self._finish_fast_iter(trees, init_scores)
 
     def _train_one_iter_fast(self) -> bool:
         with timer.section("GBDT::TrainOneIterFast"):
@@ -1680,9 +1778,16 @@ class GBDT:
         self.scores, trees = self._fast_step_fn(
             self.fused_bins_T, self.scores, grad_in, hess_in,
             self.bag_weight, fm_pads)
+        return self._finish_fast_iter(trees, init_scores)
+
+    def _finish_fast_iter(self, trees, init_scores):
+        """Pipelining tail shared by the fast and epilogue iteration
+        bodies: async host copies, in-jit valid updates, pending append,
+        batch-drain signalling."""
         for leaf in jax.tree_util.tree_leaves(trees):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
+        self._update_valid_from_trees(trees)
         self._pending.append((trees, init_scores))
         self.iter += 1
         if len(self._pending) >= self._FAST_SYNC_EVERY:
@@ -1750,6 +1855,9 @@ class GBDT:
                 ht.leaf_value[0] = init_scores[tid]
                 self.scores = self.scores.at[tid].add(
                     float(init_scores[tid]))
+                for vi in range(len(self.valid_scores)):
+                    self.valid_scores[vi] = self.valid_scores[vi] \
+                        .at[tid].add(float(init_scores[tid]))
             for ht, dt, _ in iter_models:
                 if dt is None:
                     dt = _DeviceTree(ht, np.zeros(0, np.int32))
@@ -1768,7 +1876,15 @@ class GBDT:
                 for tid, (_, dt, grew) in enumerate(iter_models):
                     if grew:
                         scores = self._add_tree_to_score(
-                            scores, self.bins_dev, dt, tid, scale=-1.0)
+                            scores, self.bins_dev, dt, tid, scale=-1.0,
+                            bundle=self._train_bundle())
+                        for vi in range(len(self.valid_scores)):
+                            self.valid_scores[vi] = \
+                                self._add_tree_to_score(
+                                    self.valid_scores[vi],
+                                    self.valid_bins[vi], dt, tid,
+                                    scale=-1.0,
+                                    bundle=self._valid_bundle(vi))
             if not self.models:
                 # first-ever iteration stopped outright: the reference
                 # keeps one constant tree per class carrying the init
@@ -1780,6 +1896,11 @@ class GBDT:
                     ht = HostTree(1)
                     ht.leaf_value[0] = init_scores[tid]
                     scores = scores.at[tid].add(float(init_scores[tid]))
+                    for vi in range(len(self.valid_scores)):
+                        # the sync path's constant-tree branch updates the
+                        # valid scorers too (gbdt.cpp:422-441)
+                        self.valid_scores[vi] = self.valid_scores[vi] \
+                            .at[tid].add(float(init_scores[tid]))
                     self.models.append(ht)
                     self.device_trees.append(
                         _DeviceTree(ht, np.zeros(0, np.int32)))
@@ -1869,7 +1990,7 @@ class GBDT:
                         else:
                             self.valid_scores[vi] = self._add_tree_to_score(
                                 self.valid_scores[vi], self.valid_bins[vi],
-                                dt, tid)
+                                dt, tid, bundle=self._valid_bundle(vi))
                     if abs(init_scores[tid]) > K_EPSILON:
                         ht.add_bias(init_scores[tid])
                         dt.leaf_value = jnp.asarray(ht.leaf_value,
@@ -1900,7 +2021,8 @@ class GBDT:
                 dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
                 for vi in range(len(self.valid_scores)):
                     self.valid_scores[vi] = self._add_tree_to_score(
-                        self.valid_scores[vi], self.valid_bins[vi], dt, tid)
+                        self.valid_scores[vi], self.valid_bins[vi], dt, tid,
+                        bundle=self._valid_bundle(vi))
                 if abs(init_scores[tid]) > K_EPSILON:
                     ht.add_bias(init_scores[tid])
                     dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
@@ -1992,30 +2114,51 @@ class GBDT:
             idx = len(self.models) - k + tid
             dt = self.device_trees[idx]
             self.scores = self._add_tree_to_score(
-                self.scores, self.bins_dev, dt, tid, scale=-1.0)
+                self.scores, self.bins_dev, dt, tid, scale=-1.0,
+                bundle=self._train_bundle())
             for vi in range(len(self.valid_scores)):
                 self.valid_scores[vi] = self._add_tree_to_score(
                     self.valid_scores[vi], self.valid_bins[vi], dt, tid,
-                    scale=-1.0)
+                    scale=-1.0, bundle=self._valid_bundle(vi))
         del self.models[-k:]
         del self.device_trees[-k:]
         self.iter -= 1
 
     # ------------------------------------------------------------------
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
-        """All (dataset_name, metric_name, value, is_higher_better) tuples."""
+        """All (dataset_name, metric_name, value, is_higher_better) tuples.
+
+        Metrics with a device formulation evaluate on the live device
+        scores and only their SCALARS cross to host (one batched fetch);
+        the rest pull the score matrix once per dataset (the reference's
+        behavior, gbdt.cpp:519 OutputMetric -> Metric::Eval on host)."""
         out = []
         if self.training_metrics:
-            score = np.asarray(self.scores, np.float64)
-            for m in self.training_metrics:
-                for name, v in zip(m.names, m.eval(score, self.objective)):
-                    out.append(("training", name, v, m.is_bigger_better))
+            out.extend(self.eval_metric_set("training",
+                                            self.training_metrics,
+                                            self.scores))
         for vi, metrics in enumerate(self.valid_metrics):
-            score = np.asarray(self.valid_scores[vi], np.float64)
-            for m in metrics:
-                for name, v in zip(m.names, m.eval(score, self.objective)):
-                    out.append((self.valid_names[vi], name, v,
-                                m.is_bigger_better))
+            out.extend(self.eval_metric_set(self.valid_names[vi], metrics,
+                                            self.valid_scores[vi]))
+        # one batched device->host fetch for every device scalar
+        fetched = jax.device_get([v for (_, _, v, _) in out])
+        return [(d, n, float(v), b)
+                for (d, n, _, b), v in zip(out, fetched)]
+
+    def eval_metric_set(self, ds_name, metrics, score_dev):
+        """Shared device-first metric protocol (also used by
+        Booster._eval_set): values may be 0-d device arrays — the caller
+        batches the host fetch."""
+        out = []
+        host_score = None
+        for m in metrics:
+            vals = m.eval_device(score_dev, self.objective)
+            if vals is None:
+                if host_score is None:
+                    host_score = np.asarray(score_dev, np.float64)
+                vals = m.eval(host_score, self.objective)
+            for name, v in zip(m.names, vals):
+                out.append((ds_name, name, v, m.is_bigger_better))
         return out
 
     def output_metric(self, it: int) -> bool:
@@ -2057,6 +2200,7 @@ class GBDT:
             if not finished:
                 finished = self.output_metric(self.iter)
                 if finished:
+                    self.drain_pending()   # the pop below needs host trees
                     best = min(self.best_iter.values()) \
                         if self.best_iter else self.iter
                     log.info("Early stopping at iteration %d, the best "
@@ -2128,7 +2272,8 @@ class DART(GBDT):
             for tid in range(k):
                 dt = self.device_trees[i * k + tid]
                 self.scores = self._add_tree_to_score(
-                    self.scores, self.bins_dev, dt, tid, scale=-1.0)
+                    self.scores, self.bins_dev, dt, tid, scale=-1.0,
+                    bundle=self._train_bundle())
         nd = len(self.drop_index)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + nd)
@@ -2166,10 +2311,12 @@ class DART(GBDT):
                     for vi in range(len(self.valid_scores)):
                         self.valid_scores[vi] = self._add_tree_to_score(
                             self.valid_scores[vi], self.valid_bins[vi], dt,
-                            tid, scale=-1.0 / (nd + 1.0))
+                            tid, scale=-1.0 / (nd + 1.0),
+                            bundle=self._valid_bundle(vi))
                     self.scores = self._add_tree_to_score(
                         self.scores, self.bins_dev, dt, tid,
-                        scale=nd / (nd + 1.0))
+                        scale=nd / (nd + 1.0),
+                        bundle=self._train_bundle())
                 else:
                     lr = cfg.learning_rate
                     factor = nd / (nd + lr)
@@ -2177,9 +2324,11 @@ class DART(GBDT):
                     for vi in range(len(self.valid_scores)):
                         self.valid_scores[vi] = self._add_tree_to_score(
                             self.valid_scores[vi], self.valid_bins[vi], dt,
-                            tid, scale=-(1.0 - factor))
+                            tid, scale=-(1.0 - factor),
+                            bundle=self._valid_bundle(vi))
                     self.scores = self._add_tree_to_score(
-                        self.scores, self.bins_dev, dt, tid, scale=factor)
+                        self.scores, self.bins_dev, dt, tid, scale=factor,
+                        bundle=self._train_bundle())
                 dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
             if not cfg.uniform_drop:
                 j = i - self.num_init_iteration
@@ -2318,7 +2467,8 @@ class RF(GBDT):
                 dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
                 for vi in range(len(self.valid_scores)):
                     self.valid_scores[vi] = self._add_tree_to_score(
-                        self.valid_scores[vi], self.valid_bins[vi], dt, tid)
+                        self.valid_scores[vi], self.valid_bins[vi], dt, tid,
+                        bundle=self._valid_bundle(vi))
                 self.models.append(ht)
                 self.device_trees.append(dt)
             else:

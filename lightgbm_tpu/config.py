@@ -247,6 +247,10 @@ _PARAMS: List[_Param] = [
     _p("tpu_extra_levels", int, 3, check=(">=", 0),
        desc="extra fused-level passes after the pow2 frontier levels so "
             "skewed trees can spend the remaining leaf budget"),
+    _p("tpu_max_bundle_bins", int, 256, check=(">", 1),
+       desc="bin capacity per EFB bundle column for sparse-built "
+            "datasets (columns fill toward this cap, bounding the "
+            "uniform-width padding of the fused kernel layout)"),
     _p("tpu_fused_epilogue", bool, True,
        desc="fuse final-level routing + score update + gradients + next "
             "root histogram into one kernel pass on the pipelined fast "

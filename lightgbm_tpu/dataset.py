@@ -83,6 +83,48 @@ def _sample_rows(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
 
 
+def _encode_sparse_bundles(csc, mappers, used_features, layout,
+                           most_freq_bins, n: int) -> np.ndarray:
+    """[R, C] bundle-column matrix straight from CSC columns — the dense
+    [R, F] logical matrix is never materialised. Bundle bin 0 = the row is
+    default (most-frequent bin) in every member; conflicts keep the first
+    member's encoding (ops/efb.py contract)."""
+    C = layout.num_columns
+    dtype = np.uint16 if max(layout.col_num_bin) > 255 else np.uint8
+    out = np.zeros((n, C), dtype)
+    for ci, bundle in enumerate(layout.bundles):
+        col = np.zeros(n, np.int64)
+        taken = np.zeros(n, bool)
+        for k in bundle:
+            j = used_features[k]
+            m = mappers[j]
+            off = int(layout.offset_of_feat[k])
+            mfb = int(most_freq_bins[k])
+            lo, hi = csc.indptr[j], csc.indptr[j + 1]
+            rows_j = csc.indices[lo:hi]
+            bins_nz = m.value_to_bin(
+                np.asarray(csc.data[lo:hi], np.float64)).astype(np.int64)
+            zero_bin = int(m.value_to_bin(np.zeros(1))[0])
+            if zero_bin == mfb:
+                # implicit zeros are default: only non-default nonzeros
+                # need storing
+                nd = bins_nz != mfb
+                sel = rows_j[nd]
+                keep = ~taken[sel]
+                col[sel[keep]] = off + bins_nz[nd][keep]
+                taken[sel[keep]] = True
+            else:
+                # zeros bin away from the most-frequent bin (e.g.
+                # zero_as_missing): expand this member densely
+                dense_bins = np.full(n, zero_bin, np.int64)
+                dense_bins[rows_j] = bins_nz
+                sel = np.nonzero((dense_bins != mfb) & ~taken)[0]
+                col[sel] = off + dense_bins[sel]
+                taken[sel] = True
+        out[:, ci] = col.astype(dtype)
+    return out
+
+
 class TpuDataset:
     """The binned training matrix living in (or bound for) TPU HBM.
 
@@ -109,6 +151,9 @@ class TpuDataset:
         self.raw_data: "np.ndarray" = None  # retained for linear trees
         self.missing_types: np.ndarray = np.zeros(0, np.int32)
         self.monotone_constraints: Optional[np.ndarray] = None
+        # sparse-built datasets: ``bins`` holds EFB BUNDLE columns and
+        # this carries the ops.efb.BundleLayout decode (None = logical)
+        self.prebundled = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -201,6 +246,134 @@ class TpuDataset:
             log.check(mc.size == f, "monotone_constraints length mismatch")
             self.monotone_constraints = mc
         return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_sparse(cls, data, config: Config,
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["TpuDataset"] = None,
+                    ) -> "TpuDataset":
+        """Build from a scipy CSR/CSC matrix WITHOUT materialising the
+        dense [R, F] float matrix (ref: the reference's CSR/CSC dataset
+        creation c_api.cpp:398-520 + sparse bin storage sparse_bin.hpp:73).
+
+        The TPU-native storage answer differs from the reference's
+        per-feature sparse bins: mutually-exclusive sparse features are
+        bundled at INGESTION time (EFB, ref: dataset.cpp FindGroups/
+        FastFeatureBundling) and only the [R, n_bundles] bundle-column
+        matrix is ever materialised — histogram/scan work then scales
+        with bundles, matching the role of the reference's MultiValBin.
+        The resulting dataset is 'prebundled': ``bins`` holds BUNDLE
+        columns and ``prebundled`` carries the decode layout.
+        """
+        import scipy.sparse as sp
+
+        from .ops.efb import BundleLayout, find_bundles
+        from .utils.timer import global_timer as timer
+        with timer.section("DatasetLoader::ConstructSparse"):
+            self = cls()
+            csc = sp.csc_matrix(data)
+            csc.sort_indices()
+            n, f = csc.shape
+            self.num_data = n
+            self.num_total_features = f
+            self.feature_names = (list(feature_names) if feature_names
+                                  else [f"Column_{i}" for i in range(f)])
+            self.metadata = Metadata(n)
+
+            if reference is not None:
+                # validation data is only ROUTED (never histogrammed), so
+                # it stores EXACT logical bins: re-encoding through the
+                # train bundles would silently drop conflicting values the
+                # train sample never saw, skewing eval vs predict
+                self.mappers = reference.mappers
+                self.used_features = reference.used_features
+                self._finalize_feature_arrays()
+                dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+                out = np.zeros((n, len(self.used_features)), dtype)
+                for k, j in enumerate(self.used_features):
+                    m = self.mappers[j]
+                    lo, hi = csc.indptr[j], csc.indptr[j + 1]
+                    zero_bin = int(m.value_to_bin(np.zeros(1))[0])
+                    col = np.full(n, zero_bin, dtype)
+                    col[csc.indices[lo:hi]] = m.value_to_bin(
+                        np.asarray(csc.data[lo:hi], np.float64)) \
+                        .astype(dtype)
+                    out[:, k] = col
+                self.bins = out
+                return self
+
+            # ---- sample + per-feature mappers (zeros implicit, like the
+            # dense path / ref dataset_loader.cpp:988); one pass also
+            # collects the sample non-default masks for bundling
+            sample_idx = np.sort(_sample_rows(
+                n, config.bin_construct_sample_cnt, config.data_random_seed))
+            n_sample = len(sample_idx)
+            self.mappers = []
+            sample_masks = []
+
+            def _in_sample(rows_j):
+                # sorted-membership: O(nnz log n_sample) per column, no
+                # per-call re-sorts (np.isin sorts its second arg)
+                pos = np.searchsorted(sample_idx, rows_j)
+                pos_c = np.minimum(pos, n_sample - 1)
+                return (pos < n_sample) & (sample_idx[pos_c] == rows_j), \
+                    pos_c
+
+            for j in range(f):
+                lo, hi = csc.indptr[j], csc.indptr[j + 1]
+                rows_j = csc.indices[lo:hi]
+                vals_j = csc.data[lo:hi]
+                hit, pos = _in_sample(rows_j)
+                nz = np.asarray(vals_j[hit], np.float64)
+                nz = nz[(np.abs(nz) > 1e-35) | np.isnan(nz)]
+                m = BinMapper()
+                m.find_bin(nz, total_sample_cnt=n_sample,
+                           max_bin=config.max_bin,
+                           min_data_in_bin=config.min_data_in_bin,
+                           min_split_data=(config.min_data_in_leaf
+                                           if config.feature_pre_filter
+                                           else 0),
+                           pre_filter=config.feature_pre_filter,
+                           bin_type=BIN_NUMERICAL,
+                           use_missing=config.use_missing,
+                           zero_as_missing=config.zero_as_missing)
+                self.mappers.append(m)
+                if not m.is_trivial:
+                    mask = np.zeros(n_sample, bool)
+                    mask[pos[hit]] = True
+                    sample_masks.append(mask)
+            self.used_features = [j for j in range(f)
+                                  if not self.mappers[j].is_trivial]
+            if not self.used_features:
+                log.warning("There are no meaningful features which "
+                            "satisfy the provided configuration.")
+            self._finalize_feature_arrays()
+
+            # ---- conflict-bounded bundling on the SAMPLE rows (the
+            # reference also bundles from its sample,
+            # dataset_loader.cpp FindGroups call sites)
+            masks = sample_masks
+            nb = [int(x) for x in self.num_bin_per_feat]
+            bundles = find_bundles(
+                masks, n_sample,
+                max_conflict_rate=0.0,
+                max_bundle_bins=int(config.tpu_max_bundle_bins),
+                num_bin_per_feat=nb)
+            layout = BundleLayout(bundles, nb)
+            self.prebundled = layout
+            self.bins = _encode_sparse_bundles(
+                csc, self.mappers, self.used_features, layout,
+                self.most_freq_bins, n)
+            log.info("Sparse EFB: %d used features -> %d bundle columns "
+                     "(max %d bins)", len(self.used_features),
+                     layout.num_columns, max(layout.col_num_bin))
+            if config.monotone_constraints:
+                mc = np.asarray(config.monotone_constraints, dtype=np.int32)
+                log.check(mc.size == f,
+                          "monotone_constraints length mismatch")
+                self.monotone_constraints = mc
+            return self
 
     def _finalize_feature_arrays(self) -> None:
         used = self.used_features

@@ -317,7 +317,8 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
     jax.jit,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat",
-                     "use_mono_bounds", "use_node_masks", "n_forced"))
+                     "use_mono_bounds", "use_node_masks", "n_forced",
+                     "use_bundles", "bundle_col_bins"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -329,6 +330,9 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        forced_leaf: jax.Array = None,
                        forced_feat: jax.Array = None,
                        forced_thr: jax.Array = None,
+                       use_bundles: bool = False,
+                       bundle_cfg: "BundleCfg" = None,
+                       bundle_col_bins: int = 0,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -342,19 +346,31 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     Returns (tree arrays, final row→leaf assignment).
     """
     R, F = bins.shape
+    if use_bundles:
+        # ``bins`` holds EFB bundle columns (ref: src/io/dataset.cpp
+        # feature groups); histograms/scans stay logical via the views
+        assert not has_cat, "EFB with categorical features is unsupported"
+        F = bundle_cfg.flat_idx.shape[0]
     L = num_leaves
     B = max_bins
 
     def _psum(h):
         return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
 
+    def _hist(slot_vec, num_slots):
+        if use_bundles:
+            hb = build_histograms(bins, gh, slot_vec, num_slots=num_slots,
+                                  num_bins=bundle_col_bins, impl=hist_impl)
+            return bundle_views(hb, bundle_cfg)
+        return build_histograms(bins, gh, slot_vec, num_slots=num_slots,
+                                num_bins=B, impl=hist_impl)
+
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
 
     # root histogram: every row targets slot 0
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
-    root_hist = _psum(build_histograms(bins, gh, row_leaf, num_slots=1,
-                                       num_bins=B, impl=hist_impl))
+    root_hist = _psum(_hist(row_leaf, 1))
     pool = pool.at[0].set(root_hist[0])
 
     root_g = jnp.sum(root_hist[0, 0, :, 0])
@@ -486,7 +502,16 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             lil2 = lil.at[l].set(True).at[new].set(False)
 
             # --- partition update (ref: data_partition.hpp Split) ---
-            bins_col = jnp.take(bins, f, axis=1, mode="clip")
+            if use_bundles:
+                f_safe = jnp.maximum(f, 0)
+                raw = jnp.take(bins, bundle_cfg.col_of_feat[f_safe],
+                               axis=1, mode="clip").astype(jnp.int32)
+                off = bundle_cfg.offset_of_feat[f_safe]
+                in_win = (raw >= off) & (raw < off + meta.num_bin[f_safe])
+                bins_col = jnp.where(in_win, raw - off,
+                                     bundle_cfg.default_bin[f_safe])
+            else:
+                bins_col = jnp.take(bins, f, axis=1, mode="clip")
             go_left = _route_left(bins_col, t, dl, meta.num_bin[f],
                                   meta.missing_type[f], meta.default_bin[f])
             if has_cat:
@@ -500,8 +525,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             target_is_left = bsl.left_count <= bsl.right_count
             target_leaf = jnp.where(target_is_left, l, new)
             slot = jnp.where(row_leaf2 == target_leaf, 0, -1)
-            hist_t = _psum(build_histograms(bins, gh, slot, num_slots=1,
-                                            num_bins=B, impl=hist_impl))[0]
+            hist_t = _psum(_hist(slot, 1))[0]
             hist_sib = pool[l] - hist_t
             pool2 = pool.at[l].set(jnp.where(target_is_left, hist_t, hist_sib))
             pool2 = pool2.at[new].set(jnp.where(target_is_left, hist_sib,

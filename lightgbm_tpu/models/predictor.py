@@ -121,12 +121,24 @@ class DevicePredictor:
 
     def predict_raw(self, X: np.ndarray, lo: int, hi: int,
                     chunk_rows: int = 2_000_000) -> np.ndarray:
-        """Sum of leaf values of trees [lo, hi) per class, [k, R] float32."""
+        """Sum of leaf values of trees [lo, hi) per class, [k, R] float32.
+
+        scipy sparse input is densified PER CHUNK (prediction routes on
+        logical bins regardless of the training-side bundle storage)."""
+        try:
+            import scipy.sparse as sp
+            sparse_in = sp.issparse(X)
+        except ImportError:  # pragma: no cover
+            sparse_in = False
+        if sparse_in:
+            X = X.tocsr()
+            chunk_rows = min(chunk_rows, 262_144)
         n = X.shape[0]
         out = np.zeros((self.k, n), np.float64)
         for c0 in range(0, n, chunk_rows):
             sl = slice(c0, min(n, c0 + chunk_rows))
-            bins = jnp.asarray(self._bin_rows(X[sl]))
+            Xc = X[sl].toarray() if sparse_in else X[sl]
+            bins = jnp.asarray(self._bin_rows(Xc))
             raw = self._predict_chunk(bins, lo, hi)
             out[:, sl] = np.asarray(raw, np.float64)
         return out

@@ -97,6 +97,12 @@ class ObjectiveFunction:
     def gradients_from(self, score, operands) -> Tuple:
         raise NotImplementedError
 
+    def convert_output_jnp(self, raw):
+        """Traced (jnp) analog of convert_output for on-device metric
+        evaluation, or None when no device form exists (those metrics
+        fall back to the host numpy path)."""
+        return None
+
     def epilogue_spec(self):
         """(kind, (row0, row1), sigmoid) for the fused boosting-epilogue
         kernel (ops/fused_level.epilogue_pass), which re-derives the

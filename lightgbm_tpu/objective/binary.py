@@ -102,6 +102,9 @@ class BinaryLogloss(ObjectiveFunction):
     def convert_output(self, raw):
         return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
 
+    def convert_output_jnp(self, raw):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * raw))
+
     def to_string(self):
         return f"{self.name} sigmoid:{self.sigmoid:g}"
 

@@ -72,6 +72,18 @@ class RegressionL2Loss(ObjectiveFunction):
             return np.sign(raw) * raw * raw
         return raw
 
+    def convert_output_jnp(self, raw):
+        # valid for any subclass whose effective convert_output is the one
+        # defined HERE (poisson/gamma/tweedie override it with exp)
+        for k in type(self).__mro__:
+            if "convert_output" in k.__dict__:
+                if k is not RegressionL2Loss:
+                    return None
+                break
+        if self.sqrt:
+            return jnp.sign(raw) * raw * raw
+        return raw
+
     def to_string(self):
         return self.name + (" sqrt" if self.sqrt else "")
 

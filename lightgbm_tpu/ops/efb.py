@@ -32,49 +32,68 @@ import numpy as np
 def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
                  max_conflict_rate: float = 0.0001,
                  max_bundle_bins: int = 65535,
-                 num_bin_per_feat: Sequence[int] = None
-                 ) -> List[List[int]]:
+                 num_bin_per_feat: Sequence[int] = None,
+                 max_search_bundles: int = 64) -> List[List[int]]:
     """Greedy conflict-bounded bundling (ref: dataset.cpp FindGroups).
 
     Args:
       nondefault_masks: per-feature boolean [R] arrays (True where the row
         is NOT in the feature's most-frequent bin).
       max_conflict_rate: allowed fraction of rows in conflict per bundle.
+      max_search_bundles: candidate bundles tried per feature before a new
+        one opens (the reference's FindGroups bounds its search the same
+        way, max_find_group cap) — keeps the greedy near-linear on
+        many-thousand-feature sparse data.
 
     Returns a list of bundles (lists of feature indices). Dense features
-    end up in singleton bundles.
+    end up in singleton bundles. Conflict masks are packed uint64 bitsets
+    so each probe is a popcount over R/64 words, not R bools.
     """
     F = len(nondefault_masks)
-    order = sorted(range(F),
-                   key=lambda f: int(nondefault_masks[f].sum()),
-                   reverse=True)
+    counts = [int(m.sum()) for m in nondefault_masks]
+    order = sorted(range(F), key=lambda f: counts[f], reverse=True)
     budget = int(max_conflict_rate * num_rows)
+    words = (num_rows + 63) // 64
+
+    def pack(m):
+        return np.packbits(m, bitorder="little")[: words * 8] \
+            .copy().view(np.uint64) if len(m) else np.zeros(0, np.uint64)
+
     bundle_masks: List[np.ndarray] = []
     bundle_conflicts: List[int] = []
     bundle_bins: List[int] = []
     bundles: List[List[int]] = []
     nb = num_bin_per_feat
     for f in order:
-        m = nondefault_masks[f]
-        nnz = int(m.sum())
+        nnz = counts[f]
         f_bins = int(nb[f]) if nb is not None else 1
         placed = False
+        packed = None
         # skip bundling for dense features (no savings, conflicts certain)
         if nnz * 2 < num_rows:
-            for bi in range(len(bundles)):
+            packed = pack(np.pad(nondefault_masks[f],
+                                 (0, words * 64 - num_rows)))
+            # most-recent bundles first: they are the least full
+            cand = range(len(bundles) - 1,
+                         max(-1, len(bundles) - 1 - max_search_bundles), -1)
+            for bi in cand:
                 if bundle_bins[bi] + f_bins > max_bundle_bins:
                     continue  # keep the encoded bin range in dtype bounds
-                conflicts = int((bundle_masks[bi] & m).sum())
+                conflicts = int(np.bitwise_count(
+                    bundle_masks[bi] & packed).sum())
                 if bundle_conflicts[bi] + conflicts <= budget:
                     bundles[bi].append(f)
-                    bundle_masks[bi] = bundle_masks[bi] | m
+                    bundle_masks[bi] |= packed
                     bundle_conflicts[bi] += conflicts
                     bundle_bins[bi] += f_bins
                     placed = True
                     break
         if not placed:
+            if packed is None:
+                packed = pack(np.pad(nondefault_masks[f],
+                                     (0, words * 64 - num_rows)))
             bundles.append([f])
-            bundle_masks.append(m.copy())
+            bundle_masks.append(packed.copy())
             bundle_conflicts.append(0)
             bundle_bins.append(1 + f_bins)
     return bundles

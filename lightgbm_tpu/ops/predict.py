@@ -27,7 +27,8 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
                          num_bin: jax.Array, missing_type: jax.Array,
                          default_bin: jax.Array, max_steps: int,
                          cat_flag: jax.Array = None,
-                         cat_mask: jax.Array = None) -> jax.Array:
+                         cat_mask: jax.Array = None,
+                         bundle: tuple = None) -> jax.Array:
     """Leaf index per row for one tree (arrays follow the TreeArrays
     convention: child >= 0 internal node, child < 0 means ~leaf).
 
@@ -35,6 +36,11 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
     are handled by the caller (leaf 0 for every row).
     ``cat_flag``/``cat_mask`` ([N], [N, B]) enable categorical bitset
     decisions (ref: tree.h CategoricalDecision on bin space).
+    ``bundle``: (col_of_feat, offset_of_feat, most_freq_bin) when ``bins``
+    holds EFB BUNDLE columns (sparse-built datasets) — the logical bin is
+    decoded per node: in-window values shift by the feature's offset,
+    out-of-window rows are bundle-default and carry the feature's most
+    frequent bin (ops/efb.py encoding).
     """
     R = bins.shape[0]
     node = jnp.zeros((R,), jnp.int32)
@@ -43,8 +49,17 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
         is_internal = node >= 0
         nd = jnp.maximum(node, 0)
         f = split_feature[nd]
-        b = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
-                                axis=1)[:, 0].astype(jnp.int32)
+        if bundle is None:
+            b = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
+                                    axis=1)[:, 0].astype(jnp.int32)
+        else:
+            col_of_feat, offset_of_feat, mfb = bundle
+            raw = jnp.take_along_axis(
+                bins, col_of_feat[f][:, None].astype(jnp.int32),
+                axis=1)[:, 0].astype(jnp.int32)
+            off = offset_of_feat[f]
+            in_win = (raw >= off) & (raw < off + num_bin[f])
+            b = jnp.where(in_win, raw - off, mfb[f])
         go_left = _route_left(b, threshold_bin[nd], default_left[nd],
                               num_bin[f], missing_type[f], default_bin[f])
         if cat_flag is not None:
@@ -64,10 +79,12 @@ def add_tree_score(score: jax.Array, bins: jax.Array, leaf_value: jax.Array,
                    right_child: jax.Array, num_bin: jax.Array,
                    missing_type: jax.Array, default_bin: jax.Array,
                    max_steps: int, cat_flag: jax.Array = None,
-                   cat_mask: jax.Array = None) -> jax.Array:
+                   cat_mask: jax.Array = None,
+                   bundle: tuple = None) -> jax.Array:
     """score += leaf_value[route(row)] in one fused pass."""
     leaves = route_rows_to_leaves(bins, split_feature, threshold_bin,
                                   default_left, left_child, right_child,
                                   num_bin, missing_type, default_bin,
-                                  max_steps, cat_flag, cat_mask)
+                                  max_steps, cat_flag, cat_mask,
+                                  bundle=bundle)
     return score + leaf_value[leaves]

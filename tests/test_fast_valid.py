@@ -1,0 +1,133 @@
+"""Valid sets on the pipelined fast path (round 3, VERDICT r2 weak #3):
+valid-score updates run in-jit from device TreeArrays and metric eval
+pulls scalars — the fast path must no longer be disabled by valid sets,
+and results must match the synchronous path exactly (interpret mode)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(9)
+    n = 4000
+    X = rng.randn(n, 10)
+    X[rng.rand(n, 10) < 0.04] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 1]) ** 2
+         > 0.3).astype(np.float32)
+    return X[:3000], y[:3000], X[3000:], y[3000:]
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+        "tpu_engine": "fused", "metric": ["auc", "binary_logloss"]}
+
+
+def _run(data, extra, rounds=25, es=None):
+    Xt, yt, Xv, yv = data
+    ds = lgb.Dataset(Xt, label=yt)
+    dv = lgb.Dataset(Xv, label=yv, reference=ds)
+    rec = {}
+    cbs = [lgb.record_evaluation(rec)]
+    if es:
+        cbs.append(lgb.early_stopping(es, verbose=False))
+    bst = lgb.train(dict(BASE, **extra), ds, num_boost_round=rounds,
+                    valid_sets=[dv], valid_names=["v"], callbacks=cbs)
+    return bst, rec
+
+
+def test_fast_path_stays_on_with_valid(data):
+    bst, _ = _run(data, {})
+    assert bst._gbdt._fast_path_ok()
+    assert bst._gbdt._use_epilogue()
+
+
+def test_valid_traces_match_unfused_path(data):
+    _, rec_fast = _run(data, {})
+    _, rec_off = _run(data, {"tpu_fused_epilogue": False})
+    np.testing.assert_allclose(rec_fast["v"]["auc"], rec_off["v"]["auc"],
+                               atol=2e-6)
+    np.testing.assert_allclose(rec_fast["v"]["binary_logloss"],
+                               rec_off["v"]["binary_logloss"], atol=2e-6)
+
+
+def test_device_metrics_match_host_metrics(data):
+    Xt, yt, Xv, yv = data
+    bst, rec = _run(data, {})
+    from sklearn.metrics import log_loss, roc_auc_score
+    p = bst.predict(Xv)
+    assert abs(rec["v"]["auc"][-1] - roc_auc_score(yv, p)) < 1e-5
+    assert abs(rec["v"]["binary_logloss"][-1] - log_loss(yv, p)) < 1e-5
+
+
+def test_early_stopping_fires_on_fast_path(data):
+    # flip 35% of the valid labels so the valid metric degrades and ES
+    # actually fires (the pop path needs drained host trees)
+    Xt, yt, Xv, yv = data
+    rng = np.random.RandomState(0)
+    yv2 = yv.copy()
+    flip = rng.rand(len(yv2)) < 0.35
+    yv2[flip] = 1 - yv2[flip]
+    bst, rec = _run((Xt, yt, Xv, yv2), {"learning_rate": 0.3}, rounds=60,
+                    es=3)
+    assert 0 < bst.best_iteration < 60
+    # stock LightGBM keeps the overrun trees; predict defaults to
+    # best_iteration
+    assert bst.num_trees() >= bst.best_iteration
+    b_off, rec_off = _run((Xt, yt, Xv, yv2),
+                          {"learning_rate": 0.3,
+                           "tpu_fused_epilogue": False}, rounds=60, es=3)
+    assert bst.best_iteration == b_off.best_iteration
+
+
+def test_multiclass_valid_on_fast_path(data):
+    Xt, yt, Xv, yv = data
+    rng = np.random.RandomState(4)
+    y3t = (rng.rand(len(yt)) * 3).astype(int)
+    y3v = (rng.rand(len(yv)) * 3).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1, "tpu_engine": "fused",
+              "metric": "multi_logloss"}
+    ds = lgb.Dataset(Xt, label=y3t)
+    dv = lgb.Dataset(Xv, label=y3v, reference=ds)
+    rec = {}
+    bst = lgb.train(params, ds, num_boost_round=5, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(rec)])
+    assert bst._gbdt._fast_path_ok()   # multiclass: fast path, no epilogue
+    assert not bst._gbdt._use_epilogue()
+    # the recorded (device-evaluated) final metric must match the metric
+    # computed from a fresh host predict of the same model
+    from sklearn.metrics import log_loss
+    p = bst.predict(Xv)
+    assert abs(rec["valid_0"]["multi_logloss"][-1]
+               - log_loss(y3v, p, labels=[0, 1, 2])) < 1e-5
+    # cross-engine (bf16-hi/lo fused vs f32 XLA) only agrees to ~1e-4
+    ds2 = lgb.Dataset(Xt, label=y3t)
+    dv2 = lgb.Dataset(Xv, label=y3v, reference=ds2)
+    rec2 = {}
+    lgb.train(dict(params, tpu_engine="xla", grow_policy="depthwise"),
+              ds2, num_boost_round=5, valid_sets=[dv2],
+              callbacks=[lgb.record_evaluation(rec2)])
+    np.testing.assert_allclose(rec["valid_0"]["multi_logloss"],
+                               rec2["valid_0"]["multi_logloss"], atol=5e-4)
+
+
+def test_no_split_stop_rolls_back_valid_scores(data):
+    # min_data so large that training dries up mid-batch: the deferred
+    # stop must subtract the discarded iterations from VALID scores too
+    Xt, yt, Xv, yv = data
+    ds = lgb.Dataset(Xt, label=yt)
+    dv = lgb.Dataset(Xv, label=yv, reference=ds)
+    rec = {}
+    bst = lgb.train(dict(BASE, min_gain_to_split=60.0, learning_rate=0.3),
+                    ds, num_boost_round=40, valid_sets=[dv],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(rec)])
+    n_kept = bst.num_trees()
+    assert n_kept < 40
+    # the final valid score must equal a fresh replay of the kept model
+    import jax.numpy as jnp
+    g = bst._gbdt
+    replay = np.asarray(bst.predict(Xv, raw_score=True))
+    np.testing.assert_allclose(np.asarray(g.valid_scores[0][0]), replay,
+                               atol=1e-4)
