@@ -440,6 +440,10 @@ class Booster:
             self._gbdt.drain_pending()
 
     def current_iteration(self) -> int:
+        """Iterations trained so far. PROVISIONAL under the pipelined
+        driver: queued-but-undrained iterations count, and a later drain
+        may discard some of them via the deferred no-split stop — poll
+        num_trees() (which drains) for a settled count."""
         return self._gbdt.iter if self._gbdt is not None else \
             len(self.models) // max(1, self.num_tree_per_iteration)
 

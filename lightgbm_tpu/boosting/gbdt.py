@@ -630,6 +630,7 @@ class GBDT:
             if grow is grow_tree_leafwise:
                 kw = {k: v for k, v in kw.items()
                       if k not in ("parallel_mode", "top_k")}
+                kw["mono_mode"] = getattr(self, "mono_mode", "basic")
                 if n_forced:
                     kw.update(n_forced=n_forced,
                               forced_leaf=self.forced_leaf,
@@ -730,6 +731,24 @@ class GBDT:
             log.info("the frontier-v1 engine has no multi-chip path; "
                      "using the fused engine")
             engine = "fused"
+        # intermediate/advanced monotone modes need the stale-leaf
+        # recompute, implemented on the leaf-wise grower (the reference
+        # implements them in SerialTreeLearner too,
+        # monotone_constraints.hpp:514,856)
+        self.mono_mode = "basic"
+        if getattr(self, "use_mono_bounds", False):
+            method = str(self.config.monotone_constraints_method)
+            if method == "advanced":
+                log.warning("monotone_constraints_method=advanced is not "
+                            "implemented; using intermediate")
+                method = "intermediate"
+            if method == "intermediate":
+                self.mono_mode = "intermediate"
+                if engine != "xla" and self.parallel_mode in ("serial",
+                                                              "data"):
+                    log.info("monotone_constraints_method=intermediate "
+                             "runs on the leaf-wise XLA grower")
+                    engine = "xla"
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
             engine = "xla"
@@ -768,6 +787,13 @@ class GBDT:
             log.warning("tree_learner=%s is implemented on the depthwise "
                         "grower; switching grow_policy", self.parallel_mode)
             self.grow_policy = "depthwise"
+        if self.mono_mode == "intermediate":
+            if self.grow_policy != "leafwise" \
+                    or self.parallel_mode in ("voting", "feature"):
+                log.warning("the intermediate monotone recompute runs on "
+                            "the leaf-wise grower; this configuration "
+                            "enforces the basic mode instead")
+                self.mono_mode = "basic"
         if getattr(self, "use_cegb", False) \
                 and self.grow_policy != "depthwise":
             log.warning("CEGB is implemented on the depthwise grower; "
@@ -775,7 +801,11 @@ class GBDT:
             self.grow_policy = "depthwise"
         if getattr(self, "use_bundles", False) \
                 and getattr(self, "n_forced", 0) > 0:
-            # (prebundled datasets already fatal'd in _setup_bundles)
+            if getattr(self.train_data, "prebundled", None) is not None:
+                # reset_config can reach here after init: the bundle
+                # matrix IS the storage — it cannot be switched off
+                log.fatal("forced splits are not supported on sparse-"
+                          "built (prebundled) datasets")
             log.warning("forced splits disable feature bundling")
             self.use_bundles = False
         if getattr(self, "n_forced", 0) > 0 \
@@ -1181,7 +1211,8 @@ class GBDT:
             forced_thr=self.forced_thr if n_forced else None,
             use_bundles=ub,
             bundle_cfg=self.bundle_cfg if ub else None,
-            bundle_col_bins=(self.bundle_col_bins if ub else 0))
+            bundle_col_bins=(self.bundle_col_bins if ub else 0),
+            mono_mode=getattr(self, "mono_mode", "basic"))
 
     def _node_masks_for_iter(self):
         """Per-tree bynode randomness: fold the boosting iteration into the

@@ -64,21 +64,23 @@ def reset_parameter(**kwargs: Union[list, Callable[[int], Any]]):
     ``reset_parameter(learning_rate=lambda i: 0.1 * 0.99 ** i)``
     (ref: callback.py:147)."""
     def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
+        it = env.iteration - env.begin_iteration
+        n_rounds = env.end_iteration - env.begin_iteration
+        updates = {}
+        for name, schedule in kwargs.items():
+            if isinstance(schedule, list):
+                if len(schedule) != n_rounds:
                     raise ValueError(
-                        f"Length of list {key!r} has to equal to "
-                        "'num_boost_round'.")
-                new_param = value[env.iteration - env.begin_iteration]
+                        f"the schedule list for {name!r} needs one entry "
+                        f"per boosting round ({n_rounds})")
+                target = schedule[it]
             else:
-                new_param = value(env.iteration - env.begin_iteration)
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
-            env.params.update(new_parameters)
+                target = schedule(it)
+            if env.params.get(name) != target:
+                updates[name] = target
+        if updates:
+            env.model.reset_parameter(updates)
+            env.params.update(updates)
     _callback.before_iteration = True
     _callback.order = 10
     return _callback
@@ -111,29 +113,31 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             log.info("Training until validation scores don't improve for %d "
                      "rounds", stopping_rounds)
 
+        # min_delta broadcast: a scalar applies everywhere; a list gives
+        # one threshold per metric, tiled across datasets
         n_metrics = len({m[1] for m in env.evaluation_result_list})
         n_datasets = len(env.evaluation_result_list) // max(1, n_metrics)
+        n_slots = n_datasets * n_metrics
         if isinstance(min_delta, list):
-            if not all(t >= 0 for t in min_delta):
-                raise ValueError(
-                    "Values for early stopping min_delta must be non-negative")
+            if any(t < 0 for t in min_delta):
+                raise ValueError("early stopping min_delta entries must "
+                                 "be >= 0")
             if len(min_delta) == 0:
-                deltas = [0.0] * n_datasets * n_metrics
+                deltas = [0.0] * n_slots
             elif len(min_delta) == 1:
-                deltas = min_delta * n_datasets * n_metrics
-            else:
-                if len(min_delta) != n_metrics:
-                    raise ValueError(
-                        "Must provide a single value for min_delta or as many "
-                        "as metrics")
+                deltas = list(min_delta) * n_slots
+            elif len(min_delta) == n_metrics:
                 if first_metric_only and verbose:
-                    log.info("Using only %s for early stopping", min_delta[0])
-                deltas = min_delta * n_datasets
+                    log.info("Using only %s for early stopping",
+                             min_delta[0])
+                deltas = list(min_delta) * n_datasets
+            else:
+                raise ValueError("min_delta takes a scalar, a 1-element "
+                                 "list, or one value per metric")
         else:
             if min_delta < 0:
-                raise ValueError(
-                    "Early stopping min_delta must be non-negative")
-            deltas = [min_delta] * n_datasets * n_metrics
+                raise ValueError("early stopping min_delta must be >= 0")
+            deltas = [min_delta] * n_slots
 
         first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
         for eval_ret, delta in zip(env.evaluation_result_list, deltas):

@@ -60,7 +60,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if isinstance(init_model, str):
         predictor = Booster(model_file=init_model)
     elif isinstance(init_model, Booster):
-        predictor = Booster(model_str=init_model.model_to_string())
+        # num_iteration=-1: continuation must see EVERY tree, including
+        # the post-best overrun of an early-stopped init_model (the
+        # default would truncate to best_iteration)
+        predictor = Booster(model_str=init_model.model_to_string(
+            num_iteration=-1))
     if predictor is not None and train_set.init_score is None:
         raw = predictor.predict(train_set.data, raw_score=True)
         train_set.set_init_score(np.asarray(raw).reshape(-1, order="F"))
@@ -114,7 +118,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
             # periodic checkpoint (ref: gbdt.cpp:279-283 SaveModelToFile
             # snapshot_out); the text model is the checkpoint format
-            booster.save_model(f"{snapshot_base}.snapshot_iter_{i + 1}")
+            # snapshots are resume checkpoints: keep the full model
+            booster.save_model(f"{snapshot_base}.snapshot_iter_{i + 1}",
+                               num_iteration=-1)
 
         evaluation_result_list = []
         if valid_sets is not None or feval is not None:

@@ -40,15 +40,24 @@ def _label_spec(label_column, header_names):
 
 
 def load_text_file(path: str, label_column=None, rank: int = 0,
-                   num_machines: int = 1
+                   num_machines: int = 1, force_header: bool = None
                    ) -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
     """Parse a CSV/TSV/LibSVM file -> (X, label, sidecars).
 
     sidecars: {"weight": arr?, "group": arr?, "init_score": arr?}
+    ``force_header`` overrides the auto-detection (the reference's
+    ``has_header`` flag — an all-numeric header line would otherwise be
+    misread as a data row).
     """
     if not os.path.exists(path):
         raise FileNotFoundError(path)
     sep, n_rows, n_cols, is_libsvm, has_header = native.scan(path)
+    if force_header is not None and bool(force_header) != bool(has_header):
+        if force_header and not has_header:
+            n_rows -= 1   # the scan counted the numeric header as data
+        elif has_header and not force_header:
+            n_rows += 1
+        has_header = bool(force_header)
     if n_rows == 0:
         raise ValueError(f"no data rows in {path}")
 

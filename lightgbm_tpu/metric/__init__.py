@@ -615,13 +615,17 @@ def _xent(label, prob):
     return -(label * np.log(p) + (1.0 - label) * np.log(1.0 - p))
 
 
+def _stable_sigmoid(s):
+    # saturated raw scores overflow np.exp and spray RuntimeWarnings
+    # (the reference xentropy metric clamps the same way)
+    return 1.0 / (1.0 + np.exp(-np.clip(s, -500.0, 500.0)))
+
+
 class CrossEntropyMetric(Metric):
     names = ["cross_entropy"]
 
     def eval(self, score, objective):
-        s = score[0]
-        sig = 1.0 / (1.0 + np.exp(-s))
-        pt = _xent(self.label, sig)
+        pt = _xent(self.label, _stable_sigmoid(score[0]))
         if self.weight is not None:
             return [float(np.sum(pt * self.weight) / self.sum_weights)]
         return [float(np.sum(pt) / self.sum_weights)]
@@ -634,7 +638,7 @@ class CrossEntropyLambdaMetric(Metric):
         # ref: xentropy_metric.hpp:196-226 — loss in the lambda parameterization
         s = score[0]
         w = self.weight if self.weight is not None else 1.0
-        hhat = np.log1p(np.exp(s))
+        hhat = np.logaddexp(0.0, s)   # log(1+e^s) without overflow
         z = 1.0 - np.exp(-w * hhat)
         z = np.clip(z, K_EPSILON, 1.0 - K_EPSILON)
         pt = _xent(self.label, z)
@@ -649,7 +653,10 @@ class KullbackLeiblerDivergence(Metric):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        lab = np.clip(self.label, K_EPSILON, 1.0 - K_EPSILON)
+        # float64 before the clip: a float32 label rounds 1 - 1e-15 back
+        # to exactly 1.0 and log(1 - lab) would emit divide-by-zero
+        lab = np.clip(np.asarray(self.label, np.float64), K_EPSILON,
+                      1.0 - K_EPSILON)
         ent = -(self.label * np.log(lab)
                 + (1.0 - self.label) * np.log(1.0 - lab))
         # entropy is zero for hard 0/1 labels
@@ -662,8 +669,7 @@ class KullbackLeiblerDivergence(Metric):
 
     def eval(self, score, objective):
         s = score[0]
-        sig = 1.0 / (1.0 + np.exp(-s))
-        pt = _xent(self.label, sig)
+        pt = _xent(self.label, _stable_sigmoid(s))
         if self.weight is not None:
             xent = float(np.sum(pt * self.weight) / self.sum_weights)
         else:

@@ -229,17 +229,21 @@ def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,
 
 def mono_child_bounds(lo, hi, new_lo, new_hi, sel, mono_dir,
                       left_output, right_output, left_idx, new_idx):
-    """Per-leaf monotone bound update at split time (ref:
-    monotone_constraints.hpp:546-556 UpdateConstraintsWithOutputs):
-    m>0: left.upper <- min(., right_out), right.lower <- max(., left_out);
-    m<0 mirrored. Non-monotone splits pass bounds through. All arrays [L];
-    ``sel`` masks the leaves actually split this step."""
+    """Per-leaf monotone bound update at split time — the reference's
+    BASIC rule (ref: monotone_constraints.hpp:488-500
+    BasicLeafConstraints::Update): both children are fenced at
+    mid = (left_out + right_out)/2, which guarantees every later leaf in
+    the left subtree stays <= mid <= every leaf in the right subtree
+    (raw-output fences permit cross-subtree violations — caught in
+    round 3). m<0 mirrored; non-monotone splits pass bounds through.
+    All arrays [L]; ``sel`` masks the leaves actually split this step."""
     par_lo = lo[left_idx] if left_idx is not None else lo
     par_hi = hi[left_idx] if left_idx is not None else hi
-    l_hi = jnp.where(mono_dir > 0, jnp.minimum(par_hi, right_output), par_hi)
-    l_lo = jnp.where(mono_dir < 0, jnp.maximum(par_lo, right_output), par_lo)
-    r_lo = jnp.where(mono_dir > 0, jnp.maximum(par_lo, left_output), par_lo)
-    r_hi = jnp.where(mono_dir < 0, jnp.minimum(par_hi, left_output), par_hi)
+    mid = 0.5 * (left_output + right_output)
+    l_hi = jnp.where(mono_dir > 0, jnp.minimum(par_hi, mid), par_hi)
+    l_lo = jnp.where(mono_dir < 0, jnp.maximum(par_lo, mid), par_lo)
+    r_lo = jnp.where(mono_dir > 0, jnp.maximum(par_lo, mid), par_lo)
+    r_hi = jnp.where(mono_dir < 0, jnp.minimum(par_hi, mid), par_hi)
     lo2 = _masked_scatter(new_lo, left_idx, l_lo, sel)         if left_idx is not None else jnp.where(sel, l_lo, new_lo)
     hi2 = _masked_scatter(new_hi, left_idx, l_hi, sel)         if left_idx is not None else jnp.where(sel, l_hi, new_hi)
     lo2 = _masked_scatter(lo2, new_idx, r_lo, sel)
@@ -318,7 +322,7 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat",
                      "use_mono_bounds", "use_node_masks", "n_forced",
-                     "use_bundles", "bundle_col_bins"))
+                     "use_bundles", "bundle_col_bins", "mono_mode"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -333,6 +337,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        use_bundles: bool = False,
                        bundle_cfg: "BundleCfg" = None,
                        bundle_col_bins: int = 0,
+                       mono_mode: str = "basic",
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -385,6 +390,13 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
     leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
     leaf_groups = jnp.full((L,), -1, jnp.int32)
+    # intermediate monotone mode tracks per-leaf axis-aligned bin regions
+    # [lo, hi) so bound tightening can reach non-sibling leaves
+    # (ref: monotone_constraints.hpp:514 IntermediateLeafConstraints)
+    inter = use_mono_bounds and mono_mode == "intermediate"
+    reg_lo = jnp.zeros((L, F), jnp.int32)
+    reg_hi = jnp.broadcast_to(meta.num_bin[None, :], (L, F)) \
+        .astype(jnp.int32)
 
     def _scan_mask(lg_rows, node_ids):
         m = feature_mask[None, :] if feature_mask.ndim == 1 else feature_mask
@@ -411,7 +423,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
     def body(i, state):
         (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
-         leaf_groups) = state
+         leaf_groups, reg_lo, reg_hi) = state
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         l = jnp.argmax(gains).astype(jnp.int32)
@@ -449,7 +461,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
         def split_branch(op):
             (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
-             leaf_groups) = op
+             leaf_groups, reg_lo, reg_hi) = op
             new = tree.num_leaves
             f = best.feature[l]
             t = best.threshold[l]
@@ -532,21 +544,30 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                                                 hist_t))
 
             # --- monotone bound propagation for the two children ---
+            # basic: both children fenced at mid=(l+r)/2 (ref:
+            # BasicLeafConstraints::Update, monotone_constraints.hpp:488)
+            # — the fence is what guarantees left-subtree <= mid <=
+            # right-subtree for every later descendant.
+            # intermediate: raw-output fences (UpdateConstraintsWithOutputs
+            # :544) — looser, compensated by the cross-tree tightening +
+            # stale-leaf recompute below.
             if use_mono_bounds:
                 mono_d = jnp.where(f >= 0, meta.monotone[jnp.maximum(f, 0)],
                                    0)
                 p_lo, p_hi = leaf_lo[l], leaf_hi[l]
-                l_hi = jnp.where(mono_d > 0,
-                                 jnp.minimum(p_hi, bsl.right_output),
+                if inter:
+                    fence_l = bsl.right_output   # raw opposite outputs
+                    fence_r = bsl.left_output
+                else:
+                    fence_l = fence_r = 0.5 * (bsl.left_output
+                                               + bsl.right_output)
+                l_hi = jnp.where(mono_d > 0, jnp.minimum(p_hi, fence_l),
                                  p_hi)
-                l_lo = jnp.where(mono_d < 0,
-                                 jnp.maximum(p_lo, bsl.right_output),
+                l_lo = jnp.where(mono_d < 0, jnp.maximum(p_lo, fence_l),
                                  p_lo)
-                r_lo = jnp.where(mono_d > 0,
-                                 jnp.maximum(p_lo, bsl.left_output),
+                r_lo = jnp.where(mono_d > 0, jnp.maximum(p_lo, fence_r),
                                  p_lo)
-                r_hi = jnp.where(mono_d < 0,
-                                 jnp.minimum(p_hi, bsl.left_output),
+                r_hi = jnp.where(mono_d < 0, jnp.minimum(p_hi, fence_r),
                                  p_hi)
                 leaf_lo2 = leaf_lo.at[l].set(l_lo).at[new].set(r_lo)
                 leaf_hi2 = leaf_hi.at[l].set(l_hi).at[new].set(r_hi)
@@ -577,17 +598,133 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 leaf_depth=jnp.stack([tree2.leaf_depth[l],
                                       tree2.leaf_depth[new]]))
             best2 = _merge_best(best, l, new, bs2)
+
+            # --- intermediate mode: region cut + cross-tree tightening +
+            # stale-leaf best-split recompute (ref:
+            # monotone_constraints.hpp:514-720 Update/GoUp/GoDown,
+            # serial_tree_learner.cpp:706-714). Regions make the
+            # reference's up-and-down contiguity walk a vectorized
+            # adjacency test: leaf q is constrained by new child c on
+            # monotone feature g when their regions overlap on every
+            # other feature and q lies strictly beyond c on g.
+            reg_lo2, reg_hi2 = reg_lo, reg_hi
+            if inter:
+                is_num = ~cf
+                parent_lo = reg_lo[l]
+                parent_hi = reg_hi[l]
+                fs = jnp.maximum(f, 0)
+                l_hi_r = parent_hi.at[fs].set(
+                    jnp.where(is_num, t + 1, parent_hi[fs]))
+                n_lo_r = parent_lo.at[fs].set(
+                    jnp.where(is_num, t + 1, parent_lo[fs]))
+                # BOTH region coordinates of the fresh slot must be
+                # written — its stored values are the init placeholder
+                reg_lo2 = reg_lo.at[new].set(n_lo_r)
+                reg_hi2 = reg_hi.at[new].set(parent_hi).at[l].set(l_hi_r)
+
+                c_lo = jnp.stack([parent_lo, n_lo_r])           # [2, F]
+                c_hi = jnp.stack([l_hi_r, parent_hi])
+                d = meta.monotone[None, None, :]
+                active = jnp.arange(L) < tree.num_leaves
+
+                def _adj(q_lo, q_hi, mask_q):
+                    """[L, 2] above/below adjacency of leaves q vs the two
+                    children: regions overlap on every feature but one
+                    monotone g, and q lies strictly beyond on g."""
+                    ql = q_lo[:, None, :]
+                    qh = q_hi[:, None, :]
+                    cl = c_lo[None, :, :]
+                    ch = c_hi[None, :, :]
+                    ov = (ql < ch) & (cl < qh)                  # [L, 2, F]
+                    cnt = jnp.sum(ov.astype(jnp.int32), axis=2)
+                    ov_except = (cnt[:, :, None]
+                                 - ov.astype(jnp.int32)) == (F - 1)
+                    gate = ov_except & mask_q[:, None, None]
+                    above = gate & (ql >= ch)
+                    below = gate & (qh <= cl)
+                    q_is_up = (((d > 0) & above) | ((d < 0) & below))
+                    q_is_dn = (((d > 0) & below) | ((d < 0) & above))
+                    return (jnp.any(q_is_up, axis=2),
+                            jnp.any(q_is_dn, axis=2))
+
+                # --- region-aware child clipping: a child strictly beyond
+                # an EXISTING leaf must respect that leaf's output NOW —
+                # inheritance alone misses leaves the parent straddled
+                # (ref: the per-feature constraint recompute,
+                # monotone_constraints.hpp RecomputeConstraintsIfNeeded)
+                lo_before, hi_before = leaf_lo2, leaf_hi2
+                exist = active & (jnp.arange(L) != l)
+                q_up, q_dn = _adj(reg_lo, reg_hi, exist)        # [L, 2]
+                qv = tree.leaf_value[:, None]
+                c_hi_b = jnp.min(jnp.where(q_up, qv, jnp.inf), axis=0)
+                c_lo_b = jnp.max(jnp.where(q_dn, qv, -jnp.inf), axis=0)
+                o_l = jnp.clip(bsl.left_output, c_lo_b[0], c_hi_b[0])
+                o_n = jnp.clip(bsl.right_output, c_lo_b[1], c_hi_b[1])
+                # sibling order must survive the independent clips
+                mono_d2 = jnp.where(f >= 0, meta.monotone[fs], 0)
+                num_mono = is_num & (mono_d2 != 0)
+                o_n = jnp.where(num_mono & (mono_d2 > 0),
+                                jnp.maximum(o_n, o_l), o_n)
+                o_n = jnp.where(num_mono & (mono_d2 < 0),
+                                jnp.minimum(o_n, o_l), o_n)
+                tree2 = tree2._replace(
+                    leaf_value=tree2.leaf_value.at[l].set(o_l)
+                                               .at[new].set(o_n))
+                leaf_lo2 = leaf_lo2.at[l].max(c_lo_b[0]) \
+                                   .at[new].max(c_lo_b[1])
+                leaf_hi2 = leaf_hi2.at[l].min(c_hi_b[0]) \
+                                   .at[new].min(c_hi_b[1])
+                # sibling fences re-applied with the CLIPPED outputs
+                leaf_hi2 = leaf_hi2.at[l].min(jnp.where(
+                    num_mono & (mono_d2 > 0), o_n, jnp.inf))
+                leaf_lo2 = leaf_lo2.at[l].max(jnp.where(
+                    num_mono & (mono_d2 < 0), o_n, -jnp.inf))
+                leaf_lo2 = leaf_lo2.at[new].max(jnp.where(
+                    num_mono & (mono_d2 > 0), o_l, -jnp.inf))
+                leaf_hi2 = leaf_hi2.at[new].min(jnp.where(
+                    num_mono & (mono_d2 < 0), o_l, jnp.inf))
+
+                # --- cross-tree tightening of the OTHER leaves by the new
+                # (clipped) child outputs
+                other = active & (jnp.arange(L) != l)
+                other = other.at[new].set(False)
+                q_up2, q_dn2 = _adj(reg_lo2, reg_hi2, other)
+                co = jnp.stack([o_l, o_n])[None, :]
+                lo_cand = jnp.max(jnp.where(q_up2, co, -jnp.inf), axis=1)
+                hi_cand = jnp.min(jnp.where(q_dn2, co, jnp.inf), axis=1)
+                leaf_lo2 = jnp.maximum(leaf_lo2, lo_cand)
+                leaf_hi2 = jnp.minimum(leaf_hi2, hi_cand)
+                changed = (leaf_lo2 > lo_before) | (leaf_hi2 < hi_before)
+
+                def _rescan(b):
+                    node_ids = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
+                    bs_all = best_split(
+                        pool2, meta,
+                        _scan_mask(leaf_groups2, node_ids), params,
+                        tree2.leaf_value, has_cat=has_cat,
+                        use_bounds=True, bound_lo=leaf_lo2,
+                        bound_hi=leaf_hi2, leaf_depth=tree2.leaf_depth)
+
+                    def merge(old, newv):
+                        m = changed if old.ndim == 1 else changed[:, None]
+                        return jnp.where(m, newv, old)
+                    return BestSplit(*[merge(o, n)
+                                       for o, n in zip(b, bs_all)])
+
+                best2 = jax.lax.cond(jnp.any(changed), _rescan,
+                                     lambda b: b, best2)
             return (tree2, row_leaf2, pool2, best2, lpn2, lil2, leaf_lo2,
-                    leaf_hi2, leaf_groups2)
+                    leaf_hi2, leaf_groups2, reg_lo2, reg_hi2)
 
         return jax.lax.cond(do_split, split_branch, lambda op: op,
                             (tree, row_leaf, pool, best, lpn, lil,
-                             leaf_lo, leaf_hi, leaf_groups))
+                             leaf_lo, leaf_hi, leaf_groups, reg_lo,
+                             reg_hi))
 
     state = (tree, row_leaf, pool, best, leaf_parent_node, leaf_is_left,
-             leaf_lo, leaf_hi, leaf_groups)
-    tree, row_leaf, pool, best, _, _, _, _, _ = jax.lax.fori_loop(
-        0, L - 1, body, state)
+             leaf_lo, leaf_hi, leaf_groups, reg_lo, reg_hi)
+    tree, row_leaf, pool, best = jax.lax.fori_loop(
+        0, L - 1, body, state)[:4]
     return tree, row_leaf
 
 

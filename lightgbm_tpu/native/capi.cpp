@@ -15,7 +15,9 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 
 #define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
@@ -24,15 +26,21 @@ namespace {
 
 thread_local std::string g_last_error = "everything is fine";
 
+std::once_flag g_interp_once;
+
 struct Gil {
   PyGILState_STATE state;
   Gil() {
-    if (!Py_IsInitialized()) {
-      // pure-C host: bring up an embedded interpreter once, then RELEASE
-      // the GIL the init acquired so other host threads can enter
-      Py_InitializeEx(0);
-      PyEval_SaveThread();
-    }
+    // pure-C host: bring up an embedded interpreter exactly once (two
+    // host threads making their first concurrent LGBM_* calls must not
+    // race Py_InitializeEx), then RELEASE the GIL the init acquired so
+    // other host threads can enter
+    std::call_once(g_interp_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        PyEval_SaveThread();
+      }
+    });
     state = PyGILState_Ensure();
   }
   ~Gil() { PyGILState_Release(state); }
@@ -285,5 +293,292 @@ LGBM_EXPORT int LGBM_BoosterGetNumClasses(void* booster, int* out) {
 LGBM_EXPORT int LGBM_BoosterFree(void* handle) {
   Gil gil;
   Py_XDECREF((PyObject*)handle);
+  return 0;
+}
+
+// ----------------------------------------------------------------------
+// round-3 surface growth (ref: src/c_api.cpp:398-520 CSR/CSC/file dataset
+// creation, :939-1156 FastInit single-row predicts, c_api.h:1317
+// NetworkInit, GetEval family, leaf accessors)
+
+LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           void* reference, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(ssO)", filename, parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_file", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, void* reference, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(KiKKiLLLsO)", (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col,
+      parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_csr", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, void* reference, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(KiKKiLLLsO)", (unsigned long long)(uintptr_t)col_ptr, col_ptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+      parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_csc", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSaveBinary(void* handle, const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", (PyObject*)handle, filename);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_save_binary", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumFeature(void* booster, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_num_feature", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForFile(
+    void* booster, const char* data_filename, int data_has_header,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, const char* result_filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Osiiiiss)", (PyObject*)booster, data_filename, data_has_header,
+      predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "", result_filename);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_file", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    void* booster, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKiLLLiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col,
+      predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_csr", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalCounts(void* booster, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_eval_counts", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// reference string-array convention: caller provides ``len`` buffers of
+// ``buffer_len`` bytes; out_buffer_len reports the longest name + NUL
+LGBM_EXPORT int LGBM_BoosterGetEvalNames(void* booster, const int len,
+                                         int* out_len,
+                                         const size_t buffer_len,
+                                         size_t* out_buffer_len,
+                                         char** out_strs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_eval_names", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = (int)n;
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    size_t l = s ? strlen(s) + 1 : 1;
+    if (l > need) need = l;
+    if (out_strs != nullptr && i < len && s != nullptr) {
+      std::snprintf(out_strs[i], buffer_len, "%s", s);
+    }
+  }
+  *out_buffer_len = need;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEval(void* booster, int data_idx,
+                                    int* out_len, double* out_results) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", (PyObject*)booster, data_idx);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_eval", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = (int)n;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLeafValue(void* booster, int tree_idx,
+                                         int leaf_idx, double* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)", (PyObject*)booster, tree_idx,
+                                 leaf_idx);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_leaf_value", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSetLeafValue(void* booster, int tree_idx,
+                                         int leaf_idx, double val) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiid)", (PyObject*)booster, tree_idx,
+                                 leaf_idx, val);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_set_leaf_value", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterRollbackOneIter(void* booster) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_rollback_one_iter", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                                 int listen_time_out, int num_machines) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(siii)", machines ? machines : "",
+                                 local_listen_port, listen_time_out,
+                                 num_machines);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("network_init", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_NetworkFree() {
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("network_free", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+// FastInit single-row predicts (ref: c_api.cpp:939-1156): parse/alloc
+// once, then per-call predicts touch only the row buffer
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRowFastInit(
+    void* booster, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int32_t ncol,
+    const char* parameter, void** out_fast_config) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oiiiiis)", (PyObject*)booster, predict_type, start_iteration,
+      num_iteration, data_type, (int)ncol, parameter ? parameter : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("fast_config_create", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out_fast_config = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRowFast(
+    void* fast_config, const void* data, int64_t* out_len,
+    double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKK)", (PyObject*)fast_config,
+      (unsigned long long)(uintptr_t)data,
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("predict_single_row_fast", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_FastConfigFree(void* fast_config) {
+  Gil gil;
+  Py_XDECREF((PyObject*)fast_config);
   return 0;
 }

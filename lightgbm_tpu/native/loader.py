@@ -27,23 +27,40 @@ _TRIED = False
 def _compile(src: str, out: str, extra: Tuple[str, ...] = (),
              fallback_extra: Optional[Tuple[str, ...]] = None,
              timeout: int = 180) -> str:
-    """mtime-cached g++ compile with an atomic publish: build to a
-    process-unique temp path, then rename, so a concurrent process can
-    never dlopen a half-written .so. Callers serialize same-process
-    builds under _LOCK. Raises on failure."""
+    """Flag-stamped, mtime-cached g++ compile with an atomic publish:
+    build to a process-unique temp path, then rename, so a concurrent
+    process can never dlopen a half-written .so. A sidecar stamp records
+    the flag set that produced the cached .so — a flag or Python-version
+    change (or an earlier degraded fallback build) invalidates it instead
+    of being pinned forever. Callers serialize same-process builds under
+    _LOCK. Raises on failure."""
+    stamp_path = out + ".flags"
+    want_stamp = " ".join(extra)
     if os.path.exists(out) and \
             os.path.getmtime(out) >= os.path.getmtime(src):
-        return out
+        have = None
+        if os.path.exists(stamp_path):
+            with open(stamp_path) as fh:
+                have = fh.read()
+        if have == want_stamp:
+            return out
     tmp = f"{out}.{os.getpid()}.{threading.get_ident()}.tmp"
     base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    built_stamp = want_stamp
     r = subprocess.run(base[:-2] + list(extra) + base[-2:],
                        capture_output=True, timeout=timeout)
     if r.returncode != 0 and fallback_extra is not None:
         subprocess.run(base[:-2] + list(fallback_extra) + base[-2:],
                        check=True, capture_output=True, timeout=timeout)
+        built_stamp = " ".join(fallback_extra)
+        log.warning("%s built with FALLBACK flags (%s); a pure-C host "
+                    "may fail to dlopen it", os.path.basename(out),
+                    built_stamp)
     elif r.returncode != 0:
         raise RuntimeError(r.stderr.decode()[-300:])
     os.replace(tmp, out)
+    with open(stamp_path, "w") as fh:
+        fh.write(built_stamp)
     return out
 
 

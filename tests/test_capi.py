@@ -163,3 +163,183 @@ def test_capi_pure_c_host(lib, tmp_path):
                          timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "C HOST OK" in out.stdout
+
+
+def test_capi_csr_dataset_and_predict(lib, tmp_path):
+    """CSR dataset creation + CSR predict (ref surface:
+    c_api.cpp:398-520, exercised the way tests/c_api_test/test_.py
+    drives lib_lightgbm)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(1)
+    n, F = 2000, 40
+    Xs = sp.random(n, F, density=0.05, format="csr", random_state=rng,
+                   data_rvs=lambda k: rng.rand(k) + 0.5)
+    y = (np.asarray(Xs[:, :5].sum(axis=1)).ravel() > 0.4).astype(np.float32)
+
+    indptr = Xs.indptr.astype(np.int32)
+    indices = Xs.indices.astype(np.int32)
+    vals = Xs.data.astype(np.float64)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(F), b"verbose=-1", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    out = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(F), 0, 0, -1, b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, out) > 0.9
+
+    # dense predict on the same rows must agree
+    Xd = Xs.toarray().astype(np.float64)
+    out2 = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xd.ctypes.data_as(ctypes.c_void_p), 1, n, F, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_array_equal(out, out2)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
+def test_capi_file_dataset_predict_and_eval(lib, tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.rand(1500, 5)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    train_path = tmp_path / "train.csv"
+    rows = np.column_stack([y, X])
+    np.savetxt(train_path, rows, delimiter=",", fmt="%.6f")
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromFile(
+        str(train_path).encode(), b"verbose=-1 label_column=0", None,
+        ctypes.byref(ds)))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == 1500
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 metric=auc verbose=-1 "
+        b"is_provide_training_metric=true",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # GetEvalCounts / GetEvalNames / GetEval on the training data
+    cnt = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+    assert cnt.value == 1
+    bufs = [ctypes.create_string_buffer(64)]
+    arr = (ctypes.c_char_p * 1)(ctypes.addressof(bufs[0]))
+    out_n = ctypes.c_int()
+    out_blen = ctypes.c_size_t()
+    _check(lib, lib.LGBM_BoosterGetEvalNames(
+        bst, 1, ctypes.byref(out_n), ctypes.c_size_t(64),
+        ctypes.byref(out_blen), arr))
+    assert out_n.value == 1 and bufs[0].value == b"auc"
+    res = np.zeros(4, np.float64)
+    out_n2 = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEval(
+        bst, 0, ctypes.byref(out_n2),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_n2.value == 1 and 0.9 < res[0] <= 1.0
+
+    # PredictForFile writes one line per row
+    pred_in = tmp_path / "pred.csv"
+    np.savetxt(pred_in, X[:100], delimiter=",", fmt="%.6f")
+    pred_out = tmp_path / "pred.out"
+    _check(lib, lib.LGBM_BoosterPredictForFile(
+        bst, str(pred_in).encode(), 0, 0, 0, -1, b"",
+        str(pred_out).encode()))
+    got = np.loadtxt(pred_out)
+    assert got.shape == (100,) and np.isfinite(got).all()
+
+    # binary dataset cache round trip
+    bin_path = tmp_path / "train.bin"
+    _check(lib, lib.LGBM_DatasetSaveBinary(ds, str(bin_path).encode()))
+    assert bin_path.exists()
+
+    # leaf accessors
+    lv = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                             ctypes.byref(lv)))
+    lib.LGBM_BoosterSetLeafValue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    _check(lib, lib.LGBM_BoosterSetLeafValue(
+        bst, 0, 0, ctypes.c_double(lv.value + 1.0)))
+    lv2 = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                             ctypes.byref(lv2)))
+    assert abs(lv2.value - lv.value - 1.0) < 1e-9
+    nf = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetNumFeature(bst, ctypes.byref(nf)))
+    assert nf.value == 5
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
+def test_capi_fast_single_row(lib):
+    """FastInit preallocated single-row predicts
+    (ref: c_api.cpp:939-1156)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(800, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 0, 800, 4, 1, b"verbose=-1",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 800, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    cfg = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+        bst, 0, 0, -1, 1, 4, b"", ctypes.byref(cfg)))
+    out = ctypes.c_double()
+    out_len = ctypes.c_int64()
+    row = X[0].astype(np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFast(
+        cfg, row.ctypes.data_as(ctypes.c_void_p), ctypes.byref(out_len),
+        ctypes.byref(out)))
+    assert out_len.value == 1
+    # must match the batch predict of the same row
+    batch = np.zeros(1, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, row.ctypes.data_as(ctypes.c_void_p), 1, 1, 4, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert abs(out.value - batch[0]) < 1e-12
+    lib.LGBM_FastConfigFree(cfg)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
