@@ -125,10 +125,19 @@ class Dataset:
             # chunked out-of-core assembly (ref: Sequence streaming push)
             self.data = _materialize_sequences(self.data)
         if isinstance(self.data, (str, os.PathLike)):
-            # file-based ingestion (ref: DatasetLoader::LoadFromFile)
+            # file-based ingestion (ref: DatasetLoader::LoadFromFile).
+            # Multi-process: each rank reads its contiguous row slice
+            # unless pre_partition says the file already IS this rank's
+            # partition (ref: dataset_loader.cpp:203 LoadFromFile(rank,
+            # num_machines) + config.h pre_partition)
             from .io.file_loader import load_text_file
+            import jax as _jax
+            rank, nm = 0, 1
+            if _jax.process_count() > 1 and not bool(cfg.pre_partition):
+                rank, nm = _jax.process_index(), _jax.process_count()
             X, y, side = load_text_file(
-                str(self.data), label_column=self.params.get("label_column"))
+                str(self.data), label_column=self.params.get("label_column"),
+                rank=rank, num_machines=nm)
             self.data = X
             if self.label is None and y is not None:
                 self.label = y

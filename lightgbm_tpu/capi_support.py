@@ -162,15 +162,25 @@ def dataset_create_from_file(filename, parameters, reference):
                    reference=_ref(reference))
 
 
-def _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
-                   data_type, nindptr, nelem, num_col):
+def _sparse_from_ptrs(fmt, ptr_arr, ptr_type, indices_ptr, data_ptr,
+                      data_type, nptr, nelem, other_dim):
+    """Shared CSR/CSC constructor from raw C pointers (indptr/colptr
+    type codes: 2 = int32, 3 = int64, C_API_DTYPE)."""
     import scipy.sparse as sp
-    # indptr_type: 2 = int32, 3 = int64 (C_API_DTYPE codes)
-    indptr = _wrap(indptr_ptr, nindptr, indptr_type).copy()
+    ptrs = _wrap(ptr_arr, nptr, ptr_type).copy()
     indices = _wrap(indices_ptr, nelem, 2).copy()
     vals = _wrap(data_ptr, nelem, data_type).copy().astype(np.float64)
-    return sp.csr_matrix((vals, indices, indptr),
-                         shape=(nindptr - 1, num_col))
+    if fmt == "csr":
+        return sp.csr_matrix((vals, indices, ptrs),
+                             shape=(nptr - 1, other_dim))
+    return sp.csc_matrix((vals, indices, ptrs),
+                         shape=(other_dim, nptr - 1))
+
+
+def _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                   data_type, nindptr, nelem, num_col):
+    return _sparse_from_ptrs("csr", indptr_ptr, indptr_type, indices_ptr,
+                             data_ptr, data_type, nindptr, nelem, num_col)
 
 
 def dataset_create_from_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
@@ -185,12 +195,8 @@ def dataset_create_from_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
 def dataset_create_from_csc(colptr_ptr, colptr_type, indices_ptr, data_ptr,
                             data_type, ncolptr, nelem, num_row,
                             parameters, reference):
-    import scipy.sparse as sp
-    colptr = _wrap(colptr_ptr, ncolptr, colptr_type).copy()
-    indices = _wrap(indices_ptr, nelem, 2).copy()
-    vals = _wrap(data_ptr, nelem, data_type).copy().astype(np.float64)
-    X = sp.csc_matrix((vals, indices, colptr),
-                      shape=(num_row, ncolptr - 1))
+    X = _sparse_from_ptrs("csc", colptr_ptr, colptr_type, indices_ptr,
+                          data_ptr, data_type, ncolptr, nelem, num_row)
     return Dataset(X, params=_parse_params(parameters),
                    reference=_ref(reference))
 
@@ -298,9 +304,22 @@ def booster_get_eval(bst, data_idx):
                                               in vals])]
 
 
+def _checked_tree_leaf(g, tree_idx, leaf_idx):
+    # the reference returns -1 for invalid indices; Python negative
+    # indexing would silently read/mutate the LAST tree instead
+    if not (0 <= tree_idx < len(g.models)):
+        raise IndexError(f"tree index {tree_idx} out of range "
+                         f"[0, {len(g.models)})")
+    ht = g.models[tree_idx]
+    if not (0 <= leaf_idx < ht.num_leaves):
+        raise IndexError(f"leaf index {leaf_idx} out of range "
+                         f"[0, {ht.num_leaves})")
+    return ht
+
+
 def booster_get_leaf_value(bst, tree_idx, leaf_idx):
     bst._drain()
-    ht = bst._gbdt.models[tree_idx]
+    ht = _checked_tree_leaf(bst._gbdt, tree_idx, leaf_idx)
     return float(ht.leaf_value[leaf_idx])
 
 
@@ -308,7 +327,7 @@ def booster_set_leaf_value(bst, tree_idx, leaf_idx, value):
     """(ref: c_api.cpp LGBM_BoosterSetLeafValue -> Tree::SetLeafOutput)"""
     bst._drain()
     g = bst._gbdt
-    ht = g.models[tree_idx]
+    ht = _checked_tree_leaf(g, tree_idx, leaf_idx)
     ht.leaf_value[leaf_idx] = float(value)
     dt = g.device_trees[tree_idx]
     import jax.numpy as jnp

@@ -76,6 +76,30 @@ class Metadata:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
 
+def _allgather_sample(sample: np.ndarray) -> np.ndarray:
+    """Concatenate every process's binning sample (no-op single-process).
+
+    process_allgather requires identical shapes on every rank, but row
+    shards are unequal whenever the file row count doesn't divide evenly
+    — gather the per-rank counts first, pad to the max, then slice each
+    rank's real rows back out."""
+    import jax
+    if jax.process_count() <= 1:
+        return sample
+    from jax.experimental import multihost_utils
+    n_proc = jax.process_count()
+    cnt = np.array([sample.shape[0]], np.int64)
+    cnts = np.asarray(multihost_utils.process_allgather(cnt)) \
+        .reshape(n_proc)
+    m = int(cnts.max())
+    padded = np.pad(np.asarray(sample, np.float64),
+                    ((0, m - sample.shape[0]), (0, 0)))
+    gathered = np.asarray(multihost_utils.process_allgather(padded)) \
+        .reshape(n_proc, m, sample.shape[1])
+    return np.concatenate([gathered[p, :int(cnts[p])]
+                           for p in range(n_proc)], axis=0)
+
+
 def _sample_rows(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     if num_data <= sample_cnt:
         return np.arange(num_data)
@@ -201,6 +225,12 @@ class TpuDataset:
         sample_idx = _sample_rows(n, config.bin_construct_sample_cnt,
                                   config.data_random_seed)
         sample = np.asarray(data[sample_idx], dtype=np.float64)
+        # distributed loading: every rank holds only its row shard — the
+        # bin mappers must still be IDENTICAL everywhere, so the samples
+        # are allgathered across processes before FindBin (the TPU-native
+        # form of the reference's feature-sharded FindBin + mapper
+        # allgather, ref: src/io/dataset_loader.cpp:1015,1146-1154)
+        sample = _allgather_sample(sample)
         forced_bounds = forced_bounds or {}
 
         # per-feature bin budget override (ref: config.h

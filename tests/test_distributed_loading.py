@@ -1,0 +1,95 @@
+"""Distributed dataset loading parity (VERDICT r2 missing #5): per-rank
+file partitions must produce IDENTICAL bin mappers on every rank — the
+TPU-native form of the reference's feature-sharded FindBin + mapper
+allgather (ref: src/io/dataset_loader.cpp:1015,1146-1154).
+
+Mirrors the reference's distributed mockup (tests/distributed/
+_test_distributed.py): real subprocesses, one per rank, joined through
+jax.distributed over localhost."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=sys.argv[1],
+        num_processes=int(sys.argv[2]), process_id=int(sys.argv[3]))
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    path, out_path = sys.argv[4], sys.argv[5]
+    ds = lgb.Dataset(path, params={"label_column": 0, "verbose": -1,
+                                   "max_bin": 31})
+    ds.construct()
+    inner = ds._inner
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "num_iterations": 3, "verbose": -1}, ds)
+    report = {
+        "rank": jax.process_index(),
+        "num_rows": int(inner.num_data),
+        "bounds": [[float(b) for b in m.bin_upper_bound]
+                   for m in inner.mappers],
+        "model": bst.model_to_string(),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh)
+""")
+
+
+def test_two_process_loading_shares_mappers(tmp_path):
+    rng = np.random.RandomState(3)
+    n = 3001   # odd: unequal shards exercise the allgather padding
+    X = rng.randn(n, 5)
+    # rank shards see DIFFERENT distributions (sorted rows) so local-only
+    # binning would produce different mappers — the allgather must fix it
+    X = X[np.argsort(X[:, 0])]
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    outs = [tmp_path / f"rank{i}.json" for i in range(2)]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # ONLY the repo on the path: the axon TPU plugin breaks multiprocess
+    # CPU backends (process_count stays 1)
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, "2", str(i), str(train),
+         str(outs[i])], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE) for i in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err.decode()[-2000:]
+
+    reports = [json.loads(o.read_text()) for o in outs]
+    # disjoint contiguous shards covering the file
+    assert reports[0]["num_rows"] + reports[1]["num_rows"] == n
+    assert reports[0]["num_rows"] not in (0, n)
+    # IDENTICAL mappers everywhere despite skewed shards
+    assert reports[0]["bounds"] == reports[1]["bounds"]
+    # single-process local-only binning of one skewed shard must differ —
+    # otherwise this test would pass vacuously
+    import lightgbm_tpu as lgb
+    half = lgb.Dataset(np.ascontiguousarray(X[:n // 2]),
+                       params={"verbose": -1, "max_bin": 31})
+    half.construct()
+    local_bounds = [[float(b) for b in m.bin_upper_bound]
+                    for m in half._inner.mappers]
+    assert local_bounds != reports[0]["bounds"]
