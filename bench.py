@@ -18,10 +18,105 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
+
+# The bench record must be indestructible (VERDICT r3 weak #1: round 3
+# lost an already-measured perf number because the JSON printed only
+# after a ~1-hour quality leg that the driver's budget killed).  The
+# current best-known result lives here; it is printed+flushed the moment
+# each leg lands, and re-emitted by the SIGTERM handler / watchdog if a
+# later leg dies, so the LAST stdout line is always parseable JSON.
+_RESULT = {"metric": "higgs_sec_per_iter_10.5M_rows", "value": None,
+           "unit": "s", "vs_baseline": None, "probe_tfs": None}
+
+
+def _emit():
+    print(json.dumps(_RESULT), flush=True)
+
+
+def _die_with_record(reason: str):
+    _RESULT.setdefault("error", reason)
+    _emit()
+    os._exit(0)
+
+
+def _install_guards():
+    # SIGTERM: what `timeout` (the driver) sends first
+    signal.signal(signal.SIGTERM,
+                  lambda s, f: _die_with_record("sigterm"))
+    # watchdog thread: fires even when the main thread is stuck inside a
+    # blocking device call (signal handlers can't run there)
+    deadline = float(os.environ.get("BENCH_DEADLINE_SECS", "3000"))
+
+    def _watch():
+        time.sleep(deadline)
+        _die_with_record(f"internal_deadline_{deadline:.0f}s")
+
+    threading.Thread(target=_watch, daemon=True).start()
+
+
+_PROBE_CODE = r"""
+import json, time, numpy as np
+from lightgbm_tpu.utils.platform import pin_jax_platforms
+pin_jax_platforms()
+import jax, jax.numpy as jnp
+d = jax.devices()
+xp = jnp.asarray(np.random.RandomState(1).randn(4096, 4096)
+                 .astype(np.float32)).astype(jnp.bfloat16)
+
+@jax.jit
+def _chain(m):
+    for _ in range(8):
+        m = (m @ m) * 1e-3
+    return jnp.sum(m.astype(jnp.float32))
+
+float(_chain(xp))
+t0 = time.perf_counter()
+float(_chain(xp))
+tfs = 8 * 2 * 4096 ** 3 / (time.perf_counter() - t0) / 1e12
+print(json.dumps({"platform": d[0].platform, "probe_tfs": round(tfs, 1)}))
+"""
+
+
+def _probe_chip(timeout_s: float = None):
+    """Backend bring-up + chained-matmul probe in a SUBPROCESS so a stuck
+    tunnel (device grant hang: jax.devices() blocks forever, PROFILE.md
+    §5) is a recorded reason, not an rc=124 with no JSON. Returns
+    (probe_dict, None) on success; (None, reason) only when backend init
+    HANGS — a transient probe error is advisory (the caller continues
+    without a probe reading, it must not destroy the perf leg)."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
+    last = "probe_failed"
+    for attempt in range(2):
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                               capture_output=True, text=True,
+                               timeout=timeout_s,
+                               cwd=os.path.dirname(os.path.abspath(
+                                   __file__)))  # lightgbm_tpu importable
+        except subprocess.TimeoutExpired:
+            # a hung probe means the main process would hang too —
+            # one retry, then bail with the record
+            last = "tunnel_stuck_backend_init"
+            continue
+        if r.returncode != 0:
+            if "tunnel_stuck" not in last:   # a stuck-tunnel signal from
+                # an earlier attempt must survive: main() bails on it
+                # instead of walking into the same hang in-process
+                last = f"probe_failed: {r.stderr[-200:]}"
+            continue
+        try:
+            return json.loads(r.stdout.strip().splitlines()[-1]), None
+        except Exception:
+            last = f"probe_unparseable: {r.stdout[-200:]}"
+    return None, last
 
 
 def _make_data(n_rows: int, n_feat: int):
@@ -60,18 +155,20 @@ def _run(engine: str, X, y, n_iters: int):
     return (time.perf_counter() - t0) / n_iters
 
 
-def _quality_leg(engine: str) -> dict:
+def _quality_leg(engine: str, iters: int = 500) -> dict:
     """Differential AUC vs the rebuilt reference CPU package on identical
     data + params (VERDICT r2 #4: the bf16 hi/lo histogram precision claim
     needs a quality number at scale, not a 0.005-tolerance fixture).
     Ref contract being matched: docs/GPU-Performance.rst:136 — the fp32-
-    histogram GPU build holds AUC to ~5e-4 of the CPU build on Higgs."""
+    histogram GPU build holds AUC to ~5e-4 of the CPU build on Higgs.
+    Our AUC is pushed into _RESULT and emitted BEFORE the (up to 1 h)
+    reference-CPU subprocess so a deadline mid-reference-run cannot
+    destroy the measured TPU number."""
     import lightgbm_tpu as lgb
     from sklearn.metrics import roc_auc_score
 
     n_train = int(os.environ.get("BENCH_QUALITY_ROWS", 1_000_000))
     n_test = max(100_000, n_train // 5)
-    iters = int(os.environ.get("BENCH_QUALITY_ITERS", 500))
     rng = np.random.RandomState(7)
     n_feat = 28
     X = rng.rand(n_train + n_test, n_feat).astype(np.float32)
@@ -106,6 +203,9 @@ def _quality_leg(engine: str) -> dict:
     out = {"auc": round(auc, 6),
            "auc_bayes": round(float(roc_auc_score(yte, margin[n_train:])),
                               6)}
+    _RESULT.update(out)
+    _emit()   # the measured TPU AUC is now on stdout, whatever happens
+              # to the reference-CPU leg below
 
     # the reference package is built out-of-tree by
     # scripts/build_reference.sh; absent -> report our AUC alone
@@ -138,6 +238,31 @@ def _quality_leg(engine: str) -> dict:
 
 
 def main() -> None:
+    _install_guards()
+
+    # chip-health probe FIRST, in a bounded subprocess: the tunnel's
+    # delivered throughput swings >10x over hours and its failure mode is
+    # an infinite hang at backend init (PROFILE.md §5) — record the state
+    # and bail with a parseable record instead of dying silently
+    probe, probe_err = _probe_chip()
+    if probe is None:
+        print(f"chip probe failed: {probe_err}", file=sys.stderr)
+        if "tunnel_stuck" in probe_err:
+            # backend init hangs: the perf leg would hang forever too —
+            # emit the record and stop
+            _die_with_record(probe_err)
+        # transient probe error: advisory only, keep the perf leg alive
+        _RESULT["probe_error"] = probe_err
+        tfs = 0.0
+    else:
+        tfs = float(probe.get("probe_tfs", 0.0))
+        _RESULT["probe_tfs"] = tfs
+        _RESULT["platform"] = probe.get("platform")
+        print(f"chip probe: {tfs:.1f} TF/s (chained bf16 4096^3 matmul; "
+              f"v5e spec 197)", file=sys.stderr)
+
+    from lightgbm_tpu.utils.platform import pin_jax_platforms
+    pin_jax_platforms()   # the axon plugin ignores the env var
     import jax
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("JAX_CACHE_DIR",
@@ -150,29 +275,6 @@ def main() -> None:
     baseline_sec_per_iter = 130.094 / 500  # ref: docs/Experiments.rst:113
 
     X, y = _make_data(n_rows, n_feat)
-
-    # chip-health probe: the tunnel's delivered throughput swings >10x
-    # over hours (PROFILE.md §5) — record it so the headline number can
-    # be read with its error bar
-    try:
-        import jax
-        import jax.numpy as jnp
-        xp = jnp.asarray(np.random.RandomState(1).randn(4096, 4096)
-                         .astype(np.float32)).astype(jnp.bfloat16)
-
-        @jax.jit
-        def _chain(m):
-            for _ in range(8):
-                m = (m @ m) * 1e-3
-            return jnp.sum(m.astype(jnp.float32))
-        float(_chain(xp))
-        t0 = time.perf_counter()
-        float(_chain(xp))
-        tfs = 8 * 2 * 4096 ** 3 / (time.perf_counter() - t0) / 1e12
-        print(f"chip probe: {tfs:.1f} TF/s (chained bf16 4096^3 matmul; "
-              f"v5e spec 197)", file=sys.stderr)
-    except Exception:
-        pass
 
     sec_per_iter = None
     for engine in ("fused", "frontier", "xla"):
@@ -197,24 +299,39 @@ def main() -> None:
         if sec_per_iter is not None:
             break
     if sec_per_iter is None:
-        raise SystemExit("all engines failed")
+        _die_with_record("all_engines_failed")
 
     scaled = sec_per_iter * (10_500_000 / n_rows)
-    result = {
-        "metric": "higgs_sec_per_iter_10.5M_rows",
-        "value": round(scaled, 4),
-        "unit": "s",
-        "vs_baseline": round(baseline_sec_per_iter / scaled, 3),
-    }
+    _RESULT["value"] = round(scaled, 4)
+    _RESULT["vs_baseline"] = round(baseline_sec_per_iter / scaled, 3)
+    _RESULT["engine"] = engine
+    _emit()   # the perf record is now on stdout, whatever happens next
+
     # quality leg: differential AUC vs the rebuilt reference CPU package
-    # (skippable for smoke runs with BENCH_QUALITY=0)
+    # (skippable with BENCH_QUALITY=0). Iteration budget scales with the
+    # probe: the full 500-iter leg is only feasible at healthy throughput
+    # (~40+ TF/s); a degraded chip gets a shrunk leg with the reason
+    # recorded rather than a destroyed round.
     if os.environ.get("BENCH_QUALITY", "1") != "0":
-        try:
-            result.update(_quality_leg(engine))
-        except Exception as e:
-            print(f"quality leg failed: {type(e).__name__}: {str(e)[:300]}",
+        full_iters = int(os.environ.get("BENCH_QUALITY_ITERS", 500))
+        if probe is None:
+            _RESULT["quality_skipped"] = "no_probe_reading"
+            print("quality leg skipped: no probe reading", file=sys.stderr)
+        elif tfs < 8.0:
+            _RESULT["quality_skipped"] = f"probe_{tfs:.1f}_tfs_too_low"
+            print(f"quality leg skipped: probe {tfs:.1f} TF/s",
                   file=sys.stderr)
-    print(json.dumps(result))
+        else:
+            q_iters = full_iters if tfs >= 40.0 else \
+                min(full_iters, max(100, int(full_iters * tfs / 40.0)))
+            _RESULT["quality_iters"] = q_iters
+            try:
+                _RESULT.update(_quality_leg(engine, iters=q_iters))
+            except Exception as e:
+                print(f"quality leg failed: {type(e).__name__}: "
+                      f"{str(e)[:300]}", file=sys.stderr)
+                _RESULT["quality_error"] = f"{type(e).__name__}"
+        _emit()   # merged record; last stdout line wins
 
 
 if __name__ == "__main__":

@@ -504,10 +504,18 @@ class Booster:
             dataset = self.valid_sets[valid_idx]
         if getattr(g, "average_output", False) or feval is not None:
             self._drain()   # needs the settled model count / host scores
+            # re-capture: the drain may apply the deferred no-split-stop
+            # subtraction, so the device rows captured above are stale
+            score_dev = (g.scores if valid_idx is None
+                         else g.valid_scores[valid_idx])
         if getattr(g, "average_output", False):
             score_dev = score_dev / max(1, g.num_iterations_trained)
         out.extend(g.eval_metric_set(name, metrics, score_dev))
         if feval is not None:
+            if not getattr(score_dev, "is_fully_addressable", True):
+                raise ValueError(
+                    "custom feval needs the full score matrix on one "
+                    "host; not supported with multi-process training")
             host_score = np.asarray(score_dev, np.float64)
             for f in (feval if isinstance(feval, list) else [feval]):
                 ret = f(host_score.reshape(-1), dataset)

@@ -13,10 +13,57 @@ CONTRIB=3).
 from __future__ import annotations
 
 import ctypes
+import os
 
 import numpy as np
 
+# Honor JAX_PLATFORMS deterministically BEFORE anything can touch a jax
+# backend: a pure-C host embedding the interpreter gets no other chance
+# to pin it, and an unreachable TPU would otherwise hang backend
+# bring-up forever.
+from .utils.platform import pin_jax_platforms
+
+pin_jax_platforms()
+
 from .basic import Booster, Dataset
+
+_BACKEND_READY = False
+
+
+def _ensure_backend():
+    """Bound jax backend bring-up so an unreachable device yields an
+    LGBM_GetLastError message instead of an infinite hang (the axon
+    tunnel's "device grant stuck" state blocks jax.devices() forever).
+    Runs device discovery in a daemon thread with a deadline; on timeout
+    the thread is abandoned and the caller gets a C API error (-1)."""
+    global _BACKEND_READY
+    if _BACKEND_READY:
+        return
+    import threading
+
+    import jax
+
+    timeout = float(os.environ.get("LGBM_TPU_BACKEND_TIMEOUT", "120"))
+    box = {}
+
+    def _probe():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:  # surfaced below on the calling thread
+            box["error"] = e
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise RuntimeError(
+            f"JAX backend initialization did not complete within "
+            f"{timeout:.0f}s — the accelerator is unreachable. Set "
+            f"JAX_PLATFORMS=cpu (honored at capi init) or raise "
+            f"LGBM_TPU_BACKEND_TIMEOUT.")
+    if "error" in box:
+        raise box["error"]
+    _BACKEND_READY = True
 
 _DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
 
@@ -39,6 +86,7 @@ def _parse_params(parameters: str) -> dict:
 # ---------------------------------------------------------------- dataset
 def dataset_create_from_mat(ptr, data_type, nrow, ncol, is_row_major,
                             parameters, reference):
+    _ensure_backend()
     if not ptr or nrow <= 0 or ncol <= 0:
         raise ValueError("DatasetCreateFromMat: data pointer is null or "
                          f"shape ({nrow}, {ncol}) is empty")
@@ -84,6 +132,7 @@ def dataset_num_feature(ds):
 
 # ---------------------------------------------------------------- booster
 def booster_create(train_ds, parameters):
+    _ensure_backend()
     params = _parse_params(parameters)
     # the reference C API evaluates the training data unconditionally
     # (c_api.cpp Booster constructor builds train metrics), so GetEval(0)
@@ -93,6 +142,7 @@ def booster_create(train_ds, parameters):
 
 
 def booster_from_modelfile(filename):
+    _ensure_backend()
     bst = Booster(model_file=filename)
     return bst, bst.current_iteration()
 
@@ -158,6 +208,7 @@ def _ref(ds_or_none):
 
 
 def dataset_create_from_file(filename, parameters, reference):
+    _ensure_backend()
     return Dataset(filename, params=_parse_params(parameters),
                    reference=_ref(reference))
 
@@ -186,6 +237,7 @@ def _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
 def dataset_create_from_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
                             data_type, nindptr, nelem, num_col,
                             parameters, reference):
+    _ensure_backend()
     X = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
                        data_type, nindptr, nelem, num_col)
     return Dataset(X, params=_parse_params(parameters),
@@ -195,6 +247,7 @@ def dataset_create_from_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
 def dataset_create_from_csc(colptr_ptr, colptr_type, indices_ptr, data_ptr,
                             data_type, ncolptr, nelem, num_row,
                             parameters, reference):
+    _ensure_backend()
     X = _sparse_from_ptrs("csc", colptr_ptr, colptr_type, indices_ptr,
                           data_ptr, data_type, ncolptr, nelem, num_row)
     return Dataset(X, params=_parse_params(parameters),

@@ -64,7 +64,10 @@ def run_train(params: Dict[str, str]) -> None:
     booster = _train(dict(params), train_set, num_boost_round=n_rounds,
                      valid_sets=valid_sets or None,
                      valid_names=valid_names or None)
-    booster.save_model(output_model)
+    # the reference CLI saves ALL trees even after early stopping
+    # (Application::Train -> SaveModelToFile(0, -1, ...)); -1 beats the
+    # Python facade's best_iteration default
+    booster.save_model(output_model, num_iteration=-1)
     log.info("Finished training; model saved to %s", output_model)
 
 
@@ -122,16 +125,8 @@ def run_refit(params: Dict[str, str]) -> None:
 
 
 def main(argv: List[str] = None) -> None:
-    # honor JAX_PLATFORMS deterministically: TPU-terminal environments may
-    # register their platform plugin in a way that outranks the env var
-    import os
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass
+    from .utils.platform import pin_jax_platforms
+    pin_jax_platforms()
     params = parse_args(sys.argv[1:] if argv is None else argv)
     task = params.pop("task", "train")
     if task == "train":

@@ -554,6 +554,12 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             if use_mono_bounds:
                 mono_d = jnp.where(f >= 0, meta.monotone[jnp.maximum(f, 0)],
                                    0)
+                # the reference updates constraints only for numerical
+                # splits in BOTH modes (BasicLeafConstraints::Update and
+                # UpdateConstraintsWithOutputs gate on is_numerical_split,
+                # monotone_constraints.hpp:488,547); a categorical split
+                # on a monotone feature must not fence the children
+                mono_d = jnp.where(bsl.cat_flag, 0, mono_d)
                 p_lo, p_hi = leaf_lo[l], leaf_hi[l]
                 if inter:
                     fence_l = bsl.right_output   # raw opposite outputs

@@ -28,6 +28,14 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+if hasattr(np, "bitwise_count"):
+    _popcount = np.bitwise_count          # NumPy >= 2.0
+else:
+    # NumPy 1.x fallback: byte-view + unpackbits popcount
+    def _popcount(a: np.ndarray) -> np.ndarray:
+        return np.unpackbits(a.view(np.uint8)).reshape(a.shape + (64,)) \
+            .sum(axis=-1, dtype=np.int64)
+
 
 def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
                  max_conflict_rate: float = 0.0001,
@@ -79,7 +87,7 @@ def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
             for bi in cand:
                 if bundle_bins[bi] + f_bins > max_bundle_bins:
                     continue  # keep the encoded bin range in dtype bounds
-                conflicts = int(np.bitwise_count(
+                conflicts = int(_popcount(
                     bundle_masks[bi] & packed).sum())
                 if bundle_conflicts[bi] + conflicts <= budget:
                     bundles[bi].append(f)

@@ -1,0 +1,27 @@
+"""Deterministic JAX platform selection.
+
+TPU-terminal environments may register their platform plugin in a way
+that outranks the ``JAX_PLATFORMS`` env var (observed: the env var is
+silently ignored and backend bring-up hangs forever when the TPU is
+unreachable). Every process entry point that must honor the env var —
+the CLI, the embedded-interpreter C ABI, the bench harness — calls this
+ONE helper before the first backend touch.
+"""
+from __future__ import annotations
+
+import os
+
+
+def pin_jax_platforms() -> None:
+    """Apply ``JAX_PLATFORMS`` through jax.config, which is honored even
+    where the env var is not. No-op when the env var is unset, when jax
+    is unavailable, or when a backend is already initialized."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
