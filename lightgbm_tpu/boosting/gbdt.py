@@ -181,16 +181,23 @@ class GBDT:
         self._setup_parallel(config)
         self._setup_engine(config)
 
-        md = train_data.metadata
+        md = self._mp_metadata if self.mp is not None else train_data.metadata
         k, n = self.num_tree_per_iteration, self.num_data
         self.has_init_score = md.init_score is not None
+        from jax.sharding import PartitionSpec as P
         if self.has_init_score:
             init = np.asarray(md.init_score, np.float64)
             if init.size == n * k:
                 scores = init.reshape(k, n, order="C")
             else:
                 scores = np.tile(init.reshape(1, n), (k, 1))
-            self.scores = jnp.asarray(scores, jnp.float32)
+            self.scores = (self.mp.shard_full(scores.astype(np.float32),
+                                              P(None, self.axis_name))
+                           if self.mp is not None
+                           else jnp.asarray(scores, jnp.float32))
+        elif self.mp is not None:
+            self.scores = self.mp.zeros_sharded((k, n),
+                                                P(None, self.axis_name))
         else:
             self.scores = jnp.zeros((k, n), jnp.float32)
 
@@ -223,7 +230,7 @@ class GBDT:
                        or config.neg_bagging_fraction < 1.0)):
                 self.is_bagging = True
                 self.balanced_bagging = True
-        self.bag_weight = jnp.ones((n,), jnp.float32)  # 1=in bag
+        self.bag_weight = self._bag_ones()  # 1=in bag (mp: 0 on pad rows)
         self.bag_cnt = n
 
         self.best_score: Dict[Tuple[int, str], float] = {}
@@ -441,6 +448,7 @@ class GBDT:
         self.mesh = None
         self.n_shards = 1
         self.axis_name = None
+        self.mp = None
         self._par_fns = {}
         if not bool(getattr(config, "is_parallel", False)):
             return
@@ -452,6 +460,13 @@ class GBDT:
                 "training serially (multi-chip needs a TPU slice or "
                 "XLA_FLAGS=--xla_force_host_platform_device_count)", mode)
             return
+        if jax.process_count() > 1 and mode == "feature":
+            # feature-parallel replicates rows on every shard; multi-
+            # process runs hold one rank-local row shard per process
+            log.warning("tree_learner=feature needs row-replicated data; "
+                        "multi-process runs shard rows per rank — using "
+                        "data-parallel")
+            mode = "data"
         if mode == "feature" and (self.use_node_masks
                                   or getattr(self, "use_cegb", False)
                                   or getattr(self, "n_forced", 0)
@@ -504,8 +519,47 @@ class GBDT:
                 monotone=padv(self.meta.monotone),
                 is_cat=jnp.asarray(np.pad(
                     np.asarray(self.meta.is_cat), (0, padF))))
+        if jax.process_count() > 1:
+            self._init_multiproc(config)
         log.info("Using %s-parallel tree learner over %d devices", mode,
                  n_dev)
+
+    def _init_multiproc(self, config: Config) -> None:
+        """Joint multi-process training: one global model over per-rank
+        row shards (the v5e-pod / DCN analog of the reference's multi-
+        machine mode, data_parallel_tree_learner.cpp:126-276 — see
+        parallel/multiproc.py for the layout contract)."""
+        from ..parallel.multiproc import MultiProcLayout
+        if bool(config.linear_tree):
+            log.fatal("linear_tree needs host raw-data access per leaf and "
+                      "is not supported with multi-process training")
+        if str(config.boosting) not in ("gbdt", "gbrt"):
+            log.fatal("boosting=%s is not supported with multi-process "
+                      "training yet (host-side per-tree resampling)",
+                      config.boosting)
+        if self.objective is not None and self.objective.is_renew_tree_output:
+            log.fatal("objective %s renews leaf outputs from host row "
+                      "statistics and is not supported with multi-process "
+                      "training yet", self.objective.name)
+        if getattr(self, "use_bundles", False):
+            log.fatal("EFB bundling is derived from rank-local data and "
+                      "is not supported with multi-process training yet "
+                      "(set enable_bundle=false)")
+        self.mp = MultiProcLayout(self.mesh, self.axis_name,
+                                  self.train_data.num_data)
+        self.num_data = self.mp.Np
+        self.par_rows = self.mp.Np
+        self._mp_real_mask = self.mp.real_mask_np()
+        self._mp_metadata = self.mp.global_metadata(self.train_data.metadata)
+        # objectives/metrics were inited with the rank-local shard; re-init
+        # on the global view so label statistics (class counts, averages,
+        # metric weights) are global — the reference's GlobalSyncUp* paths.
+        # num_data = REAL global rows (statistics), arrays are [Np] padded
+        # with zero weight.
+        if self.objective is not None:
+            self.objective.init(self._mp_metadata, self.mp.total_real)
+        for m in self.training_metrics:
+            m.init(self._mp_metadata, self.mp.total_real)
 
     def _place_par_data(self) -> None:
         """Mesh placement of the binned matrix for the XLA parallel
@@ -515,6 +569,12 @@ class GBDT:
         from jax.sharding import NamedSharding, PartitionSpec as P
         axis = self.axis_name
         bins_np = np.asarray(self.train_data.bins)
+        if self.mp is not None:
+            # the one per-rank-DISTINCT operand: rank-local binned rows
+            # into their block of the global row-sharded matrix
+            self.bins_par = self.mp.shard_local(bins_np)
+            self._par_placed = True
+            return
         if self.parallel_mode in ("data", "voting"):
             pad = self.par_rows - self.num_data
             if getattr(self, "use_bundles", False):
@@ -721,6 +781,9 @@ class GBDT:
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
+        if getattr(self, "mp", None) is not None and engine != "xla":
+            log.info("multi-process training runs on the XLA growers")
+            engine = "xla"
         if self.parallel_mode in ("voting", "feature") and engine != "xla":
             # the vote/column-slice exchanges live in the depthwise XLA
             # grower (ref: voting/feature_parallel_tree_learner.cpp)
@@ -957,6 +1020,16 @@ class GBDT:
     def add_valid_data(self, valid_data: TpuDataset, name: str,
                        metrics: Sequence) -> None:
         """(ref: gbdt.cpp AddValidDataset)"""
+        if getattr(self, "mp", None) is not None \
+                and self.early_stopping_round > 0:
+            # metrics evaluate on the rank-LOCAL valid shard (the
+            # reference's metrics are not distributed-aware either,
+            # SURVEY §2.8); divergent stop decisions would desync the
+            # ranks' collective schedules and hang the mesh
+            log.warning("multi-process early stopping requires IDENTICAL "
+                        "validation data on every rank — per-rank valid "
+                        "shards may stop ranks at different iterations "
+                        "and deadlock the collectives")
         self.drain_pending()          # replay below needs the full model
         self._fast_ok_cache = None    # (valid sets ride the fast path now)
         self._epi_ok_cache = None
@@ -1017,6 +1090,14 @@ class GBDT:
         return grad, hess
 
     # ------------------------------------------------------------------
+    def _bag_ones(self):
+        """All-rows-in-bag weight vector ([n] f32). Multi-process: the
+        real-row mask (pad rows carry zero weight so they never touch
+        histograms, counts or leaf sums), sharded over the global mesh."""
+        if getattr(self, "mp", None) is not None:
+            return self.mp.shard_full(self._mp_real_mask)
+        return jnp.ones((self.num_data,), jnp.float32)
+
     def _bag_mask_for(self, it: int):
         """In-bag mask effective at iteration ``it``. Rounds fire at
         iterations where it % bagging_freq == 0 and are drawn strictly in
@@ -1042,7 +1123,9 @@ class GBDT:
             # promotion (gbdt.cpp:192).
             draws = self.bag_streams.next_floats()
             if self.balanced_bagging:
-                label = self.train_data.metadata.label
+                label = (self._mp_metadata.label
+                         if getattr(self, "mp", None) is not None
+                         else self.train_data.metadata.label)
                 frac = np.where(label > 0,
                                 np.float64(cfg.pos_bagging_fraction),
                                 np.float64(cfg.neg_bagging_fraction))
@@ -1064,9 +1147,14 @@ class GBDT:
                 or it % cfg.bagging_freq != 0:
             return grad, hess
         mask = self._bag_mask_for(it)
-        self.bag_cnt = int(mask.sum())
+        if getattr(self, "mp", None) is not None:
+            m = mask.astype(np.float32) * self._mp_real_mask
+            self.bag_cnt = int(m.sum())
+            self.bag_weight = self.mp.shard_full(m)
+        else:
+            self.bag_cnt = int(mask.sum())
+            self.bag_weight = jnp.asarray(mask.astype(np.float32))
         log.debug("Re-bagging, using %d data to train", self.bag_cnt)
-        self.bag_weight = jnp.asarray(mask.astype(np.float32))
         return grad, hess
 
     def _bag_weight_for_iter(self, it: int):
@@ -1247,8 +1335,11 @@ class GBDT:
         """Per-tree column sampling (ref: col_sampler.hpp:20)."""
         F = self.train_data.num_features
         frac = float(self.config.feature_fraction)
+        mp = getattr(self, "mp", None) is not None
         if frac >= 1.0:
-            return jnp.ones((F,), bool)
+            # mp: host numpy — multi-process jit treats host operands as
+            # replicated (every rank computes the identical mask)
+            return np.ones(F, bool) if mp else jnp.ones((F,), bool)
         # reference-parity by-tree sampling: one persistent LCG stream,
         # Sample(valid_count, RoundInt(count*fraction)) per tree
         # (ref: col_sampler.hpp:33 GetCnt, :78 ResetByTree)
@@ -1256,7 +1347,7 @@ class GBDT:
         chosen = self.feat_rng.sample(F, k)
         mask = np.zeros(F, bool)
         mask[chosen] = True
-        return jnp.asarray(mask)
+        return mask if mp else jnp.asarray(mask)
 
     # ------------------------------------------------------------------
     def _to_host_tree(self, tree: TreeArrays, shrinkage: float) -> Tuple[
@@ -1473,6 +1564,7 @@ class GBDT:
             self._fast_ok_cache = bool(
                 type(self) is GBDT
                 and self.use_fused
+                and getattr(self, "mp", None) is None
                 and self.parallel_mode in ("serial", "data")
                 and obj is not None
                 and not obj.is_renew_tree_output
@@ -1967,6 +2059,10 @@ class GBDT:
                 init_scores[tid] = self._boost_from_average(tid, True)
             grad, hess = self._get_gradients()
         else:
+            if getattr(self, "mp", None) is not None:
+                log.fatal("custom objective gradients are rank-local "
+                          "host arrays; not supported with multi-process "
+                          "training yet")
             grad = jnp.asarray(gradients, jnp.float32).reshape(k, n)
             hess = jnp.asarray(hessians, jnp.float32).reshape(k, n)
 
@@ -2119,7 +2215,7 @@ class GBDT:
                 self.is_bagging = True
                 self.balanced_bagging = True
         if not self.is_bagging:
-            self.bag_weight = jnp.ones((n,), jnp.float32)
+            self.bag_weight = self._bag_ones()
             self.bag_cnt = n
         # the reference recreates its per-block bagging generators on
         # every config reset (gbdt.cpp ResetBaggingConfig)
@@ -2132,12 +2228,21 @@ class GBDT:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """(ref: gbdt.cpp:456 RollbackOneIter)"""
+        if getattr(self, "mp", None) is not None:
+            log.fatal("rollback_one_iter replays trees on the replicated "
+                      "bin matrix; not supported with multi-process "
+                      "training yet")
         self.drain_pending()
         self._epi_carry = None   # score subtraction invalidates the carry
-        # lookahead rounds drawn past the rollback point must not be
-        # served for earlier iterations — clear so post-rollback firings
-        # draw fresh rounds in stream order (pre-cache behavior)
-        self._bag_round_cache = None
+        # _bag_round_cache is RETAINED: entries are keyed by firing
+        # iteration and stay valid, so a rollback within the cache's
+        # two-round window replays the exact round it used before —
+        # covering the fused epilogue's one-round lookahead (ADVICE r3).
+        # Deeper rollbacks fall off the eviction window and draw the
+        # next stream round on retrain, which is also what the reference
+        # does at ANY depth (gbdt.cpp:456+230 never rewinds the RNG) —
+        # so beyond the window we diverge from the unfused engine's
+        # replay but not from reference-style stream semantics.
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration
@@ -2186,6 +2291,18 @@ class GBDT:
             vals = m.eval_device(score_dev, self.objective)
             if vals is None:
                 if host_score is None:
+                    if not getattr(score_dev, "is_fully_addressable", True):
+                        # multi-process sharded scores cannot be pulled to
+                        # one host; only device-form metrics apply
+                        warned = getattr(self, "_mp_metric_warned", set())
+                        if m.names[0] not in warned:
+                            log.warning(
+                                "metric %s has no device formulation and "
+                                "is skipped under multi-process training",
+                                m.names[0])
+                            warned.add(m.names[0])
+                            self._mp_metric_warned = warned
+                        continue
                     host_score = np.asarray(score_dev, np.float64)
                 vals = m.eval(host_score, self.objective)
             for name, v in zip(m.names, vals):

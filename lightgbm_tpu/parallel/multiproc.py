@@ -1,0 +1,178 @@
+"""Multi-process (multi-host) data-parallel training support.
+
+The reference trains ONE model across N machines by running N processes
+joined through its socket/MPI Network: each rank loads a disjoint row
+shard, histograms are reduce-scattered, split decisions replicated
+(ref: src/treelearner/data_parallel_tree_learner.cpp:126-276, proven by
+tests/distributed/_test_distributed.py:170-198). The TPU-native analog:
+``jax.distributed.initialize()`` gives every process the GLOBAL device
+mesh; per-rank shards become one global row-sharded ``jax.Array``; the
+in-jit ``psum`` collectives then span processes over ICI/DCN exactly as
+they span local devices — no transport layer of our own.
+
+Layout contract (rank-blocked padded rows):
+- every process owns ``block = S * local_device_count`` consecutive rows
+  of the padded global space, its real rows first;
+- pad rows carry ZERO weight everywhere (the same zero-weight-pad
+  contract the single-process parallel path already uses), enforced by
+  folding ``real_mask`` into the bagging weight vector and into the
+  metadata weight column;
+- host-side per-row state (labels, weights, bagging draws, feature
+  masks) is allgathered or recomputed IDENTICALLY on every rank, so all
+  ranks run the same Python program on the same values — the SPMD
+  contract that makes every rank emit the identical model.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import log
+
+
+class GlobalMetadata:
+    """Host-side global view of per-row metadata, identical on every
+    rank (the driver re-inits objectives/metrics with this so their
+    statistics — label means, class counts, metric weights — are global,
+    matching the reference's Network::GlobalSyncUp* paths)."""
+
+    def __init__(self, label, weight, init_score, query_boundaries=None):
+        self.label = label
+        self.weight = weight
+        self.init_score = init_score
+        self.query_boundaries = query_boundaries
+
+
+class MultiProcLayout:
+    """Row layout + placement helpers for one global mesh."""
+
+    def __init__(self, mesh: Mesh, axis: str, local_rows: int):
+        from jax.experimental import multihost_utils
+
+        self._mh = multihost_utils
+        self.mesh = mesh
+        self.axis = axis
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        devs = list(mesh.devices.flat)
+        self.n_dev = len(devs)
+        self.dev_per_proc = sum(
+            1 for d in devs if d.process_index == self.process_index)
+        if self.dev_per_proc * self.process_count != self.n_dev:
+            log.fatal("multi-process training needs the same device count "
+                      "on every process (got %d local of %d total over %d "
+                      "processes)", self.dev_per_proc, self.n_dev,
+                      self.process_count)
+        # the rank-blocked layout contract: mesh-axis position r*dpp..(r+1)
+        # *dpp must belong to process r, or shard_local would place rank
+        # r's binned rows against ANOTHER rank's block of the allgathered
+        # labels/real-mask — silent mistraining, so verify, don't assume
+        for r in range(self.process_count):
+            blk = devs[r * self.dev_per_proc:(r + 1) * self.dev_per_proc]
+            if any(d.process_index != blk[0].process_index for d in blk) \
+                    or blk[0].process_index != r:
+                log.fatal("mesh devices are not grouped in ascending "
+                          "process order along the data axis (position "
+                          "%d holds process %d); build the mesh from "
+                          "jax.devices() order", r * self.dev_per_proc,
+                          blk[0].process_index)
+        self.local_real = int(local_rows)
+        counts = np.asarray(self._mh.process_allgather(
+            np.asarray([self.local_real], np.int64))).reshape(-1)
+        self.counts = [int(c) for c in counts]
+        self.total_real = int(sum(self.counts))
+        # rows per device: every rank's shard must fit its block
+        self.S = max(1, -(-max(self.counts) // self.dev_per_proc))
+        self.block = self.S * self.dev_per_proc
+        self.Np = self.S * self.n_dev
+        log.info("multi-process layout: %d processes x %d devices, "
+                 "%d real rows -> %d padded (%d rows/device)",
+                 self.process_count, self.dev_per_proc, self.total_real,
+                 self.Np, self.S)
+
+    # ------------------------------------------------------------ host
+    def pad_local(self, arr: np.ndarray) -> np.ndarray:
+        """[local_real, ...] -> [block, ...] zero-padded."""
+        arr = np.asarray(arr)
+        pad = self.block - arr.shape[0]
+        if pad < 0:
+            log.fatal("local shard has %d rows but the block is %d",
+                      arr.shape[0], self.block)
+        if pad == 0:
+            return arr
+        return np.pad(arr, [(0, pad)] + [(0, 0)] * (arr.ndim - 1))
+
+    def allgather_rows(self, local: Optional[np.ndarray],
+                      fill=0) -> Optional[np.ndarray]:
+        """Per-rank local rows -> identical [Np, ...] host array on every
+        rank (the mapper-allgather pattern of dataset_loader.cpp:1146
+        applied to metadata columns)."""
+        if local is None:
+            return None
+        loc = self.pad_local(np.asarray(local))
+        if fill != 0:
+            loc[self.local_real:] = fill
+        out = np.asarray(self._mh.process_allgather(loc))
+        return out.reshape((self.Np,) + loc.shape[1:])
+
+    def real_mask_np(self) -> np.ndarray:
+        """[Np] f32: 1.0 for real rows, 0.0 for pads."""
+        m = np.zeros((self.Np,), np.float32)
+        for r, c in enumerate(self.counts):
+            off = r * self.block
+            m[off:off + c] = 1.0
+        return m
+
+    def global_metadata(self, md) -> GlobalMetadata:
+        """Global host metadata from the rank-local one. The weight
+        column always exists afterwards (real_mask when the data is
+        unweighted) so pad rows carry zero weight through objectives and
+        metrics."""
+        if getattr(md, "query_boundaries", None) is not None:
+            log.fatal("ranking (query/group) data is not supported with "
+                      "multi-process training yet")
+        label = self.allgather_rows(md.label)
+        weight = self.allgather_rows(md.weight)
+        mask = self.real_mask_np()
+        weight = mask if weight is None else weight * mask
+        init_score = md.init_score
+        if init_score is not None:
+            init_score = np.asarray(init_score)
+            if init_score.ndim == 1 and init_score.size != self.local_real:
+                # per-class flattened layout [k*n]: gather per class
+                k = init_score.size // self.local_real
+                cols = init_score.reshape(k, self.local_real)
+                init_score = np.concatenate(
+                    [self.allgather_rows(c) for c in cols])
+            else:
+                init_score = self.allgather_rows(init_score)
+        return GlobalMetadata(label, weight, init_score)
+
+    # ---------------------------------------------------------- device
+    def shard_local(self, local: np.ndarray) -> jax.Array:
+        """Per-rank local rows -> global row-sharded jax.Array (the only
+        placement that moves per-rank-DISTINCT data; everything else is
+        replicated host state)."""
+        loc = self.pad_local(np.asarray(local))
+        sh = NamedSharding(self.mesh,
+                           P(self.axis, *([None] * (loc.ndim - 1))))
+        return jax.make_array_from_process_local_data(sh, loc)
+
+    def shard_full(self, full: np.ndarray, spec: P = None) -> jax.Array:
+        """Identical full-size host array on every rank -> sharded global
+        array (each process donates only its addressable slices)."""
+        full = np.asarray(full)
+        if spec is None:
+            spec = P(self.axis, *([None] * (full.ndim - 1)))
+        sh = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            full.shape, sh, lambda idx: full[idx])
+
+    def zeros_sharded(self, shape, spec: P, dtype=jnp.float32) -> jax.Array:
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=NamedSharding(self.mesh, spec))()

@@ -84,6 +84,11 @@ def test_two_process_loading_shares_mappers(tmp_path):
     assert reports[0]["num_rows"] not in (0, n)
     # IDENTICAL mappers everywhere despite skewed shards
     assert reports[0]["bounds"] == reports[1]["bounds"]
+    # tree_learner=serial trains rank-LOCAL models on the skewed shards —
+    # they must differ (the joint-model claim lives in
+    # test_multiproc_train.py, where tree_learner=data makes every rank
+    # emit the identical model)
+    assert reports[0]["model"] != reports[1]["model"]
     # single-process local-only binning of one skewed shard must differ —
     # otherwise this test would pass vacuously
     import lightgbm_tpu as lgb
