@@ -39,7 +39,8 @@ from ..ops.fused_level import (NCH_PRECISE, build_route_table,
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output)
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
-                      meta_is_cat, mono_child_bounds, node_feature_mask,
+                      meta_is_cat, mono_child_bounds,
+                      mono_inter_level_update, node_feature_mask,
                       update_leaf_groups)
 from .tree import TreeArrays, empty_tree
 
@@ -111,7 +112,7 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
                      "nch", "max_depth", "extra_levels", "has_cat",
                      "use_mono_bounds", "use_node_masks", "interpret",
                      "bundle_cols", "bundle_col_bins", "psum_axis",
-                     "defer_final_route"))
+                     "defer_final_route", "mono_mode"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -123,12 +124,15 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     bundle_cfg=None, interpret: bool = False,
                     psum_axis: str = None, root_hist: jax.Array = None,
                     defer_final_route: bool = False,
+                    mono_mode: str = "basic",
                     ):
     """Grow one tree with fused level passes.
 
     Args:
       bins_T: [Fp, Rp] int8/int16 transposed binned matrix; Rp a multiple
-        of 1024; padded feature rows all-zero; padded row COLUMNS can be
+        of 2048 (the widest kernel tile — smaller pow2 multiples still
+        work, the tile just shrinks to fit); padded feature rows
+        all-zero; padded row COLUMNS can be
         anything (their gh is zero and their leaf starts at -1). With EFB
         (``bundle_cols > 0``) the rows are BUNDLE columns carrying
         ``bundle_col_bins`` bins each; splits/histograms stay logical.
@@ -175,7 +179,6 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     B = max_bins
     use_bundles = bundle_cols > 0
     if use_bundles:
-        assert not has_cat, "EFB with categorical features is unsupported"
         k_foh, k_B = bundle_cols, bundle_col_bins   # kernel layout
     else:
         k_foh, k_B = f_oh, B
@@ -230,6 +233,13 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
     leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
     leaf_groups = jnp.full((L,), -1, jnp.int32)
+    # intermediate monotone mode: per-leaf bin-space regions over the
+    # LOGICAL features. Padded features (num_bin=0) get a fake [0, 1)
+    # region so they always overlap — splits never touch them, and the
+    # adjacency test needs overlap on every feature but one.
+    reg_lo = jnp.zeros((L, f_oh), jnp.int32)
+    reg_hi = jnp.broadcast_to(jnp.maximum(meta.num_bin, 1)[None, :],
+                              (L, f_oh)).astype(jnp.int32)
     root_mask = feature_mask[None, :]
     if use_node_masks:
         root_mask = root_mask & node_feature_mask(
@@ -258,14 +268,16 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         .at[:, 0].set(-2)
 
     state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-             leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl)
+             leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
+             reg_lo, reg_hi)
     for li, S_d in enumerate(caps):
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
                            use_mono_bounds, use_node_masks, node_masks,
                            li + 1, li == len(caps) - 1,
                            bundle_cols, bundle_col_bins, bundle_cfg,
-                           interpret, psum_axis, defer_final_route)
+                           interpret, psum_axis, defer_final_route,
+                           mono_mode)
     tree, leaf_T = state[0], state[1]
     if defer_final_route:
         return tree, leaf_T[0], state[11], state[12]
@@ -276,10 +288,13 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                S_d, nch, max_depth, has_cat, use_mono_bounds,
                use_node_masks, node_masks, fold, is_last,
                bundle_cols, bundle_col_bins, bundle_cfg, interpret,
-               psum_axis=None, defer_final_route=False):
+               psum_axis=None, defer_final_route=False,
+               mono_mode="basic"):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-     leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl) = state
+     leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
+     reg_lo, reg_hi) = state
     use_bundles = bundle_cols > 0
+    inter = use_mono_bounds and mono_mode == "intermediate"
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
 
@@ -303,7 +318,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
 
     def _apply_level(op, route_only):
         (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-         leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl) = op
+         leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
+         reg_lo, reg_hi) = op
         sel_i32 = selected.astype(jnp.int32)
         k_of_leaf = jnp.cumsum(sel_i32) - sel_i32
         new_of_leaf = jnp.where(selected, tree.num_leaves + k_of_leaf, -1)
@@ -334,7 +350,9 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                 feat_s, thr_s, dl_s, meta.num_bin, meta.missing_type,
                 meta.default_bin, bundle_cfg.default_bin,
                 bundle_cfg.col_of_feat, bundle_cfg.offset_of_feat,
-                bundle_cols, bundle_col_bins)
+                bundle_cols, bundle_col_bins,
+                cat_flag=cf_s if has_cat else None,
+                cat_mask=cm_s if has_cat else None)
         else:
             W = build_route_table(feat_s, thr_s, dl_s, meta.num_bin,
                                   meta.missing_type, meta.default_bin,
@@ -430,14 +448,29 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         def upd2(arr, lv, rv):
             arr = _masked_scatter(arr, slots, lv, selected)
             return _masked_scatter(arr, new_of_leaf, rv, selected)
+        if inter:
+            # intermediate monotone: sequential per-split clipping/fences
+            # over [L]-state (models/learner.mono_inter_level_update);
+            # clipped child outputs replace the raw scan outputs
+            (lv_inter, leaf_lo2, leaf_hi2, reg_lo2, reg_hi2,
+             mono_changed) = mono_inter_level_update(
+                tree.leaf_value, leaf_lo, leaf_hi, reg_lo, reg_hi,
+                selected, k_of_leaf, best.feature, best.threshold,
+                best.cat_flag, best.left_output, best.right_output,
+                meta.monotone, tree.num_leaves, Sp)
+            new_leaf_value = lv_inter
+        else:
+            new_leaf_value = upd2(tree.leaf_value, best.left_output,
+                                  best.right_output)
+            reg_lo2, reg_hi2 = reg_lo, reg_hi
+            mono_changed = None
         tree2 = tree._replace(
             num_leaves=tree.num_leaves + n_sel,
             split_feature=sf, threshold_bin=tb, default_left=dfl,
             cat_flag=cfw, cat_mask=cmw,
             split_gain=sg, internal_value=iv, internal_count=ic,
             internal_weight=iw, left_child=lc, right_child=rc,
-            leaf_value=upd2(tree.leaf_value, best.left_output,
-                            best.right_output),
+            leaf_value=new_leaf_value,
             leaf_count=upd2(tree.leaf_count, best.left_count,
                             best.right_count),
             leaf_weight=upd2(tree.leaf_weight, best.left_sum_hess,
@@ -447,15 +480,17 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
 
         # ---- bound/group propagation (cheap [L]-sized state upkeep,
         # shared by both variants)
-        if use_mono_bounds:
+        if use_mono_bounds and not inter:
             mono_dir = jnp.where(best.feature >= 0,
                                  meta.monotone[jnp.maximum(best.feature, 0)],
                                  0)
+            # reference gates constraint updates on is_numerical_split
+            mono_dir = jnp.where(best.cat_flag, 0, mono_dir)
             leaf_lo2, leaf_hi2 = mono_child_bounds(
                 leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
                 best.left_output, best.right_output,
                 jnp.arange(L, dtype=jnp.int32), new_of_leaf)
-        else:
+        elif not use_mono_bounds:
             leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
         if use_node_masks:
             leaf_groups2 = update_leaf_groups(
@@ -473,14 +508,19 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             best2 = best._replace(gain=g2)
             return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2,
                     lpn2, lil2, leaf_lo2, leaf_hi2, leaf_groups2,
-                    def_W2, def_tbl2)
+                    def_W2, def_tbl2, reg_lo2, reg_hi2)
 
         # ---- best splits for the 2*Sp fresh children only; each child's
         # own post-split output is the parent_output for path smoothing of
         # its prospective grandchildren (matches learner.py:208 and ref
-        # feature_histogram.hpp FindBestThreshold parent_output usage)
-        left_out = jnp.where(lof_on, best.left_output[lof_safe], 0.0)
-        right_out = jnp.where(lof_on, best.right_output[lof_safe], 0.0)
+        # feature_histogram.hpp FindBestThreshold parent_output usage).
+        # Intermediate mode reads the CLIPPED outputs from the tree.
+        if inter:
+            left_out = jnp.where(lof_on, tree2.leaf_value[lof_safe], 0.0)
+            right_out = jnp.where(lof_on, tree2.leaf_value[new_s], 0.0)
+        else:
+            left_out = jnp.where(lof_on, best.left_output[lof_safe], 0.0)
+            right_out = jnp.where(lof_on, best.right_output[lof_safe], 0.0)
         ch_g = jnp.concatenate([left_g, right_g], axis=0)
         ch_h = jnp.concatenate([left_h, right_h], axis=0)
         ch_c = jnp.concatenate([left_c, right_c], axis=0)
@@ -511,11 +551,40 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         best2 = _merge_best_many(best, lof_safe, left_bs, lof_on)
         best2 = _merge_best_many(best2, new_s, right_bs, lof_on)
 
+        if inter:
+            # stale-leaf recompute: pre-existing leaves whose bounds the
+            # cross-tightening touched re-derive their cached best split
+            # from the pool with the new bounds (ref:
+            # serial_tree_learner.cpp:706-714 recompute of leaves_to_update)
+            def _rescan(b):
+                node_ids = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
+                m = feature_mask[None, :]
+                if use_node_masks:
+                    m = m & node_feature_mask(node_masks, leaf_groups2,
+                                              node_ids)
+                bs_all = best_split_cm(
+                    pool_g2, pool_h2, pool_c2, meta.num_bin,
+                    meta.missing_type, meta.default_bin,
+                    jnp.broadcast_to(m, (L, f_oh)), meta_is_cat(meta),
+                    meta.monotone, params, tree2.leaf_value,
+                    has_cat=has_cat, use_bounds=True, bound_lo=leaf_lo2,
+                    bound_hi=leaf_hi2, leaf_depth=tree2.leaf_depth)
+
+                def merge(old, newv):
+                    mm = (mono_changed if old.ndim == 1
+                          else mono_changed[:, None])
+                    return jnp.where(mm, newv, old)
+                return BestSplit(*[merge(o, n) for o, n in zip(b, bs_all)])
+
+            best2 = jax.lax.cond(jnp.any(mono_changed), _rescan,
+                                 lambda b: b, best2)
+
         return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2, lpn2,
-                lil2, leaf_lo2, leaf_hi2, leaf_groups2, def_W2, def_tbl2)
+                lil2, leaf_lo2, leaf_hi2, leaf_groups2, def_W2, def_tbl2,
+                reg_lo2, reg_hi2)
 
     op0 = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-           leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl)
+           leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl, reg_lo, reg_hi)
 
     def dispatch(op):
         if is_last:

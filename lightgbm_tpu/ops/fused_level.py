@@ -70,19 +70,50 @@ VMEM_BUDGET = 15 * 1024 * 1024  # scoped-vmem stack limit is 16 MB; leave
 # headroom for W/ghs/D values and the pipeline's operand double buffers
 
 
-def default_tile_rows(Sp: int, FB: int, nch: int) -> int:
+def default_tile_rows(Sp: int, FB: int, nch: int,
+                      wide_bins: bool = False) -> int:
     """Row-tile width: the [FB, C] bf16 one-hot scratch (2 B/elem), the
-    [FB, C] i32 repeated-bins intermediate (4 B/elem — Mosaic on this
-    target only compiles i32 compares, so the unpack cannot stay in the
-    narrow native dtype) and the [FB, nch*Sp] f32 accumulator must fit the
-    scoped-VMEM stack together. Round 2's formula ignored the i32
-    intermediate and a 255-bin config exceeded the 16 MB stack limit —
-    caught on-chip in round 3."""
+    [FB, C] repeated-bins intermediate (2 B/elem bf16 for B <= 256, else
+    4 B/elem f32 — see _write_onehot) and the [FB, nch*Sp] f32
+    accumulator must fit the scoped-VMEM stack together. Round 2's
+    formula ignored the build intermediate entirely and a 255-bin config
+    exceeded the 16 MB stack limit — caught on-chip in round 3.
+
+    Shallow levels (small Sp -> small accumulator) get LARGER tiles:
+    their per-pass cost is floor-bound (oh-build + per-tile overheads,
+    PROFILE.md §5 — the Sp<=8 passes cost half the tree), so halving the
+    tile count halves the fixed per-tile cost where the MXU is padded
+    anyway."""
     acc = FB * nch * Sp * 4
     avail = max(VMEM_BUDGET - acc, 2 * 1024 * 1024)
-    c = avail // ((2 + 4) * FB)
+    c = avail // ((2 + (4 if wide_bins else 2)) * FB)
     c = 1 << max(7, (int(c)).bit_length() - 1)      # floor to pow2, >= 128
-    return int(min(1024, c))
+    return int(min(2048, c))
+
+
+def _fit_tile(C: int, R: int) -> int:
+    """Largest pow2 tile <= C dividing the padded row count."""
+    while C > 128 and R % C:
+        C //= 2
+    return C
+
+
+def _write_onehot(bins_ref, oh_ref, F_oh: int, B: int) -> None:
+    """oh[f*B+b, r] = 1.0 iff bins[f, r] == b, written to the VMEM
+    scratch. Built ARITHMETICALLY — relu(1 - |bins - b|) — in bf16:
+    integers <= 256 are exact in bf16, so the result is bit-identical to
+    a compare while the repeated-bins intermediate stays 2 B/elem
+    (Mosaic on this target compiles only i32 compares, which forced a
+    4 B/elem intermediate in the round-2/3 build). Bin counts > 256
+    (wide EFB bundle columns) use an f32 intermediate instead."""
+    C = bins_ref.shape[1]
+    FB = F_oh * B
+    dt = jnp.bfloat16 if B <= 256 else jnp.float32
+    big = jnp.repeat(bins_ref[:F_oh].astype(dt), B, axis=0)     # [FB, C]
+    iota_b = (jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B) \
+        .astype(dt)
+    oh_ref[:] = jnp.maximum(1.0 - jnp.abs(big - iota_b), 0.0) \
+        .astype(jnp.bfloat16)
 
 
 def max_slot_cap(FB: int, nch: int, budget: int = 4 * 1024 * 1024) -> int:
@@ -191,7 +222,9 @@ def build_route_table_bundled(feature: jax.Array, threshold: jax.Array,
                               most_freq_bin: jax.Array,
                               col_of_feat: jax.Array,
                               offset_of_feat: jax.Array,
-                              C_cols: int, Bp: int) -> jax.Array:
+                              C_cols: int, Bp: int,
+                              cat_flag: jax.Array = None,
+                              cat_mask: jax.Array = None) -> jax.Array:
     """W [Sp, C_cols*Bp] for LOGICAL splits over EFB bundle columns.
 
     A bundle-bin bb of column c decodes to logical feature f's bin as
@@ -201,7 +234,8 @@ def build_route_table_bundled(feature: jax.Array, threshold: jax.Array,
     carries the decision; all other columns stay zero so the routing dot
     D = W @ one_hot still reads each row's verdict from exactly one
     lane. Missing-bin semantics follow the numerical rule on the DECODED
-    bin (ref: src/io/dense_bin.hpp Split)."""
+    bin (ref: src/io/dense_bin.hpp Split); categorical splits test the
+    DECODED bin's membership in ``cat_mask`` [Sp, B_logical]."""
     F = num_bin.shape[0]
     Sp = feature.shape[0]
     c_iota = jnp.arange(C_cols, dtype=jnp.int32)[None, :, None]
@@ -222,6 +256,11 @@ def build_route_table_bundled(feature: jax.Array, threshold: jax.Array,
     is_missing = (((mt == 1) & (logical_bin == db))
                   | ((mt == 2) & (logical_bin == nb - 1)))
     go_left = jnp.where(is_missing, dl, logical_bin <= thr)
+    if cat_flag is not None:
+        B = cat_mask.shape[1]
+        lb = jnp.clip(logical_bin, 0, B - 1)
+        cat_left = cat_mask[jnp.arange(Sp)[:, None, None], lb]
+        go_left = jnp.where(cat_flag[:, None, None], cat_left, go_left)
     w = (c_iota == col) & go_left & (feature[:, None, None] >= 0)
     return w.reshape(Sp, C_cols * Bp).astype(jnp.bfloat16)
 
@@ -267,14 +306,7 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
     C = bins_ref.shape[1]
     FB = F_oh * B
 
-    # ---- bin one-hot [FB, C]: bulk int8->int32 unpack once, sublane
-    # repeat, one compare (i32 is the only compare dtype Mosaic compiles
-    # on this target; its 4 B/elem VMEM cost is charged in
-    # default_tile_rows)
-    bins_val = bins_ref[:].astype(jnp.int32)                   # [Fp, C]
-    big = jnp.repeat(bins_val[:F_oh], B, axis=0)               # [FB, C]
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
-    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+    _write_onehot(bins_ref, oh_ref, F_oh, B)
 
     leafb = leaf_ref[:]                                        # [1, C] i32
 
@@ -351,7 +383,8 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
     B = num_bins
     FB = f_oh * B
     Sp = tbl.shape[0]
-    C = tile_rows or default_tile_rows(Sp, FB, nch)
+    C = _fit_tile(tile_rows or default_tile_rows(Sp, FB, nch,
+                                                 wide_bins=B > 256), R)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
     T = R // C
 
@@ -390,10 +423,7 @@ def _route_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, newleaf_ref,
     the histogram dot is ~60% of a deep pass's cost."""
     C = bins_ref.shape[1]
     FB = F_oh * B
-    bins_val = bins_ref[:].astype(jnp.int32)
-    big = jnp.repeat(bins_val[:F_oh], B, axis=0)
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
-    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+    _write_onehot(bins_ref, oh_ref, F_oh, B)
     leafb = leaf_ref[:]
     D = jax.lax.dot_general(w_ref[:], oh_ref[:], (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
@@ -424,7 +454,8 @@ def route_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
     B = num_bins
     FB = f_oh * B
     Sp = tbl.shape[0]
-    C = tile_rows or default_tile_rows(Sp, FB, NCH_FAST)
+    C = _fit_tile(tile_rows or default_tile_rows(Sp, FB, NCH_FAST,
+                                                 wide_bins=B > 256), R)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
     kernel = functools.partial(_route_kernel, B=B, F_oh=f_oh, Sp=Sp)
     new_leaf = pl.pallas_call(
@@ -472,10 +503,7 @@ def _epilogue_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, lv_ref, score_ref,
 
     C = bins_ref.shape[1]
     FB = F_oh * B
-    bins_val = bins_ref[:].astype(jnp.int32)
-    big = jnp.repeat(bins_val[:F_oh], B, axis=0)
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B
-    oh_ref[:] = (big == iota_b).astype(jnp.bfloat16)
+    _write_onehot(bins_ref, oh_ref, F_oh, B)
     oh = oh_ref[:]
 
     # ---- final-level routing (same contract as _route_kernel; an
@@ -581,7 +609,8 @@ def epilogue_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
     Sp = tbl.shape[0]
     L = leaf_values.shape[0]
     Lp = _round_up(max(L, 8), 8)
-    C = tile_rows or default_tile_rows(8, FB, nch)
+    C = _fit_tile(tile_rows or default_tile_rows(8, FB, nch,
+                                                 wide_bins=B > 256), R)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
     lvp = jnp.zeros((Lp, 128), jnp.float32).at[:L, 0].set(leaf_values)
     kernel = functools.partial(_epilogue_kernel, B=B, F_oh=f_oh, Sp=Sp,

@@ -21,7 +21,7 @@ def main():
     R = int(os.environ.get("ROWS", 10_500_000))
     reps = int(os.environ.get("REPS", 5))
     F, B = fl.feature_layout(28, 63)
-    Rp = ((R + 1023) // 1024) * 1024
+    Rp = ((R + 2047) // 2048) * 2048   # widest tile (shallow passes)
     Fp = max(F, 8)
     rng = np.random.RandomState(0)
     bins_T = jnp.asarray(
@@ -31,32 +31,38 @@ def main():
     ones = jnp.ones((Rp,), jnp.float32)
 
     print(f"rows={R} (padded {Rp}) F_oh={F} B={B}")
+    # tiles=0: the Sp-aware default (2048 at shallow Sp since round 4);
+    # explicit 1024 reproduces the round-2/3 fixed tile for the A/B
+    tile_list = [int(t) for t in
+                 os.environ.get("TILES", "0,1024").split(",")]
     for nch in (5, 3):
         gh_T = fl.pack_gh(g, ones, ones, nch)
-        for Sp in (8, 16, 32, 64, 128):
+        for Sp in (1, 2, 4, 8, 16, 32, 64, 128):
             W = jnp.zeros((Sp, F * B), jnp.bfloat16).at[0, :B].set(1)
             tbl = (jnp.zeros((Sp, 128), jnp.int32)
                    .at[:, 0].set(-2).at[0, 0].set(0).at[0, 2].set(1))
 
-            # fetch-based timing: block_until_ready through the axon
-            # tunnel returns early (PROFILE.md §0); chain the passes
-            # data-dependently via the leaf vector and pull a scalar
-            def one(lt):
-                h, nl = fl.level_pass(bins_T, lt, gh_T, W, tbl,
-                                      num_slots=Sp, num_bins=B, f_oh=F,
-                                      nch=nch)
-                return h, nl
-            h, nl = one(leaf_T)
-            float(jnp.sum(h))
-            t0 = time.perf_counter()
-            lt = leaf_T
-            for _ in range(reps):
-                h, lt = one(lt)
-            float(jnp.sum(h))
-            dt = (time.perf_counter() - t0) / reps
-            bw = Fp * Rp / dt / 1e9
-            print(f"  nch={nch} Sp={Sp:4d}  {dt*1e3:8.1f} ms/pass"
-                  f"  ({bw:5.1f} GB/s bins)")
+            for tile in tile_list:
+                # fetch-based timing: block_until_ready through the axon
+                # tunnel returns early (PROFILE.md §0); chain the passes
+                # data-dependently via the leaf vector and pull a scalar
+                def one(lt):
+                    h, nl = fl.level_pass(bins_T, lt, gh_T, W, tbl,
+                                          num_slots=Sp, num_bins=B,
+                                          f_oh=F, nch=nch, tile_rows=tile)
+                    return h, nl
+                h, nl = one(leaf_T)
+                float(jnp.sum(h))
+                t0 = time.perf_counter()
+                lt = leaf_T
+                for _ in range(reps):
+                    h, lt = one(lt)
+                float(jnp.sum(h))
+                dt = (time.perf_counter() - t0) / reps
+                bw = Fp * Rp / dt / 1e9
+                eff_tile = tile or fl.default_tile_rows(Sp, F * B, nch)
+                print(f"  nch={nch} Sp={Sp:4d} tile={eff_tile:5d}"
+                      f"  {dt*1e3:8.1f} ms/pass  ({bw:5.1f} GB/s bins)")
 
     table = jnp.asarray(rng.randn(255).astype(np.float32))
     idx = jnp.asarray(rng.randint(0, 255, size=(1, Rp)).astype(np.int32))
