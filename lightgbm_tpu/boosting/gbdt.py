@@ -290,10 +290,6 @@ class GBDT:
             if not (eng == "fused"
                     or (eng == "auto" and on_tpu and HAS_PALLAS)):
                 return
-        if self.has_cat:
-            log.warning("feature bundling with categorical features is "
-                        "not supported yet; disabled")
-            return
         if getattr(self, "n_forced", 0) > 0:
             return  # forced splits route through the leaf-wise grower
         from ..ops.efb import BundleLayout, encode_bundles, find_bundles
@@ -305,15 +301,29 @@ class GBDT:
         masks = [bins_np[:, k] != mfb[k]
                  for k in range(train_data.num_features)]
         nb_all = [int(x) for x in np.asarray(self.meta.num_bin)]
-        # keep the uniform column padding (Bc) economical: bundles are
-        # capped at 4x the widest feature (jagged column offsets are a
-        # round-3 improvement)
-        bundles = find_bundles(masks, self.num_data,
-                               max_conflict_rate=0.0,
-                               max_bundle_bins=4 * self.max_bins,
-                               num_bin_per_feat=nb_all)
-        if len(bundles) >= train_data.num_features:
-            return  # nothing to gain
+        # reference-parity bundling: tolerated conflicts at the
+        # single_val_max_conflict_cnt rate (ref: dataset.cpp:108
+        # total/10000). The reference's jagged per-group offsets have no
+        # kernel analog here — every bundle column is padded to the
+        # widest (the one-hot bin extraction needs a uniform per-column
+        # stride) — so the width cap is chosen ADAPTIVELY: start
+        # uncapped like the reference, and only tighten when the
+        # uniform padding would inflate the stored matrix
+        # 32767 = int16 ceiling of the fused kernel's transposed bin
+        # matrix (a wider bundle would wrap negative in _init_fused's
+        # astype(int16) and zero the one-hot); the reference is uncapped
+        # because its jagged storage never widens a column
+        for cap in (32767, 8 * self.max_bins, 4 * self.max_bins):
+            bundles = find_bundles(masks, self.num_data,
+                                   max_conflict_rate=1e-4,
+                                   max_bundle_bins=cap,
+                                   num_bin_per_feat=nb_all)
+            if len(bundles) >= train_data.num_features:
+                return  # nothing to gain
+            widths = [1 + sum(nb_all[f] for f in b) for b in bundles]
+            padded = len(bundles) * max(widths)
+            if padded <= 2 * sum(widths):
+                break  # padding waste bounded; keep this layout
         layout = BundleLayout(bundles, nb_all)
         enc = encode_bundles(bins_np, mfb, layout)
         self._install_bundle_layout(train_data, layout, enc,
@@ -419,9 +429,20 @@ class GBDT:
         self.cegb_coupled = jnp.asarray(cp)
         if not hasattr(self, "cegb_used"):
             self.cegb_used = np.zeros(train_data.num_features, bool)
-        if lazy:
-            log.warning("cegb_penalty_feature_lazy is not supported; "
-                        "ignoring the lazy per-row penalties")
+        # per-(row, feature) lazy penalties (ref:
+        # cost_effective_gradient_boosting.hpp:22 — charged per data
+        # point in the leaf that has not used the feature on its path
+        # yet; the used bitmap persists across the whole boosting run)
+        lp = np.zeros(train_data.num_features, np.float32)
+        for real_f, pen in enumerate(lazy):
+            inner = train_data.inner_feature_index(real_f)
+            if inner >= 0:
+                lp[inner] = pen
+        self.use_cegb_lazy = bool(np.any(lp > 0))
+        self.cegb_lazy = jnp.asarray(lp)
+        if self.use_cegb_lazy and not hasattr(self, "cegb_used_rf"):
+            self.cegb_used_rf = jnp.zeros(
+                (train_data.num_data, train_data.num_features), bool)
 
     # ------------------------------------------------------------------
     def _setup_parallel(self, config: Config) -> None:
@@ -460,6 +481,12 @@ class GBDT:
                 "training serially (multi-chip needs a TPU slice or "
                 "XLA_FLAGS=--xla_force_host_platform_device_count)", mode)
             return
+        if getattr(self, "use_cegb_lazy", False):
+            log.warning("cegb_penalty_feature_lazy keeps a per-(row, "
+                        "feature) bitmap on one device and is not wired "
+                        "into the distributed growers; dropping the lazy "
+                        "penalties for this parallel run")
+            self.use_cegb_lazy = False
         if jax.process_count() > 1 and mode == "feature":
             # feature-parallel replicates rows on every shard; multi-
             # process runs hold one rank-local row shard per process
@@ -474,12 +501,6 @@ class GBDT:
             log.warning("tree_learner=feature does not compose with "
                         "interaction/bynode constraints, CEGB, forced "
                         "splits or EFB; using data-parallel")
-            mode = "data"
-        if mode == "voting" and self.has_cat:
-            # the vote ranks numerical gains only; categorical columns
-            # would never win — degrade rather than silently mistrain
-            log.warning("voting-parallel does not rank categorical splits; "
-                        "using data-parallel")
             mode = "data"
         if mode == "voting" and getattr(self, "n_forced", 0):
             log.warning("forced splits use the leaf-wise grower; "
@@ -632,7 +653,8 @@ class GBDT:
                     bundle_cols=self.fused_bundle_cols,
                     bundle_col_bins=self.fused_bundle_col_bins,
                     bundle_cfg=self.fused_bundle_cfg,
-                    interpret=interp, psum_axis=axis)
+                    interpret=interp, psum_axis=axis,
+                    mono_mode=getattr(self, "mono_mode", "basic"))
             in_specs = (P(None, axis), P(None, axis), P()) + \
                 ((P(),) if use_nm else ())
             return jax.jit(jax.shard_map(
@@ -642,7 +664,8 @@ class GBDT:
         if kind == "xla_sync":
             mode = self.parallel_mode
             grow = (grow_tree_leafwise if self.grow_policy == "leafwise"
-                    and mode == "data" else grow_tree_depthwise)
+                    and mode in ("data", "voting")
+                    else grow_tree_depthwise)
             hist_impl = self._xla_hist_impl()
             use_nm = self.use_node_masks
             use_cegb = self.use_cegb
@@ -678,7 +701,7 @@ class GBDT:
                     per_shard, mesh=self.mesh, in_specs=(P(), P(), P()),
                     out_specs=(P(), P()), check_vma=False))
 
-            kw = {}
+            kw = {"mono_mode": getattr(self, "mono_mode", "basic")}
             if mode == "voting":
                 kw.update(parallel_mode="voting",
                           top_k=int(self.config.top_k))
@@ -688,8 +711,9 @@ class GBDT:
                 kw.update(use_bundles=True, bundle_cfg=self.bundle_cfg,
                           bundle_col_bins=self.bundle_col_bins)
             if grow is grow_tree_leafwise:
-                kw = {k: v for k, v in kw.items()
-                      if k not in ("parallel_mode", "top_k")}
+                # leaf-wise accepts parallel_mode/top_k since round 4
+                # (voting under best-first growth); forced splits remain
+                # data-mode-only
                 kw["mono_mode"] = getattr(self, "mono_mode", "basic")
                 if n_forced:
                     kw.update(n_forced=n_forced,
@@ -801,17 +825,11 @@ class GBDT:
         self.mono_mode = "basic"
         if getattr(self, "use_mono_bounds", False):
             method = str(self.config.monotone_constraints_method)
-            if method == "advanced":
-                log.warning("monotone_constraints_method=advanced is not "
-                            "implemented; using intermediate")
-                method = "intermediate"
-            if method == "intermediate":
-                self.mono_mode = "intermediate"
-                if engine != "xla" and self.parallel_mode in ("serial",
-                                                              "data"):
-                    log.info("monotone_constraints_method=intermediate "
-                             "runs on the leaf-wise XLA grower")
-                    engine = "xla"
+            if method in ("intermediate", "advanced"):
+                # round 4: intermediate on ALL growers (leaf-wise inline,
+                # depthwise/fused via mono_inter_level_update); advanced
+                # (per-segment bound planes) on the leaf-wise grower
+                self.mono_mode = method
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
             engine = "xla"
@@ -845,18 +863,27 @@ class GBDT:
                           else "leafwise")
         self.grow_policy = {"auto": default_policy}.get(config.grow_policy,
                                                         config.grow_policy)
-        if self.parallel_mode in ("voting", "feature") \
+        if self.parallel_mode == "feature" \
                 and self.grow_policy != "depthwise":
-            log.warning("tree_learner=%s is implemented on the depthwise "
-                        "grower; switching grow_policy", self.parallel_mode)
+            # voting composes with leaf-wise growth since round 4 (the
+            # reference's voting learner runs best-first too,
+            # voting_parallel_tree_learner.cpp:151-184); feature-parallel
+            # stays on the depthwise column-slice exchange
+            log.warning("tree_learner=feature is implemented on the "
+                        "depthwise grower; switching grow_policy")
             self.grow_policy = "depthwise"
-        if self.mono_mode == "intermediate":
-            if self.grow_policy != "leafwise" \
-                    or self.parallel_mode in ("voting", "feature"):
-                log.warning("the intermediate monotone recompute runs on "
-                            "the leaf-wise grower; this configuration "
-                            "enforces the basic mode instead")
-                self.mono_mode = "basic"
+        if self.mono_mode == "advanced" and self.grow_policy != "leafwise":
+            log.warning("monotone_constraints_method=advanced (segment "
+                        "bound planes) runs on the leaf-wise grower; this "
+                        "configuration uses intermediate instead")
+            self.mono_mode = "intermediate"
+        if self.mono_mode in ("intermediate", "advanced") \
+                and self.parallel_mode in ("voting", "feature"):
+            log.warning("the intermediate/advanced monotone recompute is "
+                        "not wired into the voting/feature-parallel "
+                        "exchanges; this configuration enforces the basic "
+                        "mode instead")
+            self.mono_mode = "basic"
         if getattr(self, "use_cegb", False) \
                 and self.grow_policy != "depthwise":
             log.warning("CEGB is implemented on the depthwise grower; "
@@ -908,8 +935,10 @@ class GBDT:
         F = train_data.num_features
         F_oh, Bp = feature_layout(F, self.max_bins)
         R = self.num_data
-        # data-parallel shards each need kernel-tile-aligned local rows
-        blk = 1024 * (self.n_shards if self.parallel_mode == "data" else 1)
+        # data-parallel shards each need kernel-tile-aligned local rows;
+        # 2048 = the widest shallow-pass tile (default_tile_rows cap), so
+        # shallow levels can actually run at the bigger tile
+        blk = 2048 * (self.n_shards if self.parallel_mode == "data" else 1)
         Rp = ((R + blk - 1) // blk) * blk
         if getattr(self, "use_bundles", False):
             n_cols = int(self.bundle_bins_dev.shape[1])
@@ -1254,7 +1283,8 @@ class GBDT:
                 bundle_cols=self.fused_bundle_cols,
                 bundle_col_bins=self.fused_bundle_col_bins,
                 bundle_cfg=self.fused_bundle_cfg,
-                interpret=self.fused_interpret)
+                interpret=self.fused_interpret,
+                mono_mode=getattr(self, "mono_mode", "basic"))
             return tree, row_leaf[:n]
         if self.use_frontier:
             from ..models.frontier import grow_tree_frontier
@@ -1267,7 +1297,8 @@ class GBDT:
                 int(self.config.max_depth), hist_impl="pallas")
         if self.grow_policy == "depthwise":
             ub = getattr(self, "use_bundles", False)
-            return grow_tree_depthwise(
+            lazy = getattr(self, "use_cegb_lazy", False)
+            out = grow_tree_depthwise(
                 self.bundle_bins_dev if ub else self.bins_dev, gh,
                 self.meta, fm, self.params,
                 self.max_leaves, self.max_bins,
@@ -1282,7 +1313,15 @@ class GBDT:
                            if self.use_cegb else None),
                 use_bundles=ub,
                 bundle_cfg=self.bundle_cfg if ub else None,
-                bundle_col_bins=(self.bundle_col_bins if ub else 0))
+                bundle_col_bins=(self.bundle_col_bins if ub else 0),
+                mono_mode=getattr(self, "mono_mode", "basic"),
+                use_cegb_lazy=lazy,
+                cegb_lazy=self.cegb_lazy if lazy else None,
+                cegb_used_rf=self.cegb_used_rf if lazy else None)
+            if lazy:
+                tree, row_leaf, self.cegb_used_rf = out
+                return tree, row_leaf
+            return out
         n_forced = getattr(self, "n_forced", 0)
         ub = getattr(self, "use_bundles", False)
         return grow_tree_leafwise(
@@ -1671,7 +1710,8 @@ class GBDT:
                     bundle_cols=self.fused_bundle_cols,
                     bundle_col_bins=self.fused_bundle_col_bins,
                     bundle_cfg=self.fused_bundle_cfg,
-                    interpret=interp, psum_axis=axis)
+                    interpret=interp, psum_axis=axis,
+                    mono_mode=getattr(self, "mono_mode", "basic"))
                 delta = table_lookup(row_leaf[None, :],
                                      tree.leaf_value * shrink,
                                      interpret=interp)[0]
@@ -1714,7 +1754,8 @@ class GBDT:
                         bundle_cols=self.fused_bundle_cols,
                         bundle_col_bins=self.fused_bundle_col_bins,
                         bundle_cfg=self.fused_bundle_cfg,
-                        interpret=interp)
+                        interpret=interp,
+                        mono_mode=getattr(self, "mono_mode", "basic"))
                     delta = table_lookup(row_leaf[None, :],
                                          tree.leaf_value * shrink,
                                          interpret=interp)[0, :n]
@@ -1788,7 +1829,8 @@ class GBDT:
                 bundle_cols=self.fused_bundle_cols,
                 bundle_col_bins=self.fused_bundle_col_bins,
                 bundle_cfg=self.fused_bundle_cfg, interpret=interp,
-                root_hist=hist0, defer_final_route=True)
+                root_hist=hist0, defer_final_route=True,
+                mono_mode=getattr(self, "mono_mode", "basic"))
 
         def epilogue(bins_T, leafT, W_l, tbl_l, tree, score_pad, ops_T,
                      bag_next):

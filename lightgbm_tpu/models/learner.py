@@ -54,7 +54,8 @@ def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
                params: SplitParams, parent_output: jax.Array,
                has_cat: bool = False, use_bounds: bool = False,
                bound_lo=None, bound_hi=None, leaf_depth=None,
-               cegb_delta=None) -> BestSplit:
+               cegb_delta=None, bound_lo_plane=None,
+               bound_hi_plane=None) -> BestSplit:
     """Channel-minor convenience wrapper over the combined numerical +
     categorical scan (ref: feature_histogram.hpp:85 FindBestThreshold)."""
     return best_split_cm(
@@ -62,7 +63,8 @@ def best_split(hist: jax.Array, meta: FeatureMeta, feature_mask: jax.Array,
         meta.missing_type, meta.default_bin, feature_mask,
         meta_is_cat(meta), meta.monotone, params, parent_output,
         has_cat=has_cat, use_bounds=use_bounds, bound_lo=bound_lo,
-        bound_hi=bound_hi, leaf_depth=leaf_depth, cegb_delta=cegb_delta)
+        bound_hi=bound_hi, leaf_depth=leaf_depth, cegb_delta=cegb_delta,
+        bound_lo_plane=bound_lo_plane, bound_hi_plane=bound_hi_plane)
 
 
 class NodeMaskCfg(NamedTuple):
@@ -215,16 +217,22 @@ def bundle_views(bundle_hist: jax.Array, cfg: BundleCfg) -> jax.Array:
 
 
 def cegb_delta_matrix(params: SplitParams, coupled_penalty, used_features,
-                      leaf_counts):
+                      leaf_counts, lazy_penalty=None, unused_cnt=None):
     """[S, F] CEGB gain delta: tradeoff*penalty_split*n_leaf plus the
-    one-time coupled feature cost for features not yet used in any split
-    (ref: cost_effective_gradient_boosting.hpp:66 DetlaGain; the per-row
-    lazy penalty is not implemented)."""
+    one-time coupled feature cost for features not yet used in any split,
+    plus the per-row LAZY cost — penalty[f] per data point in the leaf
+    whose path has not used feature f yet (ref:
+    cost_effective_gradient_boosting.hpp:66 DetlaGain; ``unused_cnt``
+    [S, F] comes from a segment-sum of the persistent used bitmap)."""
     split_pen = (params.cegb_tradeoff * params.cegb_penalty_split
                  * leaf_counts[:, None])
     feat_pen = params.cegb_tradeoff * jnp.where(used_features, 0.0,
                                                 coupled_penalty)[None, :]
-    return split_pen + feat_pen
+    delta = split_pen + feat_pen
+    if lazy_penalty is not None:
+        delta = delta + (params.cegb_tradeoff * lazy_penalty[None, :]
+                         * unused_cnt)
+    return delta
 
 
 def mono_child_bounds(lo, hi, new_lo, new_hi, sel, mono_dir,
@@ -249,6 +257,149 @@ def mono_child_bounds(lo, hi, new_lo, new_hi, sel, mono_dir,
     lo2 = _masked_scatter(lo2, new_idx, r_lo, sel)
     hi2 = _masked_scatter(hi2, new_idx, r_hi, sel)
     return lo2, hi2
+
+
+def region_adjacency(q_lo, q_hi, c_lo, c_hi, mask, monotone,
+                     per_dim: bool = False):
+    """Monotone region adjacency of every leaf box q against C child
+    boxes — the ONE implementation of the predicate used by the
+    intermediate/advanced machinery (vectorized form of the reference's
+    GoUp/GoDown contiguity walk): boxes overlap on every feature but
+    one monotone g, and q lies strictly beyond the child on g.
+
+    q_lo/q_hi: [L, F] bin-space boxes; c_lo/c_hi: [C, F]; mask: [L] or
+    [L, C] gating which q count; monotone: [F]. Returns (up, dn) as
+    [L, C] any-dim booleans, or [L, C, F] per-dim masks with
+    ``per_dim=True`` (the advanced mode needs the adjacency feature to
+    build its shadow planes)."""
+    F = q_lo.shape[1]
+    ql = q_lo[:, None, :]
+    qh = q_hi[:, None, :]
+    cl = c_lo[None, :, :]
+    ch = c_hi[None, :, :]
+    ov = (ql < ch) & (cl < qh)                       # [L, C, F]
+    cnt = jnp.sum(ov.astype(jnp.int32), axis=2)
+    ov_except = (cnt[:, :, None] - ov.astype(jnp.int32)) == (F - 1)
+    m = mask[:, None] if mask.ndim == 1 else mask
+    gate = ov_except & m[:, :, None]
+    above = gate & (ql >= ch)
+    below = gate & (qh <= cl)
+    d = monotone[None, None, :]
+    up = ((d > 0) & above) | ((d < 0) & below)
+    dn = ((d > 0) & below) | ((d < 0) & above)
+    if per_dim:
+        return up, dn
+    return jnp.any(up, axis=2), jnp.any(dn, axis=2)
+
+
+def mono_inter_level_update(leaf_value, leaf_lo, leaf_hi, reg_lo, reg_hi,
+                            selected, k_of_leaf, feature, threshold,
+                            cat_flag, left_out, right_out, monotone,
+                            num_leaves_before, n_slots: int):
+    """Intermediate-mode bookkeeping for one LEVEL of simultaneous splits
+    (ref: monotone_constraints.hpp:514 IntermediateLeafConstraints —
+    raw-output fences, region-aware clipping of fresh child outputs
+    against adjacent leaves, cross-tree tightening of other leaves).
+
+    The O(rows) routing/histogram work stays batched in the level kernel;
+    THIS bookkeeping runs the level's splits SEQUENTIALLY in slot (gain
+    rank) order over [L]-sized state — the same ordering the leaf-wise
+    grower uses, which is what guarantees every pair of region-adjacent
+    leaves ends the level with ordered outputs (simultaneous clipping
+    cannot: two fresh children of different parents may both clip only
+    against pre-level leaves and stay inverted; chains of fresh leaves
+    need the inductive one-at-a-time argument).
+
+    All arrays are [L]-sized ([L, F] for regions); ``k_of_leaf`` ranks
+    the selected leaves; the k-th split's right child gets id
+    ``num_leaves_before + k``. Returns (leaf_value2, lo2, hi2, reg_lo2,
+    reg_hi2, changed) where ``changed`` marks pre-existing leaves whose
+    bounds tightened (their cached best splits are stale)."""
+    L, F = reg_lo.shape
+
+    def _adj(q_lo, q_hi, mask_q, c_lo, c_hi):
+        return region_adjacency(q_lo, q_hi, c_lo, c_hi, mask_q, monotone)
+
+    def body(k, st):
+        lv, lo, hi, rlo, rhi, changed = st
+        hit = selected & (k_of_leaf == k)
+        has = jnp.any(hit)
+        l = jnp.argmax(hit)
+        new = num_leaves_before + k
+        f = jnp.maximum(feature[l], 0)
+        t = threshold[l]
+        cf = cat_flag[l]
+        is_num = ~cf
+        o_l0 = left_out[l]
+        o_n0 = right_out[l]
+        mono_d = jnp.where(is_num, monotone[f], 0)
+
+        # regions: numerical split cuts the parent's box at t+1
+        parent_lo = rlo[l]
+        parent_hi = rhi[l]
+        l_hi_r = parent_hi.at[f].set(jnp.where(is_num, t + 1,
+                                               parent_hi[f]))
+        n_lo_r = parent_lo.at[f].set(jnp.where(is_num, t + 1,
+                                               parent_lo[f]))
+        rlo2 = rlo.at[new].set(n_lo_r)
+        rhi2 = rhi.at[new].set(parent_hi).at[l].set(l_hi_r)
+
+        c_lo = jnp.stack([parent_lo, n_lo_r])
+        c_hi = jnp.stack([l_hi_r, parent_hi])
+        active = (jnp.arange(L) < num_leaves_before + k)
+
+        # region-aware clipping vs CURRENT leaves (pre-level leaves AND
+        # this level's already-processed children — the sequential order
+        # is what covers fresh-fresh adjacency)
+        exist = active & (jnp.arange(L) != l)
+        q_up, q_dn = _adj(rlo, rhi, exist, c_lo, c_hi)
+        qv = lv[:, None]
+        c_hi_b = jnp.min(jnp.where(q_up, qv, jnp.inf), axis=0)
+        c_lo_b = jnp.max(jnp.where(q_dn, qv, -jnp.inf), axis=0)
+        o_l = jnp.clip(o_l0, c_lo_b[0], c_hi_b[0])
+        o_n = jnp.clip(o_n0, c_lo_b[1], c_hi_b[1])
+        # sibling order must survive the independent clips
+        o_n = jnp.where(mono_d > 0, jnp.maximum(o_n, o_l), o_n)
+        o_n = jnp.where(mono_d < 0, jnp.minimum(o_n, o_l), o_n)
+
+        lv2 = lv.at[l].set(jnp.where(has, o_l, lv[l]))
+        lv2 = lv2.at[new].set(jnp.where(has, o_n, lv2[new]))
+
+        # inherited bounds + raw-output fences (looser than basic's mid)
+        # then the adjacency clip bounds, with CLIPPED outputs
+        p_lo, p_hi = lo[l], hi[l]
+        l_hi = jnp.where(mono_d > 0, jnp.minimum(p_hi, o_n), p_hi)
+        l_lo = jnp.where(mono_d < 0, jnp.maximum(p_lo, o_n), p_lo)
+        n_lo = jnp.where(mono_d > 0, jnp.maximum(p_lo, o_l), p_lo)
+        n_hi = jnp.where(mono_d < 0, jnp.minimum(p_hi, o_l), p_hi)
+        lo2 = lo.at[l].set(jnp.maximum(l_lo, c_lo_b[0])) \
+            .at[new].set(jnp.maximum(n_lo, c_lo_b[1]))
+        hi2 = hi.at[l].set(jnp.minimum(l_hi, c_hi_b[0])) \
+            .at[new].set(jnp.minimum(n_hi, c_hi_b[1]))
+
+        # cross-tighten the OTHER leaves by the new (clipped) outputs
+        other = active & (jnp.arange(L) != l)
+        q_up2, q_dn2 = _adj(rlo2, rhi2, other, c_lo, c_hi)
+        co = jnp.stack([o_l, o_n])[None, :]
+        lo_cand = jnp.max(jnp.where(q_up2, co, -jnp.inf), axis=1)
+        hi_cand = jnp.min(jnp.where(q_dn2, co, jnp.inf), axis=1)
+        lo3 = jnp.maximum(lo2, lo_cand)
+        hi3 = jnp.minimum(hi2, hi_cand)
+        changed2 = changed | (lo3 > lo2) | (hi3 < hi2)
+
+        def keep(_):
+            return lv, lo, hi, rlo, rhi, changed
+        def take(_):
+            return lv2, lo3, hi3, rlo2, rhi2, changed2
+        return jax.lax.cond(has, take, keep, None)
+
+    lv, lo, hi, rlo, rhi, changed = jax.lax.fori_loop(
+        0, n_slots, body,
+        (leaf_value, leaf_lo, leaf_hi, reg_lo, reg_hi,
+         jnp.zeros((L,), bool)))
+    # fresh children are rescanned by the level flow anyway
+    changed = changed & (jnp.arange(L) < num_leaves_before) & ~selected
+    return lv, lo, hi, rlo, rhi, changed
 
 
 def _route_left(bins_col: jax.Array, t: jax.Array, default_left: jax.Array,
@@ -322,7 +473,8 @@ def _masked_gain(best: BestSplit, leaf_depth, num_leaves, max_depth: int,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat",
                      "use_mono_bounds", "use_node_masks", "n_forced",
-                     "use_bundles", "bundle_col_bins", "mono_mode"))
+                     "use_bundles", "bundle_col_bins", "mono_mode",
+                     "parallel_mode", "top_k"))
 def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        feature_mask: jax.Array, params: SplitParams,
                        num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -338,6 +490,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                        bundle_cfg: "BundleCfg" = None,
                        bundle_col_bins: int = 0,
                        mono_mode: str = "basic",
+                       parallel_mode: str = "data",
+                       top_k: int = 20,
                        ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree leaf-wise (best-first), entirely on device.
 
@@ -354,13 +508,50 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     if use_bundles:
         # ``bins`` holds EFB bundle columns (ref: src/io/dataset.cpp
         # feature groups); histograms/scans stay logical via the views
-        assert not has_cat, "EFB with categorical features is unsupported"
         F = bundle_cfg.flat_idx.shape[0]
     L = num_leaves
     B = max_bins
 
     def _psum(h):
         return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
+
+    # voting-parallel under LEAF-WISE growth (ref:
+    # voting_parallel_tree_learner.cpp:151-184 — the reference's voting
+    # learner composes with standard best-first growth): each step the
+    # shards vote their local top_k features on the smaller child's
+    # histogram, only the 2*top_k winners' columns are summed over the
+    # mesh, and a per-leaf validity plane gates later scans (the
+    # sibling-subtraction parent must be globally valid too). With
+    # top_k >= F every column wins and the tree reproduces the serial
+    # leaf-wise model exactly. Divergence: the vote ranks the SMALLER
+    # child's local gains (the larger sibling is reconstructed by
+    # subtraction and has no local histogram to rank).
+    voting = psum_axis is not None and parallel_mode == "voting"
+    W_vote = min(F, 2 * top_k)
+
+    def _exchange_one(hist_local, parent_out1):
+        """[1, F, B, 3] local smaller-child histogram ->
+        (global [F, B, 3], valid [F])."""
+        if not voting:
+            return _psum(hist_local)[0], jnp.ones((F,), bool)
+        from ..ops.split import per_feature_gains_cm
+        fm2 = (feature_mask[None, :] if feature_mask.ndim == 1
+               else feature_mask)
+        gains = per_feature_gains_cm(
+            hist_local[..., 0], hist_local[..., 1], hist_local[..., 2],
+            meta.num_bin, meta.missing_type, meta.default_bin, fm2,
+            meta_is_cat(meta), meta.monotone, params, parent_out1,
+            has_cat=has_cat)
+        k = min(top_k, F)
+        kth = jnp.sort(gains, axis=1)[:, F - k][:, None]
+        votes = (gains >= kth) & jnp.isfinite(gains)
+        votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)[0]
+        _, w_idx = jax.lax.top_k(votes, W_vote)
+        sub = jax.lax.psum(jnp.take(hist_local[0], w_idx, axis=0),
+                           psum_axis)
+        hist2 = jnp.zeros_like(hist_local[0]).at[w_idx].set(sub)
+        valid = jnp.zeros((F,), bool).at[w_idx].set(True)
+        return hist2, valid
 
     def _hist(slot_vec, num_slots):
         if use_bundles:
@@ -373,8 +564,10 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     tree = empty_tree(L, B)
     row_leaf = jnp.zeros((R,), jnp.int32)
 
-    # root histogram: every row targets slot 0
+    # root histogram: every row targets slot 0 (always a FULL exchange —
+    # one F*B*3 payload per tree; voting applies from the first split)
     pool = jnp.zeros((L, F, B, 3), jnp.float32)
+    pool_valid = jnp.ones((L, F), bool)
     root_hist = _psum(_hist(row_leaf, 1))
     pool = pool.at[0].set(root_hist[0])
 
@@ -390,10 +583,13 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_lo = jnp.full((L,), -jnp.inf, jnp.float32)
     leaf_hi = jnp.full((L,), jnp.inf, jnp.float32)
     leaf_groups = jnp.full((L,), -1, jnp.int32)
-    # intermediate monotone mode tracks per-leaf axis-aligned bin regions
-    # [lo, hi) so bound tightening can reach non-sibling leaves
-    # (ref: monotone_constraints.hpp:514 IntermediateLeafConstraints)
-    inter = use_mono_bounds and mono_mode == "intermediate"
+    # intermediate/advanced monotone modes track per-leaf axis-aligned bin
+    # regions [lo, hi) so bound tightening can reach non-sibling leaves
+    # (ref: monotone_constraints.hpp:514 IntermediateLeafConstraints);
+    # advanced additionally scans fresh children with per-(feature,
+    # bin-segment) bound PLANES (ref: :856 AdvancedLeafConstraints)
+    inter = use_mono_bounds and mono_mode in ("intermediate", "advanced")
+    adv = use_mono_bounds and mono_mode == "advanced"
     reg_lo = jnp.zeros((L, F), jnp.int32)
     reg_hi = jnp.broadcast_to(meta.num_bin[None, :], (L, F)) \
         .astype(jnp.int32)
@@ -422,8 +618,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     State = Tuple  # (tree, row_leaf, pool, best, parent_node, is_left)
 
     def body(i, state):
-        (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
-         leaf_groups, reg_lo, reg_hi) = state
+        (tree, row_leaf, pool, pool_valid, best, lpn, lil, leaf_lo,
+         leaf_hi, leaf_groups, reg_lo, reg_hi) = state
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         l = jnp.argmax(gains).astype(jnp.int32)
@@ -460,8 +656,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             do_split = do_split | forced_ok
 
         def split_branch(op):
-            (tree, row_leaf, pool, best, lpn, lil, leaf_lo, leaf_hi,
-             leaf_groups, reg_lo, reg_hi) = op
+            (tree, row_leaf, pool, pool_valid, best, lpn, lil, leaf_lo,
+             leaf_hi, leaf_groups, reg_lo, reg_hi) = op
             new = tree.num_leaves
             f = best.feature[l]
             t = best.threshold[l]
@@ -537,11 +733,19 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             target_is_left = bsl.left_count <= bsl.right_count
             target_leaf = jnp.where(target_is_left, l, new)
             slot = jnp.where(row_leaf2 == target_leaf, 0, -1)
-            hist_t = _psum(_hist(slot, 1))[0]
+            hist_t, valid_t = _exchange_one(_hist(slot, 1),
+                                            tree.leaf_value[l][None])
             hist_sib = pool[l] - hist_t
             pool2 = pool.at[l].set(jnp.where(target_is_left, hist_t, hist_sib))
             pool2 = pool2.at[new].set(jnp.where(target_is_left, hist_sib,
                                                 hist_t))
+            # validity: the exchanged child is valid on winner columns;
+            # the subtraction sibling additionally needs a valid parent
+            v_sib = pool_valid[l] & valid_t
+            pool_valid2 = pool_valid.at[l].set(
+                jnp.where(target_is_left, valid_t, v_sib))
+            pool_valid2 = pool_valid2.at[new].set(
+                jnp.where(target_is_left, v_sib, valid_t))
 
             # --- monotone bound propagation for the two children ---
             # basic: both children fenced at mid=(l+r)/2 (ref:
@@ -596,7 +800,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             bs2 = best_split(
                 child_hist, meta,
                 _scan_mask(jnp.stack([leaf_groups2[l], leaf_groups2[new]]),
-                           jnp.stack([2 * (i + 1) + 1, 2 * (i + 1)])),
+                           jnp.stack([2 * (i + 1) + 1, 2 * (i + 1)]))
+                & jnp.stack([pool_valid2[l], pool_valid2[new]]),
                 params, parent_out2,
                 has_cat=has_cat, use_bounds=use_mono_bounds,
                 bound_lo=jnp.stack([leaf_lo2[l], leaf_lo2[new]]),
@@ -630,28 +835,11 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
                 c_lo = jnp.stack([parent_lo, n_lo_r])           # [2, F]
                 c_hi = jnp.stack([l_hi_r, parent_hi])
-                d = meta.monotone[None, None, :]
                 active = jnp.arange(L) < tree.num_leaves
 
                 def _adj(q_lo, q_hi, mask_q):
-                    """[L, 2] above/below adjacency of leaves q vs the two
-                    children: regions overlap on every feature but one
-                    monotone g, and q lies strictly beyond on g."""
-                    ql = q_lo[:, None, :]
-                    qh = q_hi[:, None, :]
-                    cl = c_lo[None, :, :]
-                    ch = c_hi[None, :, :]
-                    ov = (ql < ch) & (cl < qh)                  # [L, 2, F]
-                    cnt = jnp.sum(ov.astype(jnp.int32), axis=2)
-                    ov_except = (cnt[:, :, None]
-                                 - ov.astype(jnp.int32)) == (F - 1)
-                    gate = ov_except & mask_q[:, None, None]
-                    above = gate & (ql >= ch)
-                    below = gate & (qh <= cl)
-                    q_is_up = (((d > 0) & above) | ((d < 0) & below))
-                    q_is_dn = (((d > 0) & below) | ((d < 0) & above))
-                    return (jnp.any(q_is_up, axis=2),
-                            jnp.any(q_is_dn, axis=2))
+                    return region_adjacency(q_lo, q_hi, c_lo, c_hi,
+                                            mask_q, meta.monotone)
 
                 # --- region-aware child clipping: a child strictly beyond
                 # an EXISTING leaf must respect that leaf's output NOW —
@@ -719,18 +907,73 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
                 best2 = jax.lax.cond(jnp.any(changed), _rescan,
                                      lambda b: b, best2)
-            return (tree2, row_leaf2, pool2, best2, lpn2, lil2, leaf_lo2,
-                    leaf_hi2, leaf_groups2, reg_lo2, reg_hi2)
+
+                if adv:
+                    # ---- ADVANCED: re-derive the two fresh children's
+                    # best splits with per-(feature, bin-segment) bound
+                    # planes built from the CURRENT leaves (ref:
+                    # monotone_constraints.hpp:856 — constraints are
+                    # computed fresh at evaluation time by descending to
+                    # the constraining leaves; the dense analog is a
+                    # min/max-reduction over every leaf's shadow mask).
+                    # Stale-leaf rescans above keep the scalar
+                    # (intermediate-grade) bounds — a conservative
+                    # refinement gap, never a monotonicity risk: safety
+                    # lives in the apply-time adjacency clip.
+                    act2 = jnp.arange(L) < tree2.num_leaves
+                    tgt = jnp.stack([l, new])                     # [2]
+                    excl = (jnp.arange(L)[:, None] != tgt[None, :])                         & act2[:, None]                           # [L, 2]
+                    up_d, dn_d = region_adjacency(
+                        reg_lo2, reg_hi2,
+                        jnp.stack([reg_lo2[l], reg_lo2[new]]),
+                        jnp.stack([reg_hi2[l], reg_hi2[new]]),
+                        excl, meta.monotone, per_dim=True)
+                    any_up = jnp.any(up_d, axis=2)                # [L, 2]
+                    any_dn = jnp.any(dn_d, axis=2)
+                    b_i3 = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+                    inr = ((reg_lo2[:, :, None] <= b_i3)
+                           & (b_i3 < reg_hi2[:, :, None]))        # [L,F,B]
+                    ap_up = (up_d[:, :, :, None]
+                             | (inr[:, None, :, :]
+                                & any_up[:, :, None, None]))      # [L,2,F,B]
+                    ap_dn = (dn_d[:, :, :, None]
+                             | (inr[:, None, :, :]
+                                & any_dn[:, :, None, None]))
+                    vq4 = tree2.leaf_value[:, None, None, None]
+                    hi_pl = jnp.min(jnp.where(ap_up, vq4, jnp.inf),
+                                    axis=0)                       # [2,F,B]
+                    lo_pl = jnp.max(jnp.where(ap_dn, vq4, -jnp.inf),
+                                    axis=0)
+                    bs_adv = best_split(
+                        child_hist, meta,
+                        _scan_mask(jnp.stack([leaf_groups2[l],
+                                              leaf_groups2[new]]),
+                                   jnp.stack([2 * (i + 1) + 1,
+                                              2 * (i + 1)]))
+                        & jnp.stack([pool_valid2[l], pool_valid2[new]]),
+                        params,
+                        jnp.stack([tree2.leaf_value[l],
+                                   tree2.leaf_value[new]]),
+                        has_cat=has_cat, use_bounds=True,
+                        bound_lo=jnp.stack([leaf_lo2[l], leaf_lo2[new]]),
+                        bound_hi=jnp.stack([leaf_hi2[l], leaf_hi2[new]]),
+                        bound_lo_plane=lo_pl, bound_hi_plane=hi_pl,
+                        leaf_depth=jnp.stack([tree2.leaf_depth[l],
+                                              tree2.leaf_depth[new]]))
+                    best2 = _merge_best(best2, l, new, bs_adv)
+            return (tree2, row_leaf2, pool2, pool_valid2, best2, lpn2,
+                    lil2, leaf_lo2, leaf_hi2, leaf_groups2, reg_lo2,
+                    reg_hi2)
 
         return jax.lax.cond(do_split, split_branch, lambda op: op,
-                            (tree, row_leaf, pool, best, lpn, lil,
-                             leaf_lo, leaf_hi, leaf_groups, reg_lo,
+                            (tree, row_leaf, pool, pool_valid, best, lpn,
+                             lil, leaf_lo, leaf_hi, leaf_groups, reg_lo,
                              reg_hi))
 
-    state = (tree, row_leaf, pool, best, leaf_parent_node, leaf_is_left,
-             leaf_lo, leaf_hi, leaf_groups, reg_lo, reg_hi)
-    tree, row_leaf, pool, best = jax.lax.fori_loop(
-        0, L - 1, body, state)[:4]
+    state = (tree, row_leaf, pool, pool_valid, best, leaf_parent_node,
+             leaf_is_left, leaf_lo, leaf_hi, leaf_groups, reg_lo, reg_hi)
+    out = jax.lax.fori_loop(0, L - 1, body, state)
+    tree, row_leaf = out[0], out[1]
     return tree, row_leaf
 
 
@@ -739,7 +982,8 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     static_argnames=("params", "num_leaves", "max_bins", "max_depth",
                      "hist_impl", "psum_axis", "has_cat", "parallel_mode",
                      "top_k", "use_mono_bounds", "use_node_masks",
-                     "use_cegb", "use_bundles", "bundle_col_bins"))
+                     "use_cegb", "use_bundles", "bundle_col_bins",
+                     "mono_mode", "use_cegb_lazy"))
 def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         feature_mask: jax.Array, params: SplitParams,
                         num_leaves: int, max_bins: int, max_depth: int = -1,
@@ -756,6 +1000,10 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                         use_bundles: bool = False,
                         bundle_cfg: "BundleCfg" = None,
                         bundle_col_bins: int = 0,
+                        mono_mode: str = "basic",
+                        use_cegb_lazy: bool = False,
+                        cegb_lazy: jax.Array = None,
+                        cegb_used_rf: jax.Array = None,
                         ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree depth-wise (frontier-batched) — the TPU throughput mode.
 
@@ -805,10 +1053,14 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         if parallel_mode == "feature":
             return hist, all_valid         # local features are complete
         # voting: local gains -> per-slot top_k votes -> global top-W cols
-        gains = best_numerical_split_cm(
+        # (categorical features rank by their categorical gain since
+        # round 4 — ops/split.per_feature_gains_cm)
+        from ..ops.split import per_feature_gains_cm
+        gains = per_feature_gains_cm(
             hist[..., 0], hist[..., 1], hist[..., 2], meta.num_bin,
             meta.missing_type, meta.default_bin, feature_mask,
-            meta.monotone, params, parent_out, per_feature_gains=True)
+            meta_is_cat(meta), meta.monotone, params, parent_out,
+            has_cat=has_cat)
         k = min(top_k, F)
         kth = jnp.sort(gains, axis=1)[:, F - k][:, None]
         votes = (gains >= kth) & jnp.isfinite(gains)
@@ -857,17 +1109,33 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     leaf_groups = jnp.full((L,), -1, jnp.int32)   # all groups compatible
     used_f = (cegb_used if (use_cegb and cegb_used is not None)
               else jnp.zeros((F,), bool))
+    # intermediate monotone mode: per-leaf bin-space regions (the stale-
+    # leaf recompute is free here — all_best rescans every leaf per level
+    # with the tightened bounds)
+    inter = use_mono_bounds and mono_mode == "intermediate"
+    reg_lo = jnp.zeros((L, F), jnp.int32)
+    reg_hi = jnp.broadcast_to(meta.num_bin[None, :], (L, F)) \
+        .astype(jnp.int32)
 
     def all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
-                 node_ids, used_f):
+                 node_ids, used_f, row_leaf=None, used_rf=None):
         mask2d = feature_mask[None, :] & pool_valid
         if use_node_masks:
             mask2d = mask2d & node_feature_mask(node_masks, leaf_groups,
                                                 node_ids)
         delta = None
         if use_cegb:
+            lazy_kw = {}
+            if use_cegb_lazy:
+                # per-(leaf, feature) count of rows whose path has not
+                # used the feature (ref: the lazy bitmap of
+                # cost_effective_gradient_boosting.hpp:22)
+                unused = jax.ops.segment_sum(
+                    (~used_rf).astype(jnp.float32), row_leaf,
+                    num_segments=L)
+                lazy_kw = dict(lazy_penalty=cegb_lazy, unused_cnt=unused)
             delta = cegb_delta_matrix(params, cegb_coupled, used_f,
-                                      tree.leaf_count)
+                                      tree.leaf_count, **lazy_kw)
         bs = best_split(pool, meta, mask2d, params,
                         tree.leaf_value, has_cat=has_cat,
                         use_bounds=use_mono_bounds, bound_lo=leaf_lo,
@@ -877,8 +1145,13 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             bs = merge_best_over_shards(bs, psum_axis, feature_offset)
         return bs
 
+    # persistent per-(row, feature) lazy-CEGB bitmap (placeholder when
+    # the mode is off so the scan carry keeps a fixed structure)
+    used_rf = (cegb_used_rf if use_cegb_lazy
+               else jnp.zeros((1, 1), bool))
     best = all_best(pool, tree, pool_valid, leaf_lo, leaf_hi, leaf_groups,
-                    jnp.zeros((L,), jnp.int32), used_f)
+                    jnp.zeros((L,), jnp.int32), used_f,
+                    row_leaf=row_leaf, used_rf=used_rf)
     best = best._replace(gain=jnp.where(jnp.arange(L) == 0, best.gain,
                                         NEG_INF))
     r_bins = bins if route_bins is None else route_bins
@@ -886,7 +1159,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
     def level(carry, _):
         (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-         num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f) = carry
+         num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f,
+         reg_lo, reg_hi, used_rf) = carry
         gains = _masked_gain(best, tree.leaf_depth, tree.num_leaves,
                              max_depth, L)
         budget = L - tree.num_leaves
@@ -899,7 +1173,8 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
         def do_level(op):
             (tree, row_leaf, pool, pool_valid, best, lpn, lil,
-             num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f) = op
+             num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f,
+             reg_lo, reg_hi, used_rf) = op
             # new leaf ids: k-th selected leaf (by slot order) gets
             # num_leaves + k; node ids num_nodes + k
             sel_i32 = selected.astype(jnp.int32)
@@ -982,6 +1257,17 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 go_left = jnp.where(cf_l[l_row], cat_left, go_left)
             row_leaf2 = jnp.where(sel_row & ~go_left, new_of_leaf[l_row],
                                   row_leaf)
+            if use_cegb_lazy:
+                # rows in a split leaf mark the split feature as used on
+                # their path (persists across trees, ref: the lazy
+                # bitmap update in CostEfficientGradientBoosting::
+                # UpdateUsedFeature)
+                used_rf2 = used_rf | (
+                    (sel_row & (f_l[l_row] >= 0))[:, None]
+                    & (jnp.arange(F, dtype=jnp.int32)[None, :]
+                       == f_row[:, None]))
+            else:
+                used_rf2 = used_rf
 
             # --- one histogram pass for all LEFT children (kept old ids) ---
             leaf_to_slot = jnp.where(selected, k_of_leaf, -1)
@@ -1009,10 +1295,24 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             def upd2(arr, lv, rv):
                 arr = _masked_scatter(arr, slots, lv, selected)
                 return _masked_scatter(arr, new_of_leaf, rv, selected)
+            if inter:
+                # sequential per-split bookkeeping in slot order — see
+                # mono_inter_level_update; clipped child outputs replace
+                # the raw scan outputs
+                (lv_inter, leaf_lo2, leaf_hi2, reg_lo2, reg_hi2,
+                 _changed) = mono_inter_level_update(
+                    tree.leaf_value, leaf_lo, leaf_hi, reg_lo, reg_hi,
+                    selected, k_of_leaf, best.feature, best.threshold,
+                    best.cat_flag, best.left_output, best.right_output,
+                    meta.monotone, tree.num_leaves, L)
+                new_leaf_value = lv_inter
+            else:
+                new_leaf_value = upd2(tree2.leaf_value, best.left_output,
+                                      best.right_output)
+                reg_lo2, reg_hi2 = reg_lo, reg_hi
             tree2 = tree2._replace(
                 num_leaves=tree.num_leaves + n_sel,
-                leaf_value=upd2(tree2.leaf_value, best.left_output,
-                                best.right_output),
+                leaf_value=new_leaf_value,
                 leaf_count=upd2(tree2.leaf_count, best.left_count,
                                 best.right_count),
                 leaf_weight=upd2(tree2.leaf_weight, best.left_sum_hess,
@@ -1020,14 +1320,18 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
                 leaf_depth=upd2(tree2.leaf_depth, new_depth, new_depth),
             )
 
-            if use_mono_bounds:
+            if use_mono_bounds and not inter:
                 mono_dir = jnp.where(
                     best.feature >= 0,
                     meta.monotone[jnp.maximum(best.feature, 0)], 0)
+                # the reference updates constraints only for NUMERICAL
+                # splits (BasicLeafConstraints::Update gates on
+                # is_numerical_split)
+                mono_dir = jnp.where(best.cat_flag, 0, mono_dir)
                 leaf_lo2, leaf_hi2 = mono_child_bounds(
                     leaf_lo, leaf_hi, leaf_lo, leaf_hi, selected, mono_dir,
                     best.left_output, best.right_output, slots, new_of_leaf)
-            else:
+            elif not use_mono_bounds:
                 leaf_lo2, leaf_hi2 = leaf_lo, leaf_hi
             if use_node_masks:
                 leaf_groups2 = update_leaf_groups(
@@ -1046,21 +1350,26 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
             # a leaf's sampling identity: creating node id + side bit
             node_ids2 = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
             best2 = all_best(pool2, tree2, pv2, leaf_lo2, leaf_hi2,
-                             leaf_groups2, node_ids2, used_f2)
+                             leaf_groups2, node_ids2, used_f2,
+                             row_leaf=row_leaf2, used_rf=used_rf2)
             active = jnp.arange(L) < tree2.num_leaves
             best2 = best2._replace(gain=jnp.where(active, best2.gain, NEG_INF))
             return (tree2, row_leaf2, pool2, pv2, best2, lpn2, lil2,
                     num_nodes + n_sel, leaf_lo2, leaf_hi2, leaf_groups2,
-                    used_f2)
+                    used_f2, reg_lo2, reg_hi2, used_rf2)
 
         carry2 = jax.lax.cond(n_sel > 0, do_level, lambda op: op,
                               (tree, row_leaf, pool, pool_valid, best, lpn,
                                lil, num_nodes, leaf_lo, leaf_hi,
-                               leaf_groups, used_f))
+                               leaf_groups, used_f, reg_lo, reg_hi,
+                               used_rf))
         return carry2, None
 
     carry = (tree, row_leaf, pool, pool_valid, best, leaf_parent_node,
-             leaf_is_left, num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f)
-    (tree, row_leaf, pool, _, best, _, _, _, _, _, _, _), _ = jax.lax.scan(
-        level, carry, None, length=n_levels)
+             leaf_is_left, num_nodes, leaf_lo, leaf_hi, leaf_groups, used_f,
+             reg_lo, reg_hi, used_rf)
+    out = jax.lax.scan(level, carry, None, length=n_levels)[0]
+    tree, row_leaf = out[0], out[1]
+    if use_cegb_lazy:
+        return tree, row_leaf, out[14]
     return tree, row_leaf

@@ -42,16 +42,24 @@ def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
                  max_bundle_bins: int = 65535,
                  num_bin_per_feat: Sequence[int] = None,
                  max_search_bundles: int = 64) -> List[List[int]]:
-    """Greedy conflict-bounded bundling (ref: dataset.cpp FindGroups).
+    """Greedy conflict-bounded bundling (ref: dataset.cpp:108-176
+    FindGroups).
 
     Args:
       nondefault_masks: per-feature boolean [R] arrays (True where the row
         is NOT in the feature's most-frequent bin).
-      max_conflict_rate: allowed fraction of rows in conflict per bundle.
+      max_conflict_rate: allowed fraction of rows in conflict per bundle
+        (the reference's single_val_max_conflict_cnt is
+        total_sample_cnt/10000 — rate 1e-4, the default here).
       max_search_bundles: candidate bundles tried per feature before a new
         one opens (the reference's FindGroups bounds its search the same
         way, max_find_group cap) — keeps the greedy near-linear on
         many-thousand-feature sparse data.
+
+    A feature joins a bundle only when its conflict count also stays
+    under HALF its own non-zero count (ref: dataset.cpp:155
+    ``cnt <= cur_non_zero_cnt / 2``) — a feature that collides on most
+    of its mass would lose its signal to the first-writer-wins encode.
 
     Returns a list of bundles (lists of feature indices). Dense features
     end up in singleton bundles. Conflict masks are packed uint64 bitsets
@@ -89,7 +97,8 @@ def find_bundles(nondefault_masks: Sequence[np.ndarray], num_rows: int,
                     continue  # keep the encoded bin range in dtype bounds
                 conflicts = int(_popcount(
                     bundle_masks[bi] & packed).sum())
-                if bundle_conflicts[bi] + conflicts <= budget:
+                if bundle_conflicts[bi] + conflicts <= budget \
+                        and conflicts * 2 <= nnz:
                     bundles[bi].append(f)
                     bundle_masks[bi] |= packed
                     bundle_conflicts[bi] += conflicts

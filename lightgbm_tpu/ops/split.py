@@ -159,7 +159,9 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
                             bound_lo: jax.Array = None,
                             bound_hi: jax.Array = None,
                             leaf_depth: jax.Array = None,
-                            cegb_delta: jax.Array = None) -> BestSplit:
+                            cegb_delta: jax.Array = None,
+                            bound_lo_plane: jax.Array = None,
+                            bound_hi_plane: jax.Array = None) -> BestSplit:
     """Best numerical split per slot (channel-major inputs — TPU relayouts
     of channel-minor ``[..., 3]`` arrays are expensive, so the hot path keeps
     grad/hess/count as separate ``[S, F, B]`` planes).
@@ -246,7 +248,47 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
         mono = monotone[None, :, None]
         lo = calculate_leaf_output(left_g, left_h, p, left_c, parent_out)
         ro = calculate_leaf_output(right_g, right_h, p, right_c, parent_out)
-        if use_bounds:
+        if bound_hi_plane is not None:
+            # ADVANCED monotone mode: per-(feature, bin-SEGMENT) bounds
+            # (ref: monotone_constraints.hpp:856 AdvancedLeafConstraints —
+            # a constraint from an adjacent leaf applies only to the part
+            # of this leaf's region the neighbor shadows, so a candidate
+            # child that escapes the shadow escapes the bound). The
+            # child's bound = extremum of the plane over the bins it
+            # covers: prefix scans for the left child, suffix for the
+            # right; the missing-bin mass rides the default direction and
+            # folds its plane entry into that side.
+            inf = jnp.inf
+            hi_pl = jnp.where(is_pad, inf, bound_hi_plane)
+            lo_pl = jnp.where(is_pad, -inf, bound_lo_plane)
+            hi_pref = jax.lax.cummin(hi_pl, axis=2)
+            lo_pref = jax.lax.cummax(lo_pl, axis=2)
+            hi_suf = jax.lax.cummin(hi_pl[..., ::-1], axis=2)[..., ::-1]
+            lo_suf = jax.lax.cummax(lo_pl[..., ::-1], axis=2)[..., ::-1]
+            hi_right = jnp.concatenate(
+                [hi_suf[..., 1:], jnp.full_like(hi_suf[..., :1], inf)],
+                axis=2)
+            lo_right = jnp.concatenate(
+                [lo_suf[..., 1:], jnp.full_like(lo_suf[..., :1], -inf)],
+                axis=2)
+            mm = excl_missing_mask & ~is_pad
+            miss_hi = jnp.min(jnp.where(mm, hi_pl, inf), axis=2,
+                              keepdims=True)
+            miss_lo = jnp.max(jnp.where(mm, lo_pl, -inf), axis=2,
+                              keepdims=True)
+            if reverse:     # missing rides LEFT
+                l_hi = jnp.minimum(hi_pref, miss_hi)
+                l_lo = jnp.maximum(lo_pref, miss_lo)
+                r_hi, r_lo = hi_right, lo_right
+            else:           # missing rides RIGHT
+                l_hi, l_lo = hi_pref, lo_pref
+                r_hi = jnp.minimum(hi_right, miss_hi)
+                r_lo = jnp.maximum(lo_right, miss_lo)
+            lo = jnp.clip(lo, l_lo, l_hi)
+            ro = jnp.clip(ro, r_lo, r_hi)
+            gains = (leaf_gain_given_output(left_g, left_h, p, lo)
+                     + leaf_gain_given_output(right_g, right_h, p, ro))
+        elif use_bounds:
             # per-leaf monotone bounds: candidate outputs are clipped into
             # the leaf's feasible interval and the gain recomputed with the
             # clipped outputs (ref: monotone_constraints.hpp BasicLeaf
@@ -357,13 +399,15 @@ def best_numerical_split_cm(grad: jax.Array, hess: jax.Array,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(jax.jit, static_argnames=("params",
+                                             "per_feature_gains"))
 def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
                               cnt: jax.Array, num_bin_per_feat: jax.Array,
                               cat_feature_mask: jax.Array,
                               params: SplitParams,
                               parent_output: jax.Array,
-                              cegb_delta: jax.Array = None) -> BestSplit:
+                              cegb_delta: jax.Array = None,
+                              per_feature_gains: bool = False) -> BestSplit:
     """Best categorical split per slot (ref: feature_histogram.hpp:278-470
     FindBestThresholdCategoricalInner).
 
@@ -501,6 +545,10 @@ def best_categorical_split_cm(grad: jax.Array, hess: jax.Array,
     cfm = (cat_feature_mask[None, :] if cat_feature_mask.ndim == 1
            else cat_feature_mask)
     g_feat = jnp.where(cfm, g_feat, K_MIN_SCORE)
+    if per_feature_gains:
+        # voting-parallel ranks categorical features in the vote too
+        # (ref: voting_parallel_tree_learner.cpp:151 votes by local gain)
+        return g_feat
     f_best = jnp.argmax(g_feat, axis=1)                # [S]
     take = lambda a: jnp.take_along_axis(a, f_best[:, None], axis=1)[:, 0]
     gain = take(g_feat)
@@ -576,17 +624,23 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
                   has_cat: bool = False, use_bounds: bool = False,
                   bound_lo: jax.Array = None, bound_hi: jax.Array = None,
                   leaf_depth: jax.Array = None,
-                  cegb_delta: jax.Array = None) -> BestSplit:
+                  cegb_delta: jax.Array = None,
+                  bound_lo_plane: jax.Array = None,
+                  bound_hi_plane: jax.Array = None) -> BestSplit:
     """Combined numerical + categorical best split per slot (the analog of
     FeatureHistogram::FindBestThreshold dispatch on bin_type,
     ref: feature_histogram.hpp:85). ``has_cat`` is static: all-numerical
-    datasets skip the categorical scan entirely at trace time."""
+    datasets skip the categorical scan entirely at trace time. Optional
+    ``bound_*_plane`` [S, F, B] segment bounds select the ADVANCED
+    monotone scan for numerical features (categorical winners keep the
+    scalar whole-leaf clamp below)."""
     ic = is_cat[None, :] if feature_mask.ndim == 2 else is_cat
     num = best_numerical_split_cm(
         grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
         feature_mask & ~ic, monotone, params, parent_output,
         use_bounds=use_bounds, bound_lo=bound_lo, bound_hi=bound_hi,
-        leaf_depth=leaf_depth, cegb_delta=cegb_delta)
+        leaf_depth=leaf_depth, cegb_delta=cegb_delta,
+        bound_lo_plane=bound_lo_plane, bound_hi_plane=bound_hi_plane)
     if not has_cat:
         return num
     cat = best_categorical_split_cm(
@@ -603,3 +657,25 @@ def best_split_cm(grad: jax.Array, hess: jax.Array, cnt: jax.Array,
     merged = [jnp.where(use_cat if a.ndim == 1 else use_cat[:, None], a, b)
               for a, b in zip(cat, num)]
     return BestSplit(*merged)
+
+
+def per_feature_gains_cm(grad, hess, cnt, num_bin_per_feat, missing_type,
+                         default_bin, feature_mask, is_cat, monotone,
+                         params, parent_output,
+                         has_cat: bool = False) -> jax.Array:
+    """[S, F] best-candidate gain per feature — what voting-parallel
+    shards rank locally before the vote (ref:
+    voting_parallel_tree_learner.cpp:151 GlobalVoting). Categorical
+    features rank by their categorical gain (one-hot / sorted-subset),
+    numerical by the threshold scan."""
+    ic = is_cat[None, :] if feature_mask.ndim == 2 else is_cat
+    g = best_numerical_split_cm(
+        grad, hess, cnt, num_bin_per_feat, missing_type, default_bin,
+        feature_mask & ~ic, monotone, params, parent_output,
+        per_feature_gains=True)
+    if has_cat:
+        gc = best_categorical_split_cm(
+            grad, hess, cnt, num_bin_per_feat, feature_mask & ic, params,
+            parent_output, per_feature_gains=True)
+        g = jnp.maximum(g, gc)
+    return g

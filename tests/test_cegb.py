@@ -51,3 +51,82 @@ def test_split_penalty_shrinks_trees():
                 lgb.train(dict(base, cegb_penalty_split=0.5), ds2,
                           num_boost_round=3).models)
     assert n_pen < n_plain
+
+
+def test_cegb_lazy_penalty_blocks_expensive_feature():
+    """cegb_penalty_feature_lazy (ref:
+    cost_effective_gradient_boosting.hpp:22): the per-row acquisition
+    cost is charged for every data point whose path has not used the
+    feature yet — a huge lazy penalty on a feature prices it out
+    entirely, while the same data without penalties uses it."""
+    rng = np.random.RandomState(0)
+    n = 3000
+    X = rng.rand(n, 3)
+    y = (X[:, 0] + 2.0 * X[:, 1] > 1.4).astype(np.float32)
+
+    def tr(lazy):
+        ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+        p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+             "num_iterations": 5}
+        if lazy is not None:
+            p["cegb_penalty_feature_lazy"] = lazy
+        return lgb.train(p, ds)
+
+    free = tr(None)
+    assert 1 in set(int(f) for ht in free._gbdt.models
+                    for f in ht.split_feature)   # f1 is informative
+    priced = tr([0.0, 1e6, 0.0])
+    used = set(int(f) for ht in priced._gbdt.models
+               for f in ht.split_feature if f >= 0)
+    assert 1 not in used, used
+    g = priced._gbdt
+    assert g.use_cegb_lazy
+    # the persistent bitmap filled in for the features actually used
+    assert float(jnp_sum(g.cegb_used_rf)) > 0
+
+
+def jnp_sum(x):
+    import jax.numpy as jnp
+    return jnp.sum(x)
+
+
+def test_cegb_lazy_bitmap_persists_and_discounts_reuse():
+    """The lazy bitmap is the reference's per-(row, feature)
+    Get/SetUsedFeature store: rows that routed through a split on f have
+    paid f's cost — their unused-count contribution drops to zero, and
+    the bitmap persists ACROSS boosting iterations (it is never reset
+    per tree)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.learner import cegb_delta_matrix
+    from lightgbm_tpu.ops.split import SplitParams
+
+    # formula: delta[s, f] = tradeoff * lazy[f] * unused_cnt[s, f] (+0)
+    p = SplitParams(cegb_tradeoff=0.5)
+    lazy = jnp.asarray([2.0, 0.0])
+    unused = jnp.asarray([[10.0, 7.0], [0.0, 3.0]])
+    delta = cegb_delta_matrix(p, jnp.zeros(2), jnp.zeros(2, bool),
+                              jnp.zeros(2), lazy_penalty=lazy,
+                              unused_cnt=unused)
+    np.testing.assert_allclose(np.asarray(delta),
+                               [[10.0, 0.0], [0.0, 0.0]])
+
+    # end-to-end persistence: the bitmap only ever grows across updates
+    rng = np.random.RandomState(3)
+    n = 2000
+    X = rng.rand(n, 3)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.8).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbose": -1,
+                              "cegb_penalty_feature_lazy":
+                              [1e-4, 1e-4, 1e-4]},
+                      train_set=ds)
+    g = bst._gbdt
+    assert g.use_cegb_lazy
+    covered = 0
+    for _ in range(4):
+        bst.update()
+        now = int(np.asarray(g.cegb_used_rf).sum())
+        assert now >= covered      # never resets between iterations
+        covered = now
+    assert covered > 0

@@ -177,3 +177,45 @@ def test_serial_fallback_single_device_warning(data, monkeypatch):
                                         tree_learner="data"))
     assert bst._gbdt.parallel_mode == "serial"
     assert bst.num_trees() == 2
+
+
+def test_voting_leafwise_full_topk_matches_serial_leafwise(data):
+    """VERDICT r3 #8: voting composes with LEAF-WISE growth (ref:
+    voting_parallel_tree_learner.cpp:151-184 runs under the serial
+    best-first flow). With top_k >= F every column wins the vote, so the
+    voting model must reproduce the serial leaf-wise model — not just
+    depthwise data-parallel."""
+    X, y = data
+    ps = _train(X, y, dict(BASE)).predict(X)                 # leafwise
+    bv = _train(X, y, dict(BASE, tree_learner="voting",
+                           top_k=X.shape[1]))
+    assert bv._gbdt.grow_policy == "leafwise"
+    pv = bv.predict(X)
+    np.testing.assert_allclose(pv, ps, atol=1e-6)
+
+
+def test_voting_leafwise_restricted_topk_trains(data):
+    X, y = data
+    bst = _train(X, y, dict(BASE, tree_learner="voting", top_k=3))
+    assert bst._gbdt.grow_policy == "leafwise"
+    assert bst.num_trees() == BASE["num_iterations"]
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.8
+
+
+def test_voting_ranks_categorical_splits(data):
+    """Categorical columns enter the vote (per_feature_gains_cm): a
+    dataset whose signal lives in a categorical feature must keep it
+    through a restricted vote."""
+    rng = np.random.RandomState(11)
+    n = 4096
+    Xc = rng.randn(n, 6)
+    cat = rng.randint(0, 6, n)
+    Xc[:, 2] = cat
+    yc = ((cat >= 3) ^ (rng.rand(n) < 0.05)).astype(np.float32)
+    ds = lgb.Dataset(Xc, label=yc, categorical_feature=[2],
+                     params={"verbose": -1})
+    bst = lgb.train(dict(BASE, tree_learner="voting", top_k=2), ds)
+    assert bst._gbdt.parallel_mode == "voting"
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(yc, bst.predict(Xc)) > 0.9
