@@ -125,6 +125,30 @@ class Dataset:
             # chunked out-of-core assembly (ref: Sequence streaming push)
             self.data = _materialize_sequences(self.data)
         if isinstance(self.data, (str, os.PathLike)):
+            # binary-cache files short-circuit the text loader entirely
+            # (ref: dataset_loader.cpp:336 LoadFromBinFile — the cache
+            # magic is checked before any parsing)
+            with open(self.data, "rb") as _fh:
+                if _fh.read(8) == b"LGBMTPU1":
+                    self._inner = TpuDataset.load_binary(str(self.data))
+                    # explicitly-passed metadata overrides the cached
+                    # copy (the reference's LoadFromBinFile + SetField
+                    # sequence behaves the same way)
+                    if self.label is not None:
+                        self._inner.metadata.set_label(
+                            np.asarray(self.label))
+                    elif self._inner.metadata is not None:
+                        self.label = self._inner.metadata.label
+                    if self.weight is not None:
+                        self._inner.metadata.set_weight(
+                            np.asarray(self.weight))
+                    if self.group is not None:
+                        self._inner.metadata.set_group(
+                            np.asarray(self.group, np.int64))
+                    if self.init_score is not None:
+                        self._inner.metadata.set_init_score(
+                            np.asarray(self.init_score))
+                    return self
             # file-based ingestion (ref: DatasetLoader::LoadFromFile).
             # Multi-process: each rank reads its contiguous row slice
             # unless pre_partition says the file already IS this rank's

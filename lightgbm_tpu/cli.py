@@ -124,6 +124,37 @@ def run_refit(params: Dict[str, str]) -> None:
     log.info("Finished refit; model saved to %s", out_path)
 
 
+def run_convert_model(params: Dict[str, str]) -> None:
+    """(ref: application.cpp task=convert_model -> gbdt_model_text.cpp
+    SaveModelToIfElse / tree.cpp:562 ToIfElse)"""
+    model = params.pop("input_model", None)
+    if not model:
+        raise SystemExit("task=convert_model requires input_model=<file>")
+    lang = params.get("convert_model_language", "cpp")
+    if lang not in ("cpp", ""):
+        raise SystemExit(f"convert_model_language={lang} is not supported "
+                         "(cpp only, like the reference)")
+    out_path = params.get("convert_model", "gbdt_prediction.cpp")
+    from .io.model_io import model_to_if_else
+    booster = Booster(model_file=model)
+    with open(out_path, "w") as fh:
+        fh.write(model_to_if_else(booster))
+    log.info("Finished converting model; code saved to %s", out_path)
+
+
+def run_save_binary(params: Dict[str, str]) -> None:
+    """(ref: application.cpp:70-83 task=save_binary — load the training
+    data, write the binary cache next to it, exit)"""
+    data = params.pop("data", None)
+    if not data:
+        raise SystemExit("task=save_binary requires data=<file>")
+    ds = Dataset(data, params=dict(params))
+    ds.construct()
+    out = params.get("output_model", data + ".bin")
+    ds._inner.save_binary(out)
+    log.info("Finished saving binary dataset to %s", out)
+
+
 def main(argv: List[str] = None) -> None:
     from .utils.platform import pin_jax_platforms
     pin_jax_platforms()
@@ -136,7 +167,9 @@ def main(argv: List[str] = None) -> None:
     elif task == "refit":
         run_refit(params)
     elif task == "convert_model":
-        raise SystemExit("convert_model (if-else codegen) is not supported")
+        run_convert_model(params)
+    elif task == "save_binary":
+        run_save_binary(params)
     else:
         raise SystemExit(f"unknown task: {task}")
 

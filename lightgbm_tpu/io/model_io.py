@@ -317,3 +317,124 @@ def dump_model_json(booster, start_iteration: int = 0,
         "tree_info": tree_infos,
     }
     return json.dumps(out, indent=2)
+
+
+# ---------------------------------------------------------------------
+# if-else C code generation (ref: src/io/tree.cpp:562 Tree::ToIfElse +
+# application.cpp task=convert_model): a standalone C++ translation unit
+# with one PredictTree function per tree, PredictRaw summing them, and
+# Predict applying the objective's output transform.
+def _tree_to_if_else(ht, idx: int) -> str:
+    """One tree as ``double PredictTree<idx>(const double* arr)``."""
+    lines = []
+    cat_words = []
+
+    def cat_bitset(nd):
+        ci = int(ht.threshold[nd])
+        lo, hi = ht.cat_boundaries[ci], ht.cat_boundaries[ci + 1]
+        words = [int(w) for w in ht.cat_threshold[lo:hi]]
+        off = len(cat_words)
+        cat_words.extend(words)
+        return off, len(words)
+
+    def emit(node, ind):
+        pad = "  " * ind
+        if node < 0:
+            lines.append(f"{pad}return {float(ht.leaf_value[~node])!r};")
+            return
+        f = int(ht.split_feature[node])
+        d = int(ht.decision_type[node])
+        cat, dl, mt = bool(d & 1), bool(d & 2), (d >> 2) & 3
+        v = f"arr[{f}]"
+        if cat:
+            off, nw = cat_bitset(node)
+            # unseen/NaN categories go RIGHT (ref: tree.h
+            # CategoricalDecision)
+            cond = (f"(!std::isnan({v}) && (int){v} >= 0 && "
+                    f"(int){v} < {nw * 32} && "
+                    f"((CatBitset{idx}[{off} + ((int){v} / 32)] >> "
+                    f"((int){v} % 32)) & 1))")
+        else:
+            thr = repr(float(ht.threshold[node]))
+            if mt == 2:      # NaN-missing rides default_left
+                miss = f"std::isnan({v})"
+                val = v
+            elif mt == 1:    # zero (and NaN-as-zero) rides default_left
+                miss = (f"(std::isnan({v}) || std::fabs({v}) <= "
+                        f"kZeroThreshold)")
+                val = v
+            else:            # none: NaN is treated as 0.0
+                miss = "false"
+                val = f"(std::isnan({v}) ? 0.0 : {v})"
+            branch = f"{val} <= {thr}"
+            cond = (f"({miss} ? {str(dl).lower()} : ({branch}))"
+                    if mt else f"({branch})")
+        lines.append(f'{"  " * ind}if ({cond}) {{')
+        emit(int(ht.left_child[node]), ind + 1)
+        lines.append(f'{"  " * ind}}} else {{')
+        emit(int(ht.right_child[node]), ind + 1)
+        lines.append(f'{"  " * ind}}}')
+
+    if ht.num_leaves <= 1:
+        body = f"  return {float(ht.leaf_value[0])!r};"
+        return (f"double PredictTree{idx}(const double* arr) {{\n"
+                f"{body}\n}}\n")
+    emit(0, 1)
+    out = ""
+    if cat_words:
+        words = ", ".join(f"{w}u" for w in cat_words)
+        out += (f"static const uint32_t CatBitset{idx}[] = "
+                f"{{{words}}};\n")
+    out += (f"double PredictTree{idx}(const double* arr) {{\n"
+            + "\n".join(lines) + "\n}\n")
+    return out
+
+
+def model_to_if_else(booster) -> str:
+    """Full model as compilable C++ (ref: gbdt_model_text.cpp SaveModelToIfElse
+    — the convert_model task's output). ``Predict`` fills
+    ``num_tree_per_iteration`` outputs per row; sigmoid/exp transforms
+    follow the model's objective."""
+    models = booster.models
+    k = max(1, booster.num_tree_per_iteration)
+    obj = getattr(booster, "objective", None)
+    obj_name = getattr(obj, "name", "") if obj is not None else ""
+    parts = [
+        "// generated by lightgbm_tpu convert_model "
+        "(ref: src/io/tree.cpp:562 ToIfElse)",
+        "#include <cmath>",
+        "#include <cstdint>",
+        "static const double kZeroThreshold = 1e-35;",
+        "",
+    ]
+    for i, ht in enumerate(models):
+        parts.append(_tree_to_if_else(ht, i))
+    per_class = [[] for _ in range(k)]
+    for i in range(len(models)):
+        per_class[i % k].append(i)
+    sums = []
+    for c, idxs in enumerate(per_class):
+        terms = " + ".join(f"PredictTree{i}(arr)" for i in idxs) or "0.0"
+        sums.append(f"  out[{c}] = {terms};")
+    parts.append("void PredictRaw(const double* arr, double* out) {\n"
+                 + "\n".join(sums) + "\n}\n")
+    if obj_name == "binary":
+        sig = getattr(obj, "sigmoid", 1.0)
+        conv = (f"  out[0] = 1.0 / (1.0 + std::exp(-{float(sig)!r} "
+                f"* out[0]));")
+    elif obj_name in ("poisson", "gamma", "tweedie",
+                      "cross_entropy_lambda"):
+        conv = "\n".join(f"  out[{c}] = std::exp(out[{c}]);"
+                         for c in range(k))
+    elif obj_name == "multiclass":
+        conv = ("  double m = out[0], s = 0.0;\n"
+                + "".join(f"  if (out[{c}] > m) m = out[{c}];\n"
+                          for c in range(k))
+                + "".join(f"  out[{c}] = std::exp(out[{c}] - m); "
+                          f"s += out[{c}];\n" for c in range(k))
+                + "".join(f"  out[{c}] /= s;\n" for c in range(k)))
+    else:
+        conv = "  // identity output transform"
+    parts.append("void Predict(const double* arr, double* out) {\n"
+                 "  PredictRaw(arr, out);\n" + conv + "\n}\n")
+    return "\n".join(parts)

@@ -582,3 +582,272 @@ LGBM_EXPORT int LGBM_FastConfigFree(void* fast_config) {
   Py_XDECREF((PyObject*)fast_config);
   return 0;
 }
+
+// ----------------------------------------------------------------------
+// round-4 tranche (ref: src/c_api.cpp:430-845 — custom-gradient train,
+// JSON dump, field/feature-name access, CSC predict, sparse contribs,
+// streaming dataset push, booster merge)
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIterCustom(void* booster,
+                                                const float* grad,
+                                                const float* hess,
+                                                int* is_finished) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKK)", (PyObject*)booster, (unsigned long long)(uintptr_t)grad,
+      (unsigned long long)(uintptr_t)hess);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_update_one_iter_custom", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// reference buffer convention: out_len = bytes needed incl. NUL; the
+// string is copied only when it fits in buffer_len
+LGBM_EXPORT int LGBM_BoosterDumpModel(void* booster, int start_iteration,
+                                      int num_iteration,
+                                      int feature_importance_type,
+                                      int64_t buffer_len, int64_t* out_len,
+                                      char* out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiii)", (PyObject*)booster,
+                                 start_iteration, num_iteration,
+                                 feature_importance_type);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_dump_model", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  *out_len = (int64_t)n + 1;
+  if (out_str != nullptr && buffer_len >= n + 1) {
+    std::memcpy(out_str, s, n + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetField(void* handle, const char* field_name,
+                                     int* out_len, const void** out_ptr,
+                                     int* out_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", (PyObject*)handle, field_name);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_get_field", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  unsigned long long ptr = 0;
+  int n = 0, tc = 0;
+  if (!PyArg_ParseTuple(r, "Kii", &ptr, &n, &tc)) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  Py_DECREF(r);
+  *out_ptr = (const void*)(uintptr_t)ptr;
+  *out_len = n;
+  *out_type = tc;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetFeatureNames(void* handle, const int len,
+                                            int* num_feature_names,
+                                            const size_t buffer_len,
+                                            size_t* out_buffer_len,
+                                            char** feature_names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)handle);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_get_feature_names", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = PyList_Size(r);
+  *num_feature_names = (int)n;
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    size_t l = s ? strlen(s) + 1 : 1;
+    if (l > need) need = l;
+    if (feature_names != nullptr && i < len && s != nullptr) {
+      std::snprintf(feature_names[i], buffer_len, "%s", s);
+    }
+  }
+  *out_buffer_len = need;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSetFeatureNames(void* handle,
+                                            const char** feature_names,
+                                            int num_feature_names) {
+  Gil gil;
+  PyObject* names = PyList_New(num_feature_names);
+  if (names == nullptr) return fail_from_python();
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* args = Py_BuildValue("(ON)", (PyObject*)handle, names);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_set_feature_names", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSC(
+    void* booster, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t ncol_ptr, int64_t nelem, int64_t num_row, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKiLLLiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)col_ptr, col_ptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+      predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_csc", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// Sparse-output SHAP contributions (ref: c_api.cpp:845). Only
+// predict_type=3 (contrib) with matrix_type=0 (CSR) is supported; the
+// returned buffers live until LGBM_BoosterFreePredictSparse.
+LGBM_EXPORT int LGBM_BoosterPredictSparseOutput(
+    void* booster, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col_or_row,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, int matrix_type, int64_t* out_len,
+    void** out_indptr, int32_t** out_indices, void** out_data) {
+  if (predict_type != 3) {
+    g_last_error = "PredictSparseOutput supports predict_type=3 (contrib)";
+    return -1;
+  }
+  if (matrix_type != 0) {
+    g_last_error = "PredictSparseOutput supports matrix_type=CSR only";
+    return -1;
+  }
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKiLLLii)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col_or_row,
+      start_iteration, num_iteration);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_sparse_contribs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  long long n_indptr = 0, nnz = 0;
+  unsigned long long p_indptr = 0, p_indices = 0, p_data = 0;
+  if (!PyArg_ParseTuple(r, "LLKKK", &n_indptr, &nnz, &p_indptr, &p_indices,
+                        &p_data)) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  Py_DECREF(r);
+  out_len[0] = (int64_t)n_indptr;
+  out_len[1] = (int64_t)nnz;
+  *out_indptr = (void*)(uintptr_t)p_indptr;
+  *out_indices = (int32_t*)(uintptr_t)p_indices;
+  *out_data = (void*)(uintptr_t)p_data;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFreePredictSparse(void* indptr,
+                                              int32_t* indices, void* data,
+                                              int indptr_type,
+                                              int data_type) {
+  (void)indices;
+  (void)data;
+  (void)indptr_type;
+  (void)data_type;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(K)", (unsigned long long)(uintptr_t)indptr);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_free_predict_sparse", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateByReference(void* reference,
+                                              int64_t num_total_row,
+                                              void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)", (PyObject*)reference,
+                                 (long long)num_total_row);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_by_reference", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRows(void* handle, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiiii)", (PyObject*)handle,
+      (unsigned long long)(uintptr_t)data, data_type, (int)nrow, (int)ncol,
+      (int)start_row);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_push_rows", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRowsByCSR(
+    void* handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int32_t start_row) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKiLLLi)", (PyObject*)handle,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type, (long long)nindptr,
+      (long long)nelem, (long long)num_col, (int)start_row);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_push_rows_by_csr", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterMerge(void* booster, void* other_booster) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)booster,
+                                 (PyObject*)other_booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_merge", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}

@@ -15,13 +15,20 @@ import os
 def pin_jax_platforms() -> None:
     """Apply ``JAX_PLATFORMS`` through jax.config, which is honored even
     where the env var is not. No-op when the env var is unset, when jax
-    is unavailable, or when a backend is already initialized."""
+    is unavailable — or when the embedding program already picked a
+    DIFFERENT platform programmatically (the TPU runtime exports
+    JAX_PLATFORMS itself, so blindly re-applying the env var would
+    clobber an explicit jax.config.update("jax_platforms", "cpu") made
+    by a host process and hang on an unreachable device)."""
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
     try:
         import jax
 
+        current = getattr(jax.config, "jax_platforms", None)
+        if current and current != plat:
+            return
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass

@@ -343,3 +343,317 @@ def test_capi_fast_single_row(lib):
     lib.LGBM_FastConfigFree(cfg)
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+# ---------------------------------------------------------------------
+# round-4 tranche (VERDICT r3 #5): custom-gradient train, JSON dump,
+# field/feature-name access, CSC predict, sparse contribs, streaming
+# push-rows, booster merge — ref: src/c_api.cpp:430-845
+def test_capi_update_one_iter_custom(lib):
+    rng = np.random.RandomState(3)
+    X = rng.rand(1000, 5).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 1000, 5, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 1000, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=none num_leaves=15 verbose=-1", ctypes.byref(bst)))
+    # hand-rolled logloss gradients (what every binding's fobj path sends)
+    score = np.zeros(1000, np.float64)
+    fin = ctypes.c_int()
+    for _ in range(8):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.c_void_p),
+            hess.ctypes.data_as(ctypes.c_void_p), ctypes.byref(fin)))
+        out = np.zeros(1000, np.float64)
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X.ctypes.data_as(ctypes.c_void_p), 1, 1000, 5, 1, 1, 0,
+            -1, b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        score = out
+    # training must separate the classes
+    assert score[y > 0].mean() > score[y == 0].mean() + 0.5
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
+def test_capi_dump_get_field_feature_names(lib):
+    import json
+    rng = np.random.RandomState(4)
+    X = rng.rand(600, 4).astype(np.float64)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    w = (1.0 + y).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 600, 4, 1, b"verbose=-1",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 600, 0))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"weight", w.ctypes.data_as(ctypes.c_void_p), 600, 0))
+
+    # set + get feature names (reference string-array conventions)
+    names = [b"f_alpha", b"f_beta", b"f_gamma", b"f_delta"]
+    arr = (ctypes.c_char_p * 4)(*names)
+    _check(lib, lib.LGBM_DatasetSetFeatureNames(
+        ds, ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), 4))
+    bufs = [ctypes.create_string_buffer(64) for _ in range(4)]
+    ptrs = (ctypes.c_char_p * 4)(*[ctypes.addressof(b) for b in bufs])
+    n_names = ctypes.c_int()
+    need = ctypes.c_size_t()
+    _check(lib, lib.LGBM_DatasetGetFeatureNames(
+        ds, 4, ctypes.byref(n_names), 64, ctypes.byref(need),
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p))))
+    assert n_names.value == 4
+    assert [b.value for b in bufs] == names
+    assert need.value == len(b"f_alpha") + 1
+
+    # get_field returns pinned pointers into the metadata
+    out_ptr = ctypes.c_void_p()
+    out_len = ctypes.c_int()
+    out_type = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetField(
+        ds, b"weight", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)))
+    assert out_len.value == 600 and out_type.value == 0
+    got = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), (600,))
+    np.testing.assert_array_equal(got, w)
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # JSON dump over the ABI, with the two-call buffer-size protocol
+    need64 = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, 0, ctypes.byref(need64), None))
+    buf = ctypes.create_string_buffer(need64.value)
+    _check(lib, lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, need64.value, ctypes.byref(need64), buf))
+    model = json.loads(buf.value.decode())
+    assert model["num_tree_per_iteration"] == 1
+    assert len(model["tree_info"]) == 3
+    assert model["feature_names"] == [n.decode() for n in names]
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
+def test_capi_csc_predict_and_sparse_contribs(lib):
+    import scipy.sparse as sp
+    rng = np.random.RandomState(5)
+    n, F = 800, 12
+    Xs = sp.random(n, F, density=0.3, format="csr", random_state=rng,
+                   data_rvs=lambda k: rng.rand(k) + 0.5)
+    y = (np.asarray(Xs[:, :3].sum(axis=1)).ravel() > 0.5).astype(np.float32)
+    Xd = np.asarray(Xs.todense())
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xd.ctypes.data_as(ctypes.c_void_p), 1, n, F, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # CSC predict must match dense-mat predict
+    dense = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xd.ctypes.data_as(ctypes.c_void_p), 1, n, F, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    csc = Xs.tocsc()
+    got = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForCSC(
+        bst, csc.indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        csc.indices.ctypes.data_as(ctypes.c_void_p),
+        csc.data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz),
+        ctypes.c_int64(n), 0, 0, -1, b"", ctypes.byref(out_len),
+        got.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    np.testing.assert_allclose(got, dense, rtol=1e-12)
+
+    # sparse-output contribs: CSR in, CSR out, freed through the ABI
+    out2 = (ctypes.c_int64 * 2)()
+    o_indptr = ctypes.c_void_p()
+    o_indices = ctypes.POINTER(ctypes.c_int32)()
+    o_data = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictSparseOutput(
+        bst, Xs.indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        Xs.indices.ctypes.data_as(ctypes.c_void_p),
+        Xs.data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(Xs.indptr)), ctypes.c_int64(Xs.nnz),
+        ctypes.c_int64(F), 3, 0, -1, b"", 0, out2,
+        ctypes.byref(o_indptr), ctypes.byref(o_indices),
+        ctypes.byref(o_data)))
+    nindptr, nnz = out2[0], out2[1]
+    assert nindptr == n + 1
+    # the output indptr/data use the CALLER's indptr_type/data_type
+    # (int32/float64 here) — the reference's FreePredictSparse contract
+    indptr = np.ctypeslib.as_array(
+        ctypes.cast(o_indptr, ctypes.POINTER(ctypes.c_int32)), (nindptr,))
+    indices = np.ctypeslib.as_array(o_indices, (nnz,))
+    data = np.ctypeslib.as_array(
+        ctypes.cast(o_data, ctypes.POINTER(ctypes.c_double)), (nnz,))
+    contrib_sparse = sp.csr_matrix(
+        (data.copy(), indices.copy(), indptr.copy()), shape=(n, F + 1))
+    # row sums of contribs == raw predictions (the SHAP identity)
+    raw = np.zeros(n, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xd.ctypes.data_as(ctypes.c_void_p), 1, n, F, 1, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(
+        np.asarray(contrib_sparse.sum(axis=1)).ravel(), raw, atol=1e-9)
+    _check(lib, lib.LGBM_BoosterFreePredictSparse(o_indptr, o_indices,
+                                                  o_data, 3, 1))
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
+def test_capi_create_by_reference_push_rows(lib):
+    rng = np.random.RandomState(6)
+    X = rng.rand(900, 5).astype(np.float64)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    ref = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X[:500].ctypes.data_as(ctypes.c_void_p), 1, 500, 5, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ref)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ref, b"label", y.ctypes.data_as(ctypes.c_void_p), 500, 0))
+
+    # stream the SAME 500 rows in 3 chunks into a by-reference dataset
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(
+        ref, ctypes.c_int64(500), ctypes.byref(ds)))
+    for lo, hi in ((0, 200), (200, 350), (350, 500)):
+        chunk = np.ascontiguousarray(X[lo:hi])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            ds, chunk.ctypes.data_as(ctypes.c_void_p), 1, hi - lo, 5, lo))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 500, 0))
+    n = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+    assert n.value == 500
+
+    # identical rows + shared mappers -> identical trained model
+    def train(handle):
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            handle, b"objective=binary num_leaves=7 verbose=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(3):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        out = np.zeros(100, np.float64)
+        out_len = ctypes.c_int64()
+        q = np.ascontiguousarray(X[:100])
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, q.ctypes.data_as(ctypes.c_void_p), 1, 100, 5, 1, 0, 0,
+            -1, b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        lib.LGBM_BoosterFree(bst)
+        return out
+    np.testing.assert_array_equal(train(ref), train(ds))
+    lib.LGBM_DatasetFree(ds)
+    lib.LGBM_DatasetFree(ref)
+
+
+def test_capi_booster_merge(lib, tmp_path):
+    rng = np.random.RandomState(7)
+    X = rng.rand(500, 4).astype(np.float64)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+
+    def trained(rounds, fname):
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), 1, 500, 4, 1, b"verbose=-1",
+            None, ctypes.byref(ds)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 500, 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbose=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(rounds):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, -1, 0,
+                                              str(fname).encode()))
+        lib.LGBM_BoosterFree(bst)
+        lib.LGBM_DatasetFree(ds)
+
+    trained(3, tmp_path / "a.txt")
+    trained(2, tmp_path / "b.txt")
+    it = ctypes.c_int()
+    a = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        str(tmp_path / "a.txt").encode(), ctypes.byref(it),
+        ctypes.byref(a)))
+    b = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        str(tmp_path / "b.txt").encode(), ctypes.byref(it),
+        ctypes.byref(b)))
+    _check(lib, lib.LGBM_BoosterMerge(a, b))
+    # merged predictions = sum of the two models' raw scores
+    out = np.zeros(500, np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        a, X.ctypes.data_as(ctypes.c_void_p), 1, 500, 4, 1, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    import lightgbm_tpu as lgb
+    ra = lgb.Booster(model_file=str(tmp_path / "a.txt")) \
+        .predict(X, raw_score=True)
+    rb = lgb.Booster(model_file=str(tmp_path / "b.txt")) \
+        .predict(X, raw_score=True)
+    np.testing.assert_allclose(out, ra + rb, rtol=1e-12)
+
+
+def test_reference_c_api_suite(lib, tmp_path):
+    """Run the REFERENCE's own tests/c_api_test/test_.py, unmodified and
+    in place, against libcapi.so (VERDICT r3 #5 'Done' criterion). A
+    symlink sandbox reproduces the layout its find_lib_path expects —
+    no reference code is copied."""
+    import subprocess
+    import sys
+    ref = "/root/reference"
+    if not os.path.isdir(os.path.join(ref, "tests", "c_api_test")):
+        pytest.skip("reference tree unavailable")
+    sandbox = tmp_path / "refbox"
+    (sandbox / "tests").mkdir(parents=True)
+    os.symlink(os.path.join(ref, "tests", "c_api_test"),
+               sandbox / "tests" / "c_api_test")
+    os.symlink(os.path.join(ref, "examples"), sandbox / "examples")
+    (sandbox / "lib").mkdir()
+    os.symlink(build_capi(), sandbox / "lib" / "lib_lightgbm.so")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+    env.pop("XLA_FLAGS", None)
+    run = tmp_path / "run"
+    run.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         str(sandbox / "tests" / "c_api_test" / "test_.py")],
+        cwd=run, env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
