@@ -624,6 +624,135 @@ def booster_merge(bst, other):
     return True
 
 
+# ------------------------------------------------- round-4 tranche 4
+# (booster lifecycle/string IO breadth — ref: c_api.h:313-1310)
+def booster_save_model_to_string(bst, start_iteration, num_iteration,
+                                 feature_importance_type):
+    bst._drain()
+    return bst.model_to_string(
+        start_iteration=start_iteration,
+        num_iteration=(num_iteration if num_iteration != 0 else -1),
+        importance_type=("gain" if feature_importance_type == 1
+                         else "split"))
+
+
+def booster_load_model_from_string(model_str):
+    _ensure_backend()
+    bst = Booster(model_str=model_str)
+    return bst, bst.current_iteration()
+
+
+def booster_get_feature_names(bst):
+    return list(bst.feature_name())
+
+
+def booster_num_model_per_iteration(bst):
+    return int(max(1, bst.num_tree_per_iteration))
+
+
+def booster_number_of_total_model(bst):
+    return int(bst.num_trees())
+
+
+def booster_get_lower_bound_value(bst):
+    """(ref: gbdt.cpp:678 GetLowerBoundValue — sum of per-tree minima)"""
+    bst._drain()
+    return float(sum(float(np.min(ht.leaf_value)) for ht in bst.models))
+
+
+def booster_get_upper_bound_value(bst):
+    bst._drain()
+    return float(sum(float(np.max(ht.leaf_value)) for ht in bst.models))
+
+
+def booster_reset_parameter(bst, parameters):
+    bst.reset_parameter(_parse_params(parameters))
+    return True
+
+
+def booster_shuffle_models(bst, start_iter, end_iter):
+    """(ref: gbdt.h:82 ShuffleModels — Fisher-Yates over iteration blocks
+    with the reference's Random(17) stream; a live booster's device-tree
+    list rides the same permutation so score replay stays aligned)"""
+    from .utils import random as ref_random
+    bst._drain()
+    k = max(1, bst.num_tree_per_iteration)
+    total_iter = len(bst.models) // k
+    start_iter = max(0, start_iter)
+    end_iter = total_iter if end_iter <= 0 else min(total_iter, end_iter)
+    idx = list(range(total_iter))
+    rand = ref_random.Random(17)
+    for i in range(start_iter, end_iter - 1):
+        j = rand.next_short(i + 1, end_iter)
+        idx[i], idx[j] = idx[j], idx[i]
+    perm = [it * k + j for it in idx for j in range(k)]
+    bst.models[:] = [bst.models[i] for i in perm]
+    g = getattr(bst, "_gbdt", None)
+    if g is not None and len(g.device_trees) == len(perm):
+        g.device_trees[:] = [g.device_trees[i] for i in perm]
+    bst._model_version += 1
+    return True
+
+
+def booster_predict_for_mats(bst, row_ptrs_addr, data_type, nrow, ncol,
+                             predict_type, start_iteration, num_iteration,
+                             parameter, out_ptr):
+    """(ref: c_api.h:1185 LGBM_BoosterPredictForMats — one pointer per
+    row)"""
+    ptrs = _wrap(row_ptrs_addr, nrow, 3)   # void* array as int64
+    X = np.empty((nrow, ncol), np.float64)
+    for i in range(nrow):
+        X[i] = _wrap(int(ptrs[i]), ncol, data_type)
+    return _predict_to_buffer(bst, X, predict_type, start_iteration,
+                              num_iteration, out_ptr)
+
+
+def dataset_get_subset(ds, indices_ptr, num_indices, parameters):
+    ds = _resolve_ds(ds)
+    idx = _wrap(indices_ptr, num_indices, 2).copy()
+    # reference CHECKs: indices in range and sorted (c_api.cpp
+    # LGBM_DatasetGetSubset); numpy would wrap a -1 to the LAST row and
+    # silently train on corrupt data otherwise
+    n = dataset_num_data(ds)
+    if idx.size == 0:
+        raise ValueError("used_row_indices is empty")
+    if int(idx.min()) < 0 or int(idx.max()) >= n:
+        raise ValueError(
+            f"used_row_indices out of range [0, {n})")
+    if np.any(np.diff(idx) < 0):
+        raise ValueError("used_row_indices must be sorted")
+    sub = ds.subset(idx, params=_parse_params(parameters))
+    sub.construct()
+    return sub
+
+
+# dataset-defining params that cannot change between construction and a
+# later consumer (ref: c_api.cpp LGBM_DatasetUpdateParamChecking ->
+# Dataset::ValidateParams-class checks)
+_DS_PARAMS = ("max_bin", "max_bin_by_feature", "bin_construct_sample_cnt",
+              "min_data_in_bin", "use_missing", "zero_as_missing",
+              "enable_bundle", "data_random_seed", "min_data_in_leaf",
+              "linear_tree")
+
+
+def dataset_update_param_checking(old_parameters, new_parameters):
+    """Error iff a dataset-defining param RESOLVES differently under the
+    new string (the reference builds Configs from both strings so
+    defaults, aliases, and value normalization are applied before the
+    compare — a new param explicitly set to the old/default value is
+    fine)."""
+    from .config import Config
+    old_cfg = Config(_parse_params(old_parameters))
+    new_cfg = Config(_parse_params(new_parameters))
+    changed = getattr(new_cfg, "_user_set", set())
+    for key in _DS_PARAMS:
+        if key in changed and getattr(old_cfg, key, None) \
+                != getattr(new_cfg, key, None):
+            raise ValueError(
+                f"Cannot change {key} after constructed Dataset handle")
+    return True
+
+
 class _FastConfig:
     """Preallocated single-row predict state (ref: c_api.cpp:939-1156
     FastConfigHandle — parse params/alloc once, then per-call predicts

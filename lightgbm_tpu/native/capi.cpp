@@ -851,3 +851,204 @@ LGBM_EXPORT int LGBM_BoosterMerge(void* booster, void* other_booster) {
   Py_DECREF(r);
   return 0;
 }
+
+// ----------------------------------------------------------------------
+// round-4 tranche 4 (booster lifecycle/string IO breadth —
+// ref: include/LightGBM/c_api.h:313-1310)
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(void* booster,
+                                              int start_iteration,
+                                              int num_iteration,
+                                              int feature_importance_type,
+                                              int64_t buffer_len,
+                                              int64_t* out_len,
+                                              char* out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiii)", (PyObject*)booster,
+                                 start_iteration, num_iteration,
+                                 feature_importance_type);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_save_model_to_string", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  *out_len = (int64_t)n + 1;
+  if (out_str != nullptr && buffer_len >= n + 1) {
+    std::memcpy(out_str, s, n + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", model_str ? model_str : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_load_model_from_string", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  PyObject* bst = PyTuple_GetItem(r, 0);
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_INCREF(bst);
+  *out = (void*)bst;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetFeatureNames(void* booster, const int len,
+                                            int* out_len,
+                                            const size_t buffer_len,
+                                            size_t* out_buffer_len,
+                                            char** out_strs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_feature_names", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = (int)n;
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    size_t l = s ? strlen(s) + 1 : 1;
+    if (l > need) need = l;
+    if (out_strs != nullptr && i < len && s != nullptr) {
+      std::snprintf(out_strs[i], buffer_len, "%s", s);
+    }
+  }
+  *out_buffer_len = need;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int int_getter(const char* fn, void* handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)handle);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterNumModelPerIteration(void* booster,
+                                                 int* out_tree_per_it) {
+  return int_getter("booster_num_model_per_iteration", booster,
+                    out_tree_per_it);
+}
+
+LGBM_EXPORT int LGBM_BoosterNumberOfTotalModel(void* booster,
+                                               int* out_models) {
+  return int_getter("booster_number_of_total_model", booster, out_models);
+}
+
+static int double_getter(const char* fn, void* handle, double* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)handle);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLowerBoundValue(void* booster,
+                                               double* out_results) {
+  return double_getter("booster_get_lower_bound_value", booster,
+                       out_results);
+}
+
+LGBM_EXPORT int LGBM_BoosterGetUpperBoundValue(void* booster,
+                                               double* out_results) {
+  return double_getter("booster_get_upper_bound_value", booster,
+                       out_results);
+}
+
+LGBM_EXPORT int LGBM_BoosterResetParameter(void* booster,
+                                           const char* parameters) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", (PyObject*)booster,
+                                 parameters ? parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_reset_parameter", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterShuffleModels(void* booster, int start_iter,
+                                          int end_iter) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)", (PyObject*)booster, start_iter,
+                                 end_iter);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_shuffle_models", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMats(
+    void* booster, const void** data, int data_type, int32_t nrow,
+    int32_t ncol, int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiiiiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)data, data_type, (int)nrow, (int)ncol,
+      predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_mats", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetSubset(const void* handle,
+                                      const int32_t* used_row_indices,
+                                      int32_t num_used_row_indices,
+                                      const char* parameters, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKis)", (PyObject*)handle,
+      (unsigned long long)(uintptr_t)used_row_indices,
+      (int)num_used_row_indices, parameters ? parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_get_subset", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                                const char* new_parameters) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ss)",
+                                 old_parameters ? old_parameters : "",
+                                 new_parameters ? new_parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_update_param_checking", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}

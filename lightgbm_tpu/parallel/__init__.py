@@ -15,16 +15,14 @@ over ICI/DCN (SURVEY.md §2.3):
                     (feature_parallel_tree_learner.cpp:60-77).
 """
 from .mesh import make_mesh, replicate, shard_rows
-from .data_parallel import (grow_tree_data_parallel, make_sharded_grow_fn,
-                            train_step_data_parallel)
+from .data_parallel import make_sharded_grow_fn
 from .tree_parallel import (make_feature_parallel_grow_fn,
                             make_voting_parallel_grow_fn)
 from . import distributed
 
 __all__ = [
     "make_mesh", "replicate", "shard_rows",
-    "grow_tree_data_parallel", "make_sharded_grow_fn",
-    "train_step_data_parallel",
+    "make_sharded_grow_fn",
     "make_feature_parallel_grow_fn", "make_voting_parallel_grow_fn",
     "distributed",
 ]

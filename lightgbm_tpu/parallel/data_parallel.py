@@ -38,7 +38,16 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
     + row-sharded leaf assignment out. ``has_cat`` enables the categorical
     split scan (pass True whenever the dataset has categorical features —
     without it category bins would be scanned as ordered numeric
-    thresholds)."""
+    thresholds).
+
+    Role: the STANDALONE composition surface (unit tests, external
+    embedders growing single trees). The product driver builds its own
+    richer closures (bundles, CEGB, node masks, feature slicing) in
+    GBDT._build_par_fn — but both delegate to the same grow_tree_*
+    functions, where the psum collectives live exactly once (round-3
+    review: the driver and these factories must not carry divergent
+    copies of the collective logic; they don't — neither implements
+    any)."""
     grow = grow_tree_leafwise if policy == "leafwise" else grow_tree_depthwise
 
     def per_shard(bins, gh, meta, feature_mask):
@@ -56,58 +65,5 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
         out_specs=(P(), P(axis_name)),
-        check_vma=False)
-    return jax.jit(sharded)
-
-
-def grow_tree_data_parallel(mesh: Mesh, bins, gh, meta: FeatureMeta,
-                            feature_mask, params: SplitParams,
-                            num_leaves: int, max_bins: int,
-                            max_depth: int = -1, policy: str = "leafwise",
-                            hist_impl: str = "auto", has_cat: bool = False,
-                            ) -> Tuple[TreeArrays, jax.Array]:
-    """One-shot helper (the GBDT driver caches make_sharded_grow_fn)."""
-    fn = make_sharded_grow_fn(mesh, params, num_leaves, max_bins, max_depth,
-                              policy, hist_impl, has_cat=has_cat)
-    return fn(bins, gh, meta, feature_mask)
-
-
-def train_step_data_parallel(mesh: Mesh, params: SplitParams,
-                             num_leaves: int, max_bins: int,
-                             axis_name: str = DATA_AXIS,
-                             policy: str = "depthwise",
-                             has_cat: bool = False):
-    """A FULL jit-compiled data-parallel boosting step: binary-logloss
-    gradients -> sharded tree growth (histogram psum over the mesh) -> score
-    update.  This is the flagship multi-chip path the driver dry-runs
-    (ref call stack being replaced: gbdt.cpp:371 TrainOneIter +
-    data_parallel_tree_learner.cpp FindBestSplits).
-
-    Returns a jitted fn: (bins[R,F] sharded, label[R] sharded,
-    valid[R] sharded, score[R] sharded, meta, feature_mask) ->
-    (new_score, tree arrays).  ``valid`` is 1.0 for real rows, 0.0 for
-    shard_rows padding — padded rows must carry zero histogram weight.
-    """
-    grow = grow_tree_leafwise if policy == "leafwise" else grow_tree_depthwise
-
-    def per_shard(bins, label, valid, score, meta, feature_mask):
-        # gradients: binary logloss (ref: binary_objective.hpp:107)
-        lv = jnp.where(label > 0, 1.0, -1.0)
-        response = -lv / (1.0 + jnp.exp(lv * score))
-        grad = response * valid
-        hess = jnp.abs(response) * (1.0 - jnp.abs(response)) * valid
-        gh = jnp.stack([grad, hess, valid], axis=1)
-        tree, row_leaf = grow(bins, gh, meta, feature_mask, params,
-                              num_leaves, max_bins, -1,
-                              hist_impl="segment", psum_axis=axis_name,
-                              has_cat=has_cat)
-        new_score = score + 0.1 * tree.leaf_value[row_leaf]
-        return new_score, tree
-
-    sharded = jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
-                  P(axis_name), P(), P()),
-        out_specs=(P(axis_name), P()),
         check_vma=False)
     return jax.jit(sharded)

@@ -14,12 +14,19 @@ import os
 
 def pin_jax_platforms() -> None:
     """Apply ``JAX_PLATFORMS`` through jax.config, which is honored even
-    where the env var is not. No-op when the env var is unset, when jax
-    is unavailable — or when the embedding program already picked a
-    DIFFERENT platform programmatically (the TPU runtime exports
-    JAX_PLATFORMS itself, so blindly re-applying the env var would
-    clobber an explicit jax.config.update("jax_platforms", "cpu") made
-    by a host process and hang on an unreachable device)."""
+    where the env var is not. No-op when the env var is unset or jax is
+    unavailable.
+
+    Conflict rule — CPU wins. Two parties can have set jax_platforms
+    before we run: an embedding host program (e.g. a test harness
+    calling jax.config.update("jax_platforms", "cpu")) or the TPU
+    runtime's own plugin (which both exports JAX_PLATFORMS and may set
+    the config programmatically at interpreter startup). We cannot tell
+    them apart, but the safe resolution is directional: a CPU request —
+    from either the env var or the existing config — always prevails,
+    because pinning to CPU never hangs, while dragging a CPU-pinned
+    process onto an unreachable accelerator blocks backend bring-up
+    forever."""
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
@@ -27,8 +34,13 @@ def pin_jax_platforms() -> None:
         import jax
 
         current = getattr(jax.config, "jax_platforms", None)
-        if current and current != plat:
-            return
+        # "cpu first" is the only configuration that counts as a host's
+        # explicit CPU pin; the TPU runtime's own hook sets
+        # "<accel>,cpu" (accelerator preferred, cpu fallback), which an
+        # env request must still override
+        if current and current != plat \
+                and str(current).split(",")[0] == "cpu":
+            return   # the host already forced CPU; never override that
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass

@@ -657,3 +657,112 @@ def test_reference_c_api_suite(lib, tmp_path):
          str(sandbox / "tests" / "c_api_test" / "test_.py")],
         cwd=run, env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+
+
+def test_capi_tranche4_lifecycle(lib, tmp_path):
+    """Round-4 tranche 4: string IO, counters, bounds, reset_parameter,
+    shuffle, PredictForMats, GetSubset, UpdateParamChecking (ref:
+    c_api.h:313-1310)."""
+    rng = np.random.RandomState(8)
+    X = rng.rand(800, 4)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 800, 4, 1, b"verbose=-1",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 800, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 learning_rate=0.1 verbose=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # reset_parameter mid-training (the reference's reset_parameter
+    # callback path crosses exactly this symbol)
+    _check(lib, lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.2"))
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    n = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterNumModelPerIteration(bst, ctypes.byref(n)))
+    assert n.value == 1
+    _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 5
+
+    lo = ctypes.c_double()
+    hi = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLowerBoundValue(bst, ctypes.byref(lo)))
+    _check(lib, lib.LGBM_BoosterGetUpperBoundValue(bst, ctypes.byref(hi)))
+    assert lo.value < hi.value
+
+    # save-to-string -> load-from-string round trip
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, 0, ctypes.byref(need), None))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, need.value, ctypes.byref(need), buf))
+    it = ctypes.c_int()
+    bst2 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterLoadModelFromString(
+        buf.value, ctypes.byref(it), ctypes.byref(bst2)))
+    assert it.value == 5
+
+    # feature names through the booster
+    bufs = [ctypes.create_string_buffer(64) for _ in range(4)]
+    ptrs = (ctypes.c_char_p * 4)(*[ctypes.addressof(b) for b in bufs])
+    nn = ctypes.c_int()
+    blen = ctypes.c_size_t()
+    _check(lib, lib.LGBM_BoosterGetFeatureNames(
+        bst2, 4, ctypes.byref(nn), 64, ctypes.byref(blen),
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p))))
+    assert nn.value == 4
+
+    # PredictForMats (row-pointer array) == PredictForMat
+    rows = np.ascontiguousarray(X[:16], np.float64)
+    rp = (ctypes.c_void_p * 16)(
+        *[rows[i].ctypes.data for i in range(16)])
+    got = np.zeros(16, np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMats(
+        bst2, ctypes.cast(rp, ctypes.POINTER(ctypes.c_void_p)), 1, 16, 4,
+        0, 0, -1, b"", ctypes.byref(out_len),
+        got.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    want = np.zeros(16, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, rows.ctypes.data_as(ctypes.c_void_p), 1, 16, 4, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        want.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_array_equal(got, want)
+
+    # shuffle preserves the prediction (sum over trees is order-free)
+    _check(lib, lib.LGBM_BoosterShuffleModels(bst2, 0, -1))
+    got2 = np.zeros(16, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, rows.ctypes.data_as(ctypes.c_void_p), 1, 16, 4, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        got2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(got2, want, rtol=1e-12)
+
+    # dataset subset
+    idx = np.arange(0, 800, 2, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 400, b"",
+        ctypes.byref(sub)))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(nd)))
+    assert nd.value == 400
+
+    # param checking: same ok, changed dataset param rejected
+    _check(lib, lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=255 verbose=-1", b"max_bin=255 learning_rate=0.5"))
+    rc = lib.LGBM_DatasetUpdateParamChecking(b"max_bin=255", b"max_bin=63")
+    assert rc == -1
+
+    lib.LGBM_DatasetFree(sub)
+    lib.LGBM_BoosterFree(bst2)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
