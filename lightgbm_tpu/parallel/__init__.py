@@ -19,10 +19,11 @@ from .data_parallel import make_sharded_grow_fn
 from .tree_parallel import (make_feature_parallel_grow_fn,
                             make_voting_parallel_grow_fn)
 from . import distributed
+from .launcher import train_distributed
 
 __all__ = [
     "make_mesh", "replicate", "shard_rows",
     "make_sharded_grow_fn",
     "make_feature_parallel_grow_fn", "make_voting_parallel_grow_fn",
-    "distributed",
+    "distributed", "train_distributed",
 ]

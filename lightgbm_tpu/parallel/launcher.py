@@ -1,0 +1,150 @@
+"""Multi-process training launcher — the orchestration analog of the
+reference's Dask integration.
+
+The reference's ``dask.py`` finds open ports, builds the ``machines``
+string, runs one local fit per worker, and returns rank 0's booster
+(ref: python-package/lightgbm/dask.py:67-135 port negotiation, :166
+``_train_part``, :392 ``_train``). On the JAX runtime the transport
+negotiation collapses to ``jax.distributed.initialize`` against one
+coordinator address; this module supplies the remaining orchestration:
+spawn N processes, give each its rank, let each load its shard of the
+data file (the loader reads per-rank row slices and allgathers the
+binning sample), train ONE model jointly (``tree_learner=data`` over
+the global mesh — parallel/multiproc.py), and hand back rank 0's
+booster.
+
+Single-host by default (N local processes, gloo collectives on CPU or
+one process per accelerator); multi-host works by running the same
+worker on every host with ``coordinator_address`` pointing at host 0 —
+the exact shape of the reference's machine-list deployments.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Dict, Optional
+
+from ..utils import log
+
+_WORKER = """
+import json, os, sys
+cfg = json.load(open(sys.argv[1]))
+import jax
+if cfg["env"].get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=cfg["coordinator"],
+    num_processes=cfg["num_processes"], process_id=cfg["rank"])
+import lightgbm_tpu as lgb
+
+ds = lgb.Dataset(cfg["data"], params=cfg["dataset_params"])
+bst = lgb.train(cfg["params"], ds,
+                num_boost_round=cfg["num_boost_round"])
+if jax.process_index() == 0:
+    with open(cfg["out"], "w") as fh:
+        fh.write(bst.model_to_string(num_iteration=-1))
+"""
+
+
+def _free_port() -> int:
+    # NOTE: inherently racy (the socket closes before the coordinator
+    # rebinds); SO_REUSEADDR narrows the window. Contended environments
+    # should pass coordinator_address explicitly.
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def train_distributed(params: Dict, data_path: str, num_processes: int,
+                      num_boost_round: int = 100,
+                      dataset_params: Optional[Dict] = None,
+                      devices_per_process: int = 0,
+                      coordinator_address: Optional[str] = None,
+                      use_cpu: bool = True, timeout: float = 3600.0):
+    """Train ONE model with ``num_processes`` local worker processes over
+    per-rank shards of ``data_path``; returns rank 0's Booster (every
+    rank holds the identical model — tests/test_multiproc_train.py).
+
+    ``devices_per_process`` > 0 forces that many virtual CPU devices per
+    worker (XLA_FLAGS); ``use_cpu=False`` leaves the platform to the
+    runtime (one accelerator process per host). The reference flow being
+    mirrored: dask.py _train — partition per worker, port negotiation,
+    per-worker local fit, rank-0 booster returned, others discarded.
+    """
+    from ..basic import Booster
+
+    params = dict(params)
+    params.setdefault("tree_learner", "data")
+    coord = coordinator_address or f"127.0.0.1:{_free_port()}"
+    with tempfile.TemporaryDirectory(prefix="lgbm_tpu_launch_") as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as fh:
+            fh.write(_WORKER)
+        out = os.path.join(td, "model.txt")
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        procs = []
+        logs = []
+        for rank in range(num_processes):
+            cfg = {"coordinator": coord, "num_processes": num_processes,
+                   "rank": rank, "data": str(data_path),
+                   "params": params, "num_boost_round": num_boost_round,
+                   "dataset_params": dict(dataset_params or {}),
+                   "out": out,
+                   "env": {"JAX_PLATFORMS": "cpu"} if use_cpu else {}}
+            cfg_path = os.path.join(td, f"cfg{rank}.json")
+            with open(cfg_path, "w") as fh:
+                json.dump(cfg, fh)
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)   # inherited flags never apply
+            if devices_per_process > 0:
+                env["XLA_FLAGS"] = (
+                    "--xla_force_host_platform_device_count="
+                    f"{devices_per_process}")
+            if use_cpu:
+                # the TPU site hook breaks multiprocess CPU backends;
+                # keep only the package root on the path
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PYTHONPATH"] = pkg_root
+            else:
+                # accelerator workers still need the package importable
+                # when it is not pip-installed
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [pkg_root] + ([env["PYTHONPATH"]]
+                                  if env.get("PYTHONPATH") else []))
+            # worker output goes to FILES: a filled 64KB stderr pipe
+            # would stall that rank inside a collective and deadlock
+            # the whole fleet until the timeout
+            lf = open(os.path.join(td, f"rank{rank}.log"), "w+b")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                [sys.executable, script, cfg_path], env=env,
+                stdout=lf, stderr=subprocess.STDOUT))
+        errs = []
+        for rank, p in enumerate(procs):
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    q.wait()   # reap — no zombies in long-lived hosts
+                log.fatal("distributed training timed out after %.0fs "
+                          "(rank %d still running)", timeout, rank)
+            if p.returncode != 0:
+                logs[rank].seek(0)
+                tail = logs[rank].read().decode(errors="replace")[-1500:]
+                errs.append(f"rank {rank}: rc={p.returncode}: {tail}")
+        for lf in logs:
+            lf.close()
+        if errs:
+            log.fatal("distributed training failed:\n%s",
+                      "\n".join(errs))
+        with open(out) as fh:
+            return Booster(model_str=fh.read())
