@@ -745,6 +745,7 @@ class Booster:
         self.feature_names = header.get("feature_names", "").split()
         self.feature_infos = header.get("feature_infos", "").split()
         obj_str = header.get("objective", "none")
+        self._objective_str = obj_str
         self.objective = create_objective_from_string(obj_str)
 
     # ------------------------------------------------------------------
@@ -806,6 +807,80 @@ class Booster:
                                           + (1.0 - decay_rate) * new_out)
             scores[tid] += t.predict_rows(X)
         return new_booster
+
+    def reset_training_data(self, train_set: "Dataset") -> "Booster":
+        """Attach (or replace) training data on an existing model
+        (ref: c_api.cpp:1631 LGBM_BoosterResetTrainingData ->
+        gbdt.cpp:686 GBDT::ResetTrainingData): previously loaded/merged
+        trees become the init segment (scores NOT replayed, matching the
+        reference's iter_-only replay loop), while trees trained in this
+        booster's own lifetime are kept trainable and their scores are
+        replayed on the new data. New data must share bin mappers with
+        the old (CheckAlign)."""
+        self._drain()
+        old_models = list(self.models) if self.models else []
+        old_g = getattr(self, "_gbdt", None)
+        post = []              # (host, device) trees trained post-init
+        init_models = old_models
+        if old_g is not None:
+            k = max(1, old_g.num_tree_per_iteration)
+            n_init = old_g.num_init_iteration * k
+            init_models = old_models[:n_init]
+            post = list(zip(old_g.models[n_init:],
+                            old_g.device_trees[n_init:]))
+            train_set.construct()
+            if self.train_set is not None \
+                    and train_set is not self.train_set \
+                    and train_set._inner.feature_infos() \
+                    != self.train_set._inner.feature_infos():
+                raise ValueError(
+                    "Cannot reset training data, since new training data "
+                    "has different bin mappers")
+        # a model-file/string booster carries its objective in the header,
+        # not in params — restore name AND sub-parameters ("binary
+        # sigmoid:2" -> objective=binary, sigmoid=2) so _init_train
+        # rebuilds the same one
+        if "objective" not in self.params \
+                and getattr(self, "_objective_str", None):
+            toks = self._objective_str.split()
+            self.params["objective"] = toks[0]
+            for t in toks[1:]:
+                if ":" in t:
+                    k, v = t.split(":", 1)
+                    self.params.setdefault(k, v)
+        if self.num_class > 1:
+            self.params.setdefault("num_class", self.num_class)
+        self._init_train(train_set)
+        g = self._gbdt
+        if init_models:
+            g.adopt_init_models(init_models)
+        # post-init trees: keep trainable, replay scores on the new data
+        # (binned thresholds stay valid under the CheckAlign contract)
+        for idx, (ht, dt) in enumerate(post):
+            tid = idx % g.num_tree_per_iteration
+            g.models.append(ht)
+            g.device_trees.append(dt)
+            g.scores = g._add_tree_to_score(g.scores, g.bins_dev, dt, tid,
+                                            bundle=g._train_bundle())
+        g.iter = len(post) // max(1, g.num_tree_per_iteration)
+        self.models = g.models
+        self._model_version += 1
+        return self
+
+    def refit_by_leaf_preds(self, leaf_preds: np.ndarray) -> "Booster":
+        """In-place leaf-value refit from a precomputed leaf-assignment
+        matrix (ref: c_api.cpp:1665 LGBM_BoosterRefit -> gbdt.cpp:287
+        RefitTree). Needs live training data — load the model, then
+        reset_training_data() first."""
+        if getattr(self, "_gbdt", None) is None:
+            raise ValueError(
+                "BoosterRefit needs training data; call "
+                "reset_training_data()/LGBM_BoosterResetTrainingData first")
+        self._gbdt.refit_by_leaf_preds(
+            np.asarray(leaf_preds, np.int32).reshape(
+                self._gbdt.num_data, -1))
+        self._model_version += 1
+        return self
 
     def __copy__(self):
         return self.__deepcopy__(None)

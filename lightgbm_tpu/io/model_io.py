@@ -255,7 +255,8 @@ def parse_model_string(model_str: str) -> Tuple[Dict[str, str],
 
 
 def dump_model_json(booster, start_iteration: int = 0,
-                    num_iteration: int = -1) -> str:
+                    num_iteration: int = -1,
+                    importance_type: int = 0) -> str:
     """JSON dump (ref: gbdt_model_text.cpp DumpModel)."""
     models = booster.models
     k = booster.num_tree_per_iteration
@@ -316,6 +317,15 @@ def dump_model_json(booster, start_iteration: int = 0,
         "feature_infos": {},
         "tree_info": tree_infos,
     }
+    # nonzero importances keyed by feature name; the int truncation and
+    # the >0 drop are the REFERENCE's own behavior (gbdt_model_text.cpp
+    # :105-107 static_cast<size_t> + `if (feature_importances_int > 0)`)
+    imp = feature_importance(models[start_iteration * k:num_used],
+                             booster.max_feature_idx + 1, importance_type)
+    names = booster.feature_names or [
+        f"Column_{i}" for i in range(booster.max_feature_idx + 1)]
+    out["feature_importances"] = {
+        names[i]: int(v) for i, v in enumerate(imp) if int(v) > 0}
     return json.dumps(out, indent=2)
 
 

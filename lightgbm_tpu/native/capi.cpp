@@ -17,8 +17,11 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <string>
+#include <utility>
+#include <vector>
 
 #define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
 
@@ -1047,6 +1050,376 @@ LGBM_EXPORT int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
                                  new_parameters ? new_parameters : "");
   if (args == nullptr) return fail_from_python();
   PyObject* r = call("dataset_update_param_checking", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+// ----------------------------------------------------------------------
+// round-5 tranche: the final 20 symbols to full c_api.h parity
+// (ref: include/LightGBM/c_api.h — booster lifecycle Refit/Reset/
+// FeatureImportance/GetPredict, sampling helpers, multi-mat and
+// sampled-column dataset creation, single-row CSR fast paths, log and
+// network injection hooks)
+
+namespace {
+// shared two-call string-buffer protocol (out_len = bytes incl. NUL,
+// copy only when it fits)
+int string_result_to_buffer(PyObject* r, int64_t buffer_len,
+                            int64_t* out_len, char* out_str) {
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail_from_python();
+  }
+  *out_len = (int64_t)n + 1;
+  if (out_str != nullptr && buffer_len >= n + 1) {
+    std::memcpy(out_str, s, n + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+LGBM_EXPORT int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                                      char* out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dump_param_aliases", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  return string_result_to_buffer(r, buffer_len, out_len, out_str);
+}
+
+LGBM_EXPORT int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(K)", (unsigned long long)(uintptr_t)callback);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("register_log_callback", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_GetSampleCount(int32_t num_total_row,
+                                    const char* parameters, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(is)", (int)num_total_row,
+                                 parameters ? parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("get_sample_count", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_SampleIndices(int32_t num_total_row,
+                                   const char* parameters, void* out,
+                                   int32_t* out_len) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(isK)", (int)num_total_row, parameters ? parameters : "",
+      (unsigned long long)(uintptr_t)out);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("sample_indices", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(KKiKiis)", (unsigned long long)(uintptr_t)sample_data,
+      (unsigned long long)(uintptr_t)sample_indices, (int)ncol,
+      (unsigned long long)(uintptr_t)num_per_col, (int)num_sample_row,
+      (int)num_total_row, parameters ? parameters : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_sampled_column", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                                           int data_type, int32_t* nrow,
+                                           int32_t ncol, int is_row_major,
+                                           const char* parameters,
+                                           const void* reference,
+                                           void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(iKiKiisO)", (int)nmat, (unsigned long long)(uintptr_t)data,
+      data_type, (unsigned long long)(uintptr_t)nrow, (int)ncol,
+      is_row_major, parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_mats", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+// the get-row functor convention is a C++ std::function pointer (ref:
+// c_api.cpp LGBM_DatasetCreateFromCSRFunc); rows are materialized here
+// and handed to the normal CSR constructor
+LGBM_EXPORT int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr,
+                                              int num_rows, int64_t num_col,
+                                              const char* parameters,
+                                              const void* reference,
+                                              void** out) {
+  if (get_row_funptr == nullptr) {
+    g_last_error = "get_row_funptr is null";
+    return -1;
+  }
+  typedef std::function<void(int idx,
+                             std::vector<std::pair<int, double>>&)> RowFn;
+  auto& get_row = *static_cast<RowFn*>(get_row_funptr);
+  std::vector<int32_t> indptr{0};
+  std::vector<int32_t> indices;
+  std::vector<double> values;
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    get_row(i, row);
+    for (const auto& kv : row) {
+      indices.push_back(kv.first);
+      values.push_back(kv.second);
+    }
+    indptr.push_back(static_cast<int32_t>(indices.size()));
+  }
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(KiKKiKKisO)", (unsigned long long)(uintptr_t)indptr.data(),
+      2 /* int32 */, (unsigned long long)(uintptr_t)indices.data(),
+      (unsigned long long)(uintptr_t)values.data(), 1 /* float64 */,
+      (unsigned long long)(uintptr_t)indptr.size(),
+      (unsigned long long)(uintptr_t)values.size(), (int)num_col,
+      parameters ? parameters : "",
+      reference ? (PyObject*)reference : Py_None);
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("dataset_create_from_csr", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(void* target, void* source) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)target,
+                                 (PyObject*)source);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_add_features_from", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetDumpText(void* handle, const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", (PyObject*)handle, filename);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("dataset_dump_text", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLinear(void* booster, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)booster);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_linear", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterResetTrainingData(void* booster,
+                                              const void* train_data) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)booster,
+                                 (PyObject*)train_data);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_reset_training_data", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterRefit(void* booster, const int32_t* leaf_preds,
+                                  int32_t nrow, int32_t ncol) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKii)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)leaf_preds, (int)nrow, (int)ncol);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_refit", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFeatureImportance(void* booster,
+                                              int num_iteration,
+                                              int importance_type,
+                                              double* out_results) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OiiK)", (PyObject*)booster, num_iteration, importance_type,
+      (unsigned long long)(uintptr_t)out_results);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_feature_importance", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumPredict(void* booster, int data_idx,
+                                          int64_t* out_len) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", (PyObject*)booster, data_idx);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_num_predict", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetPredict(void* booster, int data_idx,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OiK)", (PyObject*)booster, data_idx,
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_get_predict", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    void* booster, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiiiiiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)data, data_type, 1 /* nrow */, ncol,
+      is_row_major, predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_mat", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    void* booster, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKiKKKiiisK)", (PyObject*)booster,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (unsigned long long)nindptr, (unsigned long long)nelem,
+      (unsigned long long)num_col, predict_type, start_iteration,
+      num_iteration, parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("booster_predict_for_csr_single_row", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    void* booster, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int64_t num_col,
+    const char* parameter, void** out_fast_config) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OiiiiKs)", (PyObject*)booster, predict_type, start_iteration,
+      num_iteration, data_type, (unsigned long long)num_col,
+      parameter ? parameter : "");
+  if (args == nullptr) return fail_from_python();
+  PyObject* h = call("fast_config_create_csr", args);
+  Py_DECREF(args);
+  if (h == nullptr) return fail_from_python();
+  *out_fast_config = (void*)h;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRowFast(
+    void* fast_config, const void* indptr, const int indptr_type,
+    const int32_t* indices, const void* data, const int64_t nindptr,
+    const int64_t nelem, int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKiKKKKK)", (PyObject*)fast_config,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, (unsigned long long)nindptr,
+      (unsigned long long)nelem,
+      (unsigned long long)(uintptr_t)out_result);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("predict_single_row_fast_csr", args);
+  Py_DECREF(args);
+  if (r == nullptr) return fail_from_python();
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                              void* reduce_scatter_ext_fun,
+                                              void* allgather_ext_fun) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(iiKK)", num_machines, rank,
+      (unsigned long long)(uintptr_t)reduce_scatter_ext_fun,
+      (unsigned long long)(uintptr_t)allgather_ext_fun);
+  if (args == nullptr) return fail_from_python();
+  PyObject* r = call("network_init_with_functions", args);
   Py_DECREF(args);
   if (r == nullptr) return fail_from_python();
   Py_DECREF(r);

@@ -1,0 +1,156 @@
+"""External collective-function injection (ref: c_api.h:1336
+LGBM_NetworkInitWithFunctions -> Network::ExternalInit, meta.h:68
+ReduceScatterFunction/AllgatherFunction typedefs).
+
+Embedders that own their transport (MPI wrappers, Spark barrier
+executors) hand the reference two C function pointers and every
+collective rides them. The TPU analog keeps that contract for the
+HOST-side collectives (metadata/model/statistic sync — the traffic the
+reference routes through these functions between tree levels), exposed
+here as numpy-array allgather/reduce-scatter wrappers with the exact C
+calling convention. DEVICE-side histogram collectives are in-jit XLA
+psums over the jax.distributed mesh — an external function pointer
+cannot be spliced into an XLA collective schedule, so multi-process
+training additionally needs the jax process runtime up
+(`parallel.distributed.init_distributed` / the launcher); registering
+external functions alone coordinates the host plane only.
+
+C signatures marshaled (comm_size_t = int32):
+  void reduce_scatter(char* input, int32 input_size, int type_size,
+                      const int32* block_start, const int32* block_len,
+                      int num_block, char* output, int32 output_size,
+                      const ReduceFunction& reducer)
+  void allgather(char* input, int32 input_size,
+                 const int32* block_start, const int32* block_len,
+                 int num_block, char* output, int32 output_size)
+  void reducer(const char* input, char* output, int type_size,
+               int32 array_size)
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+
+_c_i32 = ctypes.c_int32
+# src/dst as void* (not char*): ctypes converts c_char_p callback args to
+# immutable bytes, which would break in-place reduction
+_REDUCE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_int, _c_i32)
+_REDUCE_SCATTER_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, _c_i32, ctypes.c_int,
+    ctypes.POINTER(_c_i32), ctypes.POINTER(_c_i32), ctypes.c_int,
+    ctypes.c_void_p, _c_i32, ctypes.POINTER(_REDUCE_FN))
+_ALLGATHER_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, _c_i32, ctypes.POINTER(_c_i32),
+    ctypes.POINTER(_c_i32), ctypes.c_int, ctypes.c_void_p, _c_i32)
+
+
+class _ExtNet:
+    def __init__(self, num_machines: int, rank: int,
+                 reduce_scatter_addr: int, allgather_addr: int):
+        self.num_machines = num_machines
+        self.rank = rank
+        self.reduce_scatter_fn = _REDUCE_SCATTER_FN(reduce_scatter_addr)
+        self.allgather_fn = _ALLGATHER_FN(allgather_addr)
+
+
+_STATE: Optional[_ExtNet] = None
+
+
+def init_with_functions(num_machines: int, rank: int,
+                        reduce_scatter_addr: int,
+                        allgather_addr: int) -> None:
+    global _STATE
+    if num_machines < 1 or not (0 <= rank < num_machines):
+        raise ValueError(f"invalid rank {rank} of {num_machines} machines")
+    if num_machines > 1 and (not reduce_scatter_addr or not allgather_addr):
+        raise ValueError("NetworkInitWithFunctions needs both function "
+                         "pointers for num_machines > 1")
+    _STATE = _ExtNet(num_machines, rank, reduce_scatter_addr or 0,
+                     allgather_addr or 0)
+    log.info("external network functions registered: rank %d of %d",
+             rank, num_machines)
+
+
+def free() -> None:
+    global _STATE
+    _STATE = None
+
+
+def is_active() -> bool:
+    return _STATE is not None and _STATE.num_machines > 1
+
+
+def rank() -> int:
+    return _STATE.rank if _STATE is not None else 0
+
+
+def num_machines() -> int:
+    return _STATE.num_machines if _STATE is not None else 1
+
+
+def allgather(local: np.ndarray) -> np.ndarray:
+    """Every rank's ``local`` block -> concatenated array, identical on
+    all ranks. Blocks must be the same shape on every rank (the
+    fixed-block layout Network::Allgather uses for same-size payloads)."""
+    st = _STATE
+    if st is None or st.num_machines == 1:
+        return np.asarray(local).copy()
+    loc = np.ascontiguousarray(local)
+    bs = loc.nbytes
+    n = st.num_machines
+    starts = (_c_i32 * n)(*[i * bs for i in range(n)])
+    lens = (_c_i32 * n)(*[bs] * n)
+    out = np.empty(n * bs, np.uint8)
+    st.allgather_fn(
+        loc.ctypes.data_as(ctypes.c_void_p), _c_i32(bs), starts, lens,
+        ctypes.c_int(n), out.ctypes.data_as(ctypes.c_void_p),
+        _c_i32(out.nbytes))
+    return out.view(loc.dtype).reshape((n * loc.shape[0],) + loc.shape[1:])
+
+
+def _sum_reducer_for(dtype: np.dtype) -> _REDUCE_FN:
+    dt = np.dtype(dtype)
+
+    def _reduce(src, dst, type_size, array_size):
+        nelem = array_size // dt.itemsize
+
+        def as_np(addr):
+            return np.ctypeslib.as_array(
+                ctypes.cast(addr, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(array_size,)).view(dt)[:nelem]
+        b = as_np(dst)
+        b += as_np(src)
+    return _REDUCE_FN(_reduce)
+
+
+def allreduce_sum(local: np.ndarray) -> np.ndarray:
+    """Sum-allreduce built the reference way: reduce-scatter (external
+    function + injected sum reducer) then allgather of the owned block
+    (ref: network.cpp Network::Allreduce decomposition)."""
+    st = _STATE
+    if st is None or st.num_machines == 1:
+        return np.asarray(local).copy()
+    loc = np.ascontiguousarray(local)
+    dt, shape = loc.dtype, loc.shape
+    flat = loc.reshape(-1)
+    n = st.num_machines
+    # pad so every rank owns an equal block of whole elements
+    per = -(-flat.size // n)
+    padded = np.zeros(per * n, dt)
+    padded[:flat.size] = flat
+    bs = per * dt.itemsize
+    starts = (_c_i32 * n)(*[i * bs for i in range(n)])
+    lens = (_c_i32 * n)(*[bs] * n)
+    own = np.zeros(per, dt)
+    reducer = _sum_reducer_for(dt)
+    st.reduce_scatter_fn(
+        padded.ctypes.data_as(ctypes.c_void_p), _c_i32(padded.nbytes),
+        ctypes.c_int(dt.itemsize), starts, lens, ctypes.c_int(n),
+        own.ctypes.data_as(ctypes.c_void_p), _c_i32(own.nbytes),
+        ctypes.pointer(reducer))
+    return allgather(own)[:flat.size].reshape(shape)

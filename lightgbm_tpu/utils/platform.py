@@ -40,7 +40,16 @@ def pin_jax_platforms() -> None:
         # env request must still override
         if current and current != plat \
                 and str(current).split(",")[0] == "cpu":
-            return   # the host already forced CPU; never override that
+            # the host already forced CPU; never override that — but say
+            # so: a silently-dropped env request cost two rounds of
+            # debugging in the other direction
+            if plat.split(",")[0] != "cpu":
+                import sys
+                print(f"[LightGBM-TPU] [Info] JAX_PLATFORMS={plat} "
+                      f"ignored: the process already pinned "
+                      f"jax_platforms={current} (CPU-first wins; see "
+                      f"utils/platform.py)", file=sys.stderr, flush=True)
+            return
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass
