@@ -488,7 +488,13 @@ class Booster:
         return self.num_tree_per_iteration
 
     def __inner_predict_train(self) -> np.ndarray:
-        return np.asarray(self._gbdt.scores, np.float64).reshape(-1)
+        g = self._gbdt
+        if getattr(g, "mp", None) is not None:
+            # multi-process: fobj is rank-local like the reference's
+            # distributed custom objective — this rank's rows only
+            loc = g.mp.local_block(g.scores, axis=1)[:, :g.mp.local_real]
+            return np.asarray(loc, np.float64).reshape(-1)
+        return np.asarray(g.scores, np.float64).reshape(-1)
 
     # ------------------------------------------------------------------
     def eval_train(self, feval=None) -> List:

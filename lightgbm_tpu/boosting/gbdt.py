@@ -298,8 +298,24 @@ class GBDT:
         if mfb is None:
             mfb = np.array([train_data.mappers[j].most_freq_bin
                             for j in train_data.used_features], np.int32)
-        masks = [bins_np[:, k] != mfb[k]
-                 for k in range(train_data.num_features)]
+        if jax.process_count() > 1:
+            # multi-process: bundle layouts must be IDENTICAL on every
+            # rank — conflict masks come from the allgathered binning
+            # sample (the reference also bundles from sampled data,
+            # dataset_loader.cpp FindGroups over sample_indices); the
+            # local rows are then encoded with the shared layout
+            sb = getattr(train_data, "mp_sample_bins", None)
+            if sb is None:
+                log.warning("no shared binning sample retained; skipping "
+                            "EFB for this multi-process run")
+                return
+            masks = [sb[:, k] != mfb[k]
+                     for k in range(train_data.num_features)]
+            n_for_rate = sb.shape[0]
+        else:
+            masks = [bins_np[:, k] != mfb[k]
+                     for k in range(train_data.num_features)]
+            n_for_rate = self.num_data
         nb_all = [int(x) for x in np.asarray(self.meta.num_bin)]
         # reference-parity bundling: tolerated conflicts at the
         # single_val_max_conflict_cnt rate (ref: dataset.cpp:108
@@ -314,7 +330,7 @@ class GBDT:
         # astype(int16) and zero the one-hot); the reference is uncapped
         # because its jagged storage never widens a column
         for cap in (32767, 8 * self.max_bins, 4 * self.max_bins):
-            bundles = find_bundles(masks, self.num_data,
+            bundles = find_bundles(masks, n_for_rate,
                                    max_conflict_rate=1e-4,
                                    max_bundle_bins=cap,
                                    num_bin_per_feat=nb_all)
@@ -356,8 +372,11 @@ class GBDT:
             default_bin=jnp.asarray(mfb_np),
             col_of_feat=jnp.asarray(layout.col_of_feat),
             offset_of_feat=jnp.asarray(layout.offset_of_feat))
-        self.bundle_bins_dev = jnp.asarray(enc_np.astype(
-            np.uint8 if Bc <= 256 else np.uint16))
+        enc_small = enc_np.astype(np.uint8 if Bc <= 256 else np.uint16)
+        # host copy only where the multi-process placement paths read it
+        self.bundle_bins_host = (enc_small if jax.process_count() > 1
+                                 else None)
+        self.bundle_bins_dev = jnp.asarray(enc_small)
         self.bundle_col_bins = int(Bc)
         self.use_bundles = True
 
@@ -509,17 +528,34 @@ class GBDT:
                         "multi-process runs shard rows per rank — using "
                         "data-parallel")
             mode = "data"
-        if mode == "feature" and (self.use_node_masks
-                                  or getattr(self, "use_cegb", False)
-                                  or getattr(self, "n_forced", 0)
-                                  or getattr(self, "use_bundles", False)):
-            log.warning("tree_learner=feature does not compose with "
-                        "interaction/bynode constraints, CEGB, forced "
-                        "splits or EFB; using data-parallel")
+        # feature-parallel composition: the FUSED feature engine keeps
+        # the whole replicated layout (global feature indices), so EFB
+        # and interaction/bynode constraints compose on it; the sliced
+        # XLA feature grower cannot mix local/global indexing — degrade
+        # only the combinations that genuinely force the XLA growers
+        from ..ops.pallas_histogram import HAS_PALLAS as _HP
+        fused_capable = _HP and (str(config.tpu_engine) == "fused"
+                                 or (str(config.tpu_engine) == "auto"
+                                     and self.on_tpu))
+        if mode == "feature" and getattr(self, "use_cegb", False):
+            log.warning("CEGB gain accounting is wired into the depthwise "
+                        "XLA grower, whose feature-parallel column "
+                        "slicing cannot carry the global per-feature "
+                        "cost state; using data-parallel")
             mode = "data"
-        if mode == "voting" and getattr(self, "n_forced", 0):
-            log.warning("forced splits use the leaf-wise grower; "
-                        "voting-parallel is depth-wise — using data-parallel")
+        if mode == "feature" and getattr(self, "n_forced", 0):
+            log.warning("forced splits run on the leaf-wise grower; "
+                        "feature-parallel is depth-wise — using "
+                        "data-parallel")
+            mode = "data"
+        if mode == "feature" and not fused_capable \
+                and (self.use_node_masks
+                     or getattr(self, "use_bundles", False)):
+            log.warning("the sliced XLA feature-parallel grower does not "
+                        "compose with interaction/bynode constraints or "
+                        "EFB (local/global feature indexing); set "
+                        "tpu_engine=fused (replicated layout) or use "
+                        "data-parallel — using data-parallel")
             mode = "data"
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
         axis = FEATURE_AXIS if mode == "feature" else DATA_AXIS
@@ -569,20 +605,32 @@ class GBDT:
         if bool(config.linear_tree):
             log.fatal("linear_tree needs host raw-data access per leaf and "
                       "is not supported with multi-process training")
-        if str(config.boosting) not in ("gbdt", "gbrt"):
-            log.fatal("boosting=%s is not supported with multi-process "
-                      "training yet (host-side per-tree resampling)",
-                      config.boosting)
-        if self.objective is not None and self.objective.is_renew_tree_output:
-            log.fatal("objective %s renews leaf outputs from host row "
-                      "statistics and is not supported with multi-process "
-                      "training yet", self.objective.name)
-        if getattr(self, "use_bundles", False):
-            log.fatal("EFB bundling is derived from rank-local data and "
-                      "is not supported with multi-process training yet "
-                      "(set enable_bundle=false)")
+        # DART/GOSS/RF compose since round 5: drop-set and bagging
+        # streams are seeded identically on every rank (SPMD control
+        # flow), GOSS resampling is rank-local like the reference's
+        # (goss.hpp:103 samples each machine's own rows), and score
+        # replay routes on the row-sharded global matrix
+        # leaf-renewing objectives (L1/quantile/huber/MAPE) compose since
+        # round 5: rank-local percentiles averaged over contributing
+        # workers — the reference's own distributed semantics
+        # (_renew_tree_output_mp; serial_tree_learner.cpp:744-755)
+        if getattr(self.train_data, "prebundled", None) is not None:
+            log.fatal("sparse-built (prebundled) datasets derive their "
+                      "bundle layout from rank-local CSC columns and are "
+                      "not supported with multi-process training; dense "
+                      "EFB (enable_bundle on dense data) composes — its "
+                      "layout comes from the shared binning sample")
+        # the fused engine needs per-device row slices aligned to its
+        # widest kernel tile (engine resolution happens later, so key on
+        # the config request; "auto" resolves to fused only on TPU)
+        from ..ops.pallas_histogram import HAS_PALLAS
+        wants_fused = (str(config.tpu_engine) == "fused"
+                       or (str(config.tpu_engine) == "auto"
+                           and jax.default_backend() == "tpu"
+                           and HAS_PALLAS))
         self.mp = MultiProcLayout(self.mesh, self.axis_name,
-                                  self.train_data.num_data)
+                                  self.train_data.num_data,
+                                  row_align=2048 if wants_fused else 1)
         self.num_data = self.mp.Np
         self.par_rows = self.mp.Np
         self._mp_real_mask = self.mp.real_mask_np()
@@ -608,7 +656,11 @@ class GBDT:
         if self.mp is not None:
             # the one per-rank-DISTINCT operand: rank-local binned rows
             # into their block of the global row-sharded matrix
-            self.bins_par = self.mp.shard_local(bins_np)
+            if getattr(self, "use_bundles", False):
+                self.bundle_bins_par = self.mp.shard_local(
+                    np.asarray(self.bundle_bins_host))
+            else:
+                self.bins_par = self.mp.shard_local(bins_np)
             self._par_placed = True
             return
         if self.parallel_mode in ("data", "voting"):
@@ -654,11 +706,24 @@ class GBDT:
             from ..models.frontier2 import grow_tree_fused
             interp = self.fused_interpret
             use_nm = self.use_node_masks
+            mode = self.parallel_mode
+            top_k = int(self.config.top_k) if mode == "voting" else 0
+            f_oh = self.fused_f_oh
+            n_sh = self.n_shards
 
             def per_shard(bins_T, gh_T, fm_pad, *nm):
+                fsm = None
+                if mode == "feature":
+                    # this shard owns an equal contiguous block of the
+                    # padded one-hot feature axis (replicated layout,
+                    # global indices — merge offset 0)
+                    sid = jax.lax.axis_index(axis)
+                    Fs = (f_oh + n_sh - 1) // n_sh
+                    fi = jnp.arange(f_oh, dtype=jnp.int32)
+                    fsm = (fi >= sid * Fs) & (fi < (sid + 1) * Fs)
                 return grow_tree_fused(
                     bins_T, gh_T, self.fused_meta, fm_pad, params, L,
-                    self.fused_Bp, self.fused_f_oh, num_rows=0,
+                    self.fused_Bp, f_oh, num_rows=0,
                     nch=self.fused_nch, max_depth=md,
                     extra_levels=int(self.config.tpu_extra_levels),
                     has_cat=self.has_cat,
@@ -669,12 +734,21 @@ class GBDT:
                     bundle_col_bins=self.fused_bundle_col_bins,
                     bundle_cfg=self.fused_bundle_cfg,
                     interpret=interp, psum_axis=axis,
-                    mono_mode=getattr(self, "mono_mode", "basic"))
-            in_specs = (P(None, axis), P(None, axis), P()) + \
-                ((P(),) if use_nm else ())
+                    mono_mode=getattr(self, "mono_mode", "basic"),
+                    parallel_mode=mode, top_k=top_k,
+                    feature_shard_mask=fsm)
+            if mode == "feature":
+                # rows replicated on every shard; records merge in-jit,
+                # every shard emits the identical tree and row_leaf
+                in_specs = (P(), P(), P()) + ((P(),) if use_nm else ())
+                out_specs = (P(), P())
+            else:
+                in_specs = (P(None, axis), P(None, axis), P()) + \
+                    ((P(),) if use_nm else ())
+                out_specs = (P(), P(axis))
             return jax.jit(jax.shard_map(
                 per_shard, mesh=self.mesh, in_specs=in_specs,
-                out_specs=(P(), P(axis)), check_vma=False))
+                out_specs=out_specs, check_vma=False))
 
         if kind == "xla_sync":
             mode = self.parallel_mode
@@ -685,7 +759,11 @@ class GBDT:
             use_nm = self.use_node_masks
             use_cegb = self.use_cegb
             ub = getattr(self, "use_bundles", False)
-            n_forced = getattr(self, "n_forced", 0) if mode == "data" else 0
+            # forced splits compose with data- AND voting-parallel since
+            # round 5 (the vote exchange always sums the forced features'
+            # columns); feature-parallel degraded earlier
+            n_forced = (getattr(self, "n_forced", 0)
+                        if mode in ("data", "voting") else 0)
 
             if mode == "feature":
                 n_sh = self.n_shards
@@ -820,13 +898,22 @@ class GBDT:
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
-        if getattr(self, "mp", None) is not None and engine != "xla":
-            log.info("multi-process training runs on the XLA growers")
+        # the fused engine composes with every distribution mode since
+        # round 5 (ref: tree_learner.cpp:17-49 — the reference
+        # instantiates its device learner under data/voting/feature
+        # distribution too); only the frontier-v1 engine lacks a
+        # multi-chip path
+        if getattr(self, "mp", None) is not None \
+                and engine not in ("xla", "fused"):
+            # the mp row layout was aligned for fused only when the
+            # CONFIG requested fused/auto-tpu; a late engine swap to
+            # fused would trip the Rp/Np alignment guard
+            log.info("multi-process training runs on the XLA or fused "
+                     "engines; using xla")
             engine = "xla"
-        if self.parallel_mode in ("voting", "feature") and engine != "xla":
-            # the vote/column-slice exchanges live in the depthwise XLA
-            # grower (ref: voting/feature_parallel_tree_learner.cpp)
-            log.info("tree_learner=%s runs on the depthwise XLA grower",
+        if self.parallel_mode in ("voting", "feature") \
+                and engine not in ("xla", "fused"):
+            log.info("tree_learner=%s runs on the XLA or fused engines",
                      self.parallel_mode)
             engine = "xla"
         if self.parallel_mode == "data" and engine == "frontier":
@@ -893,11 +980,17 @@ class GBDT:
                         "configuration uses intermediate instead")
             self.mono_mode = "intermediate"
         if self.mono_mode in ("intermediate", "advanced") \
-                and self.parallel_mode in ("voting", "feature"):
-            log.warning("the intermediate/advanced monotone recompute is "
-                        "not wired into the voting/feature-parallel "
-                        "exchanges; this configuration enforces the basic "
-                        "mode instead")
+                and self.parallel_mode == "feature" and not self.use_fused:
+            # the sliced XLA feature grower tracks per-leaf bin regions
+            # only for its LOCAL feature slice; cross-leaf adjacency
+            # needs every feature's region. The fused feature engine
+            # (replicated layout) and voting (validity-masked rescans)
+            # compose since round 5.
+            log.warning("the intermediate/advanced monotone recompute "
+                        "needs full per-feature leaf regions, which the "
+                        "sliced feature-parallel grower does not hold; "
+                        "this configuration enforces the basic mode "
+                        "(tpu_engine=fused composes)")
             self.mono_mode = "basic"
         if getattr(self, "use_cegb", False) \
                 and self.grow_policy != "depthwise":
@@ -941,6 +1034,23 @@ class GBDT:
             self._init_frontier(self.train_data)
 
     # ------------------------------------------------------------------
+    def _mp_fused_bins_T(self, local_rows_np: np.ndarray, Fp: int,
+                         Rp: int, bins_per_col: int) -> jax.Array:
+        """Global transposed fused matrix from process-local row blocks
+        (the same rank-blocked layout contract as bins_par,
+        parallel/multiproc.py). mp.S is fused-aligned so Rp == mp.Np."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if Rp != self.mp.Np:
+            log.fatal("fused multi-process row padding mismatch: Rp=%d "
+                      "vs layout Np=%d (mp.S must be 2048-aligned)",
+                      Rp, self.mp.Np)
+        np_dt = np.int8 if bins_per_col <= 128 else np.int16
+        n_cols = local_rows_np.shape[1]
+        loc = np.zeros((Fp, self.mp.block), np_dt)
+        loc[:n_cols, :self.mp.local_real] = local_rows_np.T.astype(np_dt)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(None, self.axis_name)), loc)
+
     def _init_fused(self, train_data: TpuDataset) -> None:
         """int8 transposed bin matrix + f_oh-padded metadata for the fused
         route+histogram level kernel (ops/fused_level.py). With EFB the
@@ -950,19 +1060,27 @@ class GBDT:
         F = train_data.num_features
         F_oh, Bp = feature_layout(F, self.max_bins)
         R = self.num_data
-        # data-parallel shards each need kernel-tile-aligned local rows;
-        # 2048 = the widest shallow-pass tile (default_tile_rows cap), so
-        # shallow levels can actually run at the bigger tile
-        blk = 2048 * (self.n_shards if self.parallel_mode == "data" else 1)
+        # row-sharded modes (data/voting) need kernel-tile-aligned local
+        # rows per shard; 2048 = the widest shallow-pass tile
+        # (default_tile_rows cap), so shallow levels can actually run at
+        # the bigger tile. Multi-process layouts pre-align (mp.S) so
+        # Rp == mp.Np already.
+        blk = 2048 * (self.n_shards
+                      if self.parallel_mode in ("data", "voting") else 1)
         Rp = ((R + blk - 1) // blk) * blk
         if getattr(self, "use_bundles", False):
             n_cols = int(self.bundle_bins_dev.shape[1])
             C_oh, Bc_p = feature_layout(n_cols, self.bundle_col_bins)
             Fp = max(C_oh, 8)
             dtype = jnp.int8 if Bc_p <= 128 else jnp.int16
-            self.fused_bins_T = (
-                jnp.zeros((Fp, Rp), dtype)
-                .at[:n_cols, :R].set(self.bundle_bins_dev.T.astype(dtype)))
+            if self.mp is not None:
+                self.fused_bins_T = self._mp_fused_bins_T(
+                    np.asarray(self.bundle_bins_host), Fp, Rp, Bc_p)
+            else:
+                self.fused_bins_T = (
+                    jnp.zeros((Fp, Rp), dtype)
+                    .at[:n_cols, :R].set(
+                        self.bundle_bins_dev.T.astype(dtype)))
             self.fused_bundle_cols = C_oh
             self.fused_bundle_col_bins = Bc_p
             # decode tables padded to the logical f_oh (padding features:
@@ -989,6 +1107,14 @@ class GBDT:
             self.fused_bundle_cfg = BundleCfg(
                 flat_idx=fi, valid=va, default_bin=db, col_of_feat=cof,
                 offset_of_feat=off)
+        elif self.mp is not None:
+            Fp = max(F_oh, 8)
+            dtype = jnp.int8 if Bp <= 128 else jnp.int16
+            self.fused_bins_T = self._mp_fused_bins_T(
+                np.asarray(self.train_data.bins), Fp, Rp, Bp)
+            self.fused_bundle_cols = 0
+            self.fused_bundle_col_bins = 0
+            self.fused_bundle_cfg = None
         else:
             Fp = max(F_oh, 8)
             # int8 covers bins <= 127; larger max_bin needs int16 (a uint8
@@ -1004,12 +1130,18 @@ class GBDT:
             self.fused_bundle_cols = 0
             self.fused_bundle_col_bins = 0
             self.fused_bundle_cfg = None
-        if self.parallel_mode == "data":
+        if self.parallel_mode in ("data", "voting") and self.mp is None:
             # place the transposed matrix row-sharded once, not per call
             from jax.sharding import NamedSharding, PartitionSpec as P
             self.fused_bins_T = jax.device_put(
                 self.fused_bins_T,
                 NamedSharding(self.mesh, P(None, self.axis_name)))
+        elif self.parallel_mode == "feature":
+            # feature-parallel replicates rows (zero histogram traffic;
+            # per-level record merge instead) — replicate the matrix
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.fused_bins_T = jax.device_put(
+                self.fused_bins_T, NamedSharding(self.mesh, P()))
         # the replicated [R, F] copy served only as the transpose source;
         # release it so HBM holds one binned matrix (the property rebuilds
         # it on the rare rollback/stop-subtract/DART replay paths)
@@ -1194,6 +1326,7 @@ class GBDT:
         if getattr(self, "mp", None) is not None:
             m = mask.astype(np.float32) * self._mp_real_mask
             self.bag_cnt = int(m.sum())
+            self._bag_weight_host = m    # rank-local renewal reads this
             self.bag_weight = self.mp.shard_full(m)
         else:
             self.bag_cnt = int(mask.sum())
@@ -1503,6 +1636,64 @@ class GBDT:
                                             residual[rows], rows)
             ht.leaf_value[leaf] = new_out
 
+    def _mp_in_bag_local(self) -> np.ndarray:
+        """[local_real] bool in-bag mask for THIS rank's rows."""
+        mp = self.mp
+        bwl = getattr(self, "_bag_weight_local", None)
+        if bwl is not None:             # GOSS keeps a rank-local mask
+            return bwl[:mp.local_real] > 0
+        bw = getattr(self, "_bag_weight_host", None)
+        if bw is not None:              # synced-stream bagging: global
+            off = mp.process_index * mp.block
+            return bw[off:off + mp.local_real] > 0
+        return np.ones(mp.local_real, bool)
+
+    def _mp_avg_leaf_renewal(self, ht: HostTree, rl: np.ndarray,
+                             residual: np.ndarray, in_bag: np.ndarray
+                             ) -> None:
+        """Distributed leaf renewal = the AVERAGE of rank-local
+        percentile outputs over the workers that have rows in the leaf —
+        the reference's own distributed semantics (NOT an exact global
+        percentile): serial_tree_learner.cpp:744-755 computes the local
+        RenewTreeOutput then GlobalSum(outputs)/GlobalSum(nonzero).
+        ``rl``/``residual``/``in_bag`` are rank-local [local_real]."""
+        obj = self.objective
+        mp = self.mp
+        off = mp.process_index * mp.block   # global row base: the
+        # objective's weight vector is the allgathered rank-blocked one
+        L = ht.num_leaves
+        outputs = np.zeros(L, np.float64)
+        nonzero = np.zeros(L, np.int64)
+        sel = np.nonzero(in_bag)[0]
+        order = sel[np.argsort(rl[sel], kind="stable")]
+        starts = np.searchsorted(rl[order], np.arange(L + 1))
+        for leaf in range(L):
+            rows = order[starts[leaf]:starts[leaf + 1]]
+            if len(rows) == 0:
+                continue
+            outputs[leaf] = obj.renew_tree_output(
+                ht.leaf_value[leaf], residual[rows], rows + off)
+            nonzero[leaf] = 1
+        from jax.experimental import multihost_utils
+        allg = np.asarray(multihost_utils.process_allgather(
+            np.concatenate([outputs, nonzero.astype(np.float64)])))
+        allg = allg.reshape(mp.process_count, 2, L)
+        tot_out = allg[:, 0, :].sum(axis=0)
+        tot_nz = allg[:, 1, :].sum(axis=0)
+        renewed = np.where(tot_nz > 0, tot_out / np.maximum(tot_nz, 1),
+                           np.asarray(ht.leaf_value[:L], np.float64))
+        ht.leaf_value[:L] = renewed
+
+    def _renew_tree_output_mp(self, ht: HostTree, row_leaf, class_id: int
+                              ) -> None:
+        mp = self.mp
+        rl = mp.local_block(row_leaf)[:mp.local_real]
+        score = mp.local_block(self.scores, axis=1)[class_id,
+                                                    :mp.local_real]
+        label = np.asarray(self.train_data.metadata.label, np.float64)
+        residual = label - np.asarray(score, np.float64)
+        self._mp_avg_leaf_renewal(ht, rl, residual, self._mp_in_bag_local())
+
     # ------------------------------------------------------------------
     def _fit_linear_leaves(self, ht: HostTree, row_leaf: np.ndarray,
                            grad, hess) -> None:
@@ -1586,6 +1777,21 @@ class GBDT:
         dataset is sparse-built)."""
         return getattr(self, "_replay_bundle", None)
 
+    def _train_bins_replay(self):
+        """Bin matrix for score add/subtract replay (rollback, DART
+        drop/normalize): the replicated copy single-process, the
+        row-sharded global matrix under multi-process (per-row routing
+        partitions cleanly over the mesh)."""
+        if getattr(self, "mp", None) is not None:
+            self._place_par_data()
+            if self.bins_par is None:
+                # bundled mp runs place only the bundle matrix; replay
+                # decodes logical bins, so place those on first use
+                self.bins_par = self.mp.shard_local(
+                    np.asarray(self.train_data.bins))
+            return self.bins_par
+        return self.bins_dev
+
     def _valid_bundle(self, vi: int):
         return (self._replay_bundle
                 if self.valid_data[vi].prebundled is not None else None)
@@ -1617,6 +1823,7 @@ class GBDT:
             obj = self.objective
             self._fast_ok_cache = bool(
                 type(self) is GBDT
+                and bool(self.config.tpu_fast_path)
                 and self.use_fused
                 and getattr(self, "mp", None) is None
                 and self.parallel_mode in ("serial", "data")
@@ -2115,13 +2322,19 @@ class GBDT:
             for tid in range(k):
                 init_scores[tid] = self._boost_from_average(tid, True)
             grad, hess = self._get_gradients()
-        else:
-            if getattr(self, "mp", None) is not None:
-                log.fatal("custom objective gradients are rank-local "
-                          "host arrays; not supported with multi-process "
-                          "training yet")
-            grad = jnp.asarray(gradients, jnp.float32).reshape(k, n)
-            hess = jnp.asarray(hessians, jnp.float32).reshape(k, n)
+        elif getattr(self, "mp", None) is not None:
+            # custom gradients are per-ROW data: each rank's fobj returns
+            # [k, local_real] for its own shard (the reference's
+            # distributed custom objective is rank-local the same way);
+            # pad rows carry zero grad/hess and zero bag weight
+            mp = self.mp
+            gl = np.asarray(gradients, np.float32).reshape(
+                k, mp.local_real)
+            hl = np.asarray(hessians, np.float32).reshape(
+                k, mp.local_real)
+            pad = mp.block - mp.local_real
+            grad = mp.shard_local_cols(np.pad(gl, ((0, 0), (0, pad))))
+            hess = mp.shard_local_cols(np.pad(hl, ((0, 0), (0, pad))))
 
         grad, hess = self._bagging(self.iter, grad, hess)
 
@@ -2150,8 +2363,11 @@ class GBDT:
                                             hess[tid])
                 if (self.objective is not None
                         and self.objective.is_renew_tree_output):
-                    row_leaf_np = np.asarray(row_leaf)
-                    self._renew_tree_output(ht, row_leaf_np, tid)
+                    if getattr(self, "mp", None) is not None:
+                        self._renew_tree_output_mp(ht, row_leaf, tid)
+                    else:
+                        row_leaf_np = np.asarray(row_leaf)
+                        self._renew_tree_output(ht, row_leaf_np, tid)
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
                 if bool(self.config.linear_tree) and ht.is_linear \
@@ -2284,11 +2500,10 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
-        """(ref: gbdt.cpp:456 RollbackOneIter)"""
-        if getattr(self, "mp", None) is not None:
-            log.fatal("rollback_one_iter replays trees on the replicated "
-                      "bin matrix; not supported with multi-process "
-                      "training yet")
+        """(ref: gbdt.cpp:456 RollbackOneIter). Multi-process: the score
+        subtraction routes each device tree on the row-sharded global
+        bin matrix (bins_par) — per-row routing partitions cleanly over
+        the mesh, so the same in-jit replay works rank-sharded."""
         self.drain_pending()
         self._epi_carry = None   # score subtraction invalidates the carry
         # _bag_round_cache is RETAINED: entries are keyed by firing
@@ -2302,12 +2517,13 @@ class GBDT:
         # replay but not from reference-style stream semantics.
         if self.iter <= 0:
             return
+        train_bins = self._train_bins_replay()
         k = self.num_tree_per_iteration
         for tid in range(k):
             idx = len(self.models) - k + tid
             dt = self.device_trees[idx]
             self.scores = self._add_tree_to_score(
-                self.scores, self.bins_dev, dt, tid, scale=-1.0,
+                self.scores, train_bins, dt, tid, scale=-1.0,
                 bundle=self._train_bundle())
             for vi in range(len(self.valid_scores)):
                 self.valid_scores[vi] = self._add_tree_to_score(
@@ -2346,6 +2562,10 @@ class GBDT:
         host_score = None
         for m in metrics:
             vals = m.eval_device(score_dev, self.objective)
+            if vals is None and getattr(self, "mp", None) is not None:
+                # distributed host form (per-query ranking metrics:
+                # rank-local sums + allreduce)
+                vals = m.eval_mp(score_dev, self.objective, self.mp)
             if vals is None:
                 if host_score is None:
                     if not getattr(score_dev, "is_fully_addressable", True):
@@ -2611,8 +2831,8 @@ class DART(GBDT):
             for tid in range(k):
                 dt = self.device_trees[i * k + tid]
                 self.scores = self._add_tree_to_score(
-                    self.scores, self.bins_dev, dt, tid, scale=-1.0,
-                    bundle=self._train_bundle())
+                    self.scores, self._train_bins_replay(), dt, tid,
+                    scale=-1.0, bundle=self._train_bundle())
         nd = len(self.drop_index)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + nd)
@@ -2653,7 +2873,7 @@ class DART(GBDT):
                             tid, scale=-1.0 / (nd + 1.0),
                             bundle=self._valid_bundle(vi))
                     self.scores = self._add_tree_to_score(
-                        self.scores, self.bins_dev, dt, tid,
+                        self.scores, self._train_bins_replay(), dt, tid,
                         scale=nd / (nd + 1.0),
                         bundle=self._train_bundle())
                 else:
@@ -2666,8 +2886,8 @@ class DART(GBDT):
                             tid, scale=-(1.0 - factor),
                             bundle=self._valid_bundle(vi))
                     self.scores = self._add_tree_to_score(
-                        self.scores, self.bins_dev, dt, tid, scale=factor,
-                        bundle=self._train_bundle())
+                        self.scores, self._train_bins_replay(), dt, tid,
+                        scale=factor, bundle=self._train_bundle())
                 dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
             if not cfg.uniform_drop:
                 j = i - self.num_init_iteration
@@ -2703,17 +2923,43 @@ class GOSS(GBDT):
         self.is_bagging = False
 
     def _bagging(self, it, grad, hess):
-        """(ref: goss.hpp:103-159 BaggingHelper/Bagging)"""
+        """(ref: goss.hpp:103-159 BaggingHelper/Bagging). Multi-process:
+        sampling is rank-LOCAL over this rank's rows, exactly like the
+        reference's per-machine GOSS (each machine's BaggingHelper runs
+        on its own bag_data_cnt_); thresholds and draws differ per rank
+        by design — they only touch rank-local rows, so the SPMD control
+        flow stays identical."""
         cfg = self.config
+        mp = getattr(self, "mp", None)
         n = self.num_data
         # no subsampling in the first 1/learning_rate iterations
         if it < int(1.0 / cfg.learning_rate):
-            self.bag_weight = jnp.ones((n,), jnp.float32)
-            self.bag_cnt = n
+            self.bag_weight = self._bag_ones()
+            self.bag_cnt = mp.total_real if mp is not None else n
             return grad, hess
         # sum over classes of |g*h| (ref: goss.hpp:108-113 accumulates
         # fabs(g*h) per tree-per-iteration model)
-        g_np = np.asarray(jnp.sum(jnp.abs(grad * hess), axis=0))
+        if mp is not None:
+            n = mp.local_real
+            if n == 0:
+                # a rank can legitimately hold zero rows (query-aligned
+                # shards); it contributes nothing but must keep the SPMD
+                # control flow
+                self._bag_weight_local = np.zeros(mp.block, np.float32)
+                self.bag_weight = mp.shard_local(self._bag_weight_local)
+                from jax.experimental import multihost_utils
+                cnts = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([0], np.int64)))
+                self.bag_cnt = int(cnts.sum())
+                mult_dev = mp.shard_local(
+                    np.ones(mp.block, np.float32))[None, :]
+                return grad * mult_dev, hess * mult_dev
+            g_np = np.asarray(jnp.sum(jnp.abs(
+                mp.local_block(grad, axis=1)
+                * mp.local_block(hess, axis=1)), axis=0))
+            g_np = g_np[:n]
+        else:
+            g_np = np.asarray(jnp.sum(jnp.abs(grad * hess), axis=0))
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         threshold = np.partition(g_np, n - top_k)[n - top_k]
@@ -2731,9 +2977,21 @@ class GOSS(GBDT):
         mask[sampled] = True
         mult = np.ones(n, np.float32)
         mult[sampled] = multiply
-        self.bag_cnt = int(mask.sum())
-        self.bag_weight = jnp.asarray(mask.astype(np.float32))
-        mult_dev = jnp.asarray(mult)[None, :]
+        if mp is not None:
+            pad = mp.block - n
+            maskp = np.pad(mask.astype(np.float32), (0, pad))
+            multp = np.pad(mult, (0, pad), constant_values=1.0)
+            self._bag_weight_local = maskp
+            self.bag_weight = mp.shard_local(maskp)
+            from jax.experimental import multihost_utils
+            cnts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([mask.sum()], np.int64)))
+            self.bag_cnt = int(cnts.sum())
+            mult_dev = mp.shard_local(multp)[None, :]
+        else:
+            self.bag_cnt = int(mask.sum())
+            self.bag_weight = jnp.asarray(mask.astype(np.float32))
+            mult_dev = jnp.asarray(mult)[None, :]
         return grad * mult_dev, hess * mult_dev
 
 
@@ -2759,9 +3017,13 @@ class RF(GBDT):
         # gradients fixed at the init score (ref: rf.hpp:82-100 Boosting)
         self.init_scores = [self._rf_init_score(tid)
                             for tid in range(self.num_tree_per_iteration)]
-        base = jnp.asarray(np.tile(
-            np.asarray(self.init_scores, np.float32)[:, None],
-            (1, self.num_data)))
+        base_np = np.tile(np.asarray(self.init_scores, np.float32)[:, None],
+                          (1, self.num_data))
+        if getattr(self, "mp", None) is not None:
+            from jax.sharding import PartitionSpec as P
+            base = self.mp.shard_full(base_np, P(None, self.axis_name))
+        else:
+            base = jnp.asarray(base_np)
         self._fixed_grad, self._fixed_hess = objective.get_gradients(base)
 
     def _rf_init_score(self, tid):
@@ -2794,7 +3056,11 @@ class RF(GBDT):
                 ht, sf_inner = self._to_host_tree(tree, 1.0)
                 if (self.objective is not None
                         and self.objective.is_renew_tree_output):
-                    self._renew_tree_output_rf(ht, np.asarray(row_leaf), tid)
+                    if getattr(self, "mp", None) is not None:
+                        self._renew_tree_output_rf_mp(ht, row_leaf, tid)
+                    else:
+                        self._renew_tree_output_rf(ht, np.asarray(row_leaf),
+                                                   tid)
                 # bias folded into every tree; the averaged score then
                 # carries it once (ref: rf.hpp:136-138 AddBias)
                 if abs(self.init_scores[tid]) > K_EPSILON:
@@ -2837,9 +3103,26 @@ class RF(GBDT):
                 ht.leaf_value[leaf] = self.objective.renew_tree_output(
                     ht.leaf_value[leaf], residual[rows], rows)
 
+    def _renew_tree_output_rf_mp(self, ht, row_leaf, tid):
+        mp = self.mp
+        rl = mp.local_block(row_leaf)[:mp.local_real]
+        label = np.asarray(self.train_data.metadata.label, np.float64)
+        residual = label - self.init_scores[tid]
+        self._mp_avg_leaf_renewal(ht, rl, residual, self._mp_in_bag_local())
+
     def eval_metrics(self):
         """Metrics see the AVERAGED score in RF mode."""
         it = max(1, self.num_iterations_trained)
+        if getattr(self, "mp", None) is not None:
+            # sharded scores cannot be pulled to host; divide on device
+            # and ride the parent's device-form eval
+            saved, saved_v = self.scores, list(self.valid_scores)
+            self.scores = self.scores / it
+            self.valid_scores = [v / it for v in saved_v]
+            try:
+                return super().eval_metrics()
+            finally:
+                self.scores, self.valid_scores = saved, saved_v
         out = []
         if self.training_metrics:
             score = np.asarray(self.scores, np.float64) / it

@@ -251,6 +251,11 @@ _PARAMS: List[_Param] = [
        desc="bin capacity per EFB bundle column for sparse-built "
             "datasets (columns fill toward this cap, bounding the "
             "uniform-width padding of the fused kernel layout)"),
+    _p("tpu_fast_path", bool, True,
+       desc="allow the pipelined fast path (device trees drained in "
+            "batches); off = synchronous per-iteration host bookkeeping "
+            "— bit-comparable across engines/modes, used by debugging "
+            "and A/B tests"),
     _p("tpu_fused_epilogue", bool, True,
        desc="fuse final-level routing + score update + gradients + next "
             "root histogram into one kernel pass on the pipelined fast "

@@ -260,6 +260,17 @@ class TpuDataset:
                        forced_bounds=forced_bounds.get(j))
             self.mappers.append(m)
 
+        import jax as _jax
+        if _jax.process_count() > 1:
+            # retained (BINNED, 2 B/elem) for EFB: bundle layouts must be
+            # IDENTICAL on every rank, so conflict masks come from this
+            # shared sample (the reference also bundles from sampled
+            # data, dataset_loader.cpp FindGroups over sample_indices)
+            used = [j for j in range(f) if not self.mappers[j].is_trivial]
+            if used:
+                self.mp_sample_bins = np.stack(
+                    [self.mappers[j].value_to_bin(sample[:, j])
+                     for j in used], axis=1).astype(np.uint16)
         self.used_features = [j for j in range(f) if not self.mappers[j].is_trivial]
         if not self.used_features:
             # the reference keeps going and trains constant trees

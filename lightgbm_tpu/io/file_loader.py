@@ -85,10 +85,32 @@ def load_text_file(path: str, label_column=None, rank: int = 0,
             y = data[:, li].copy()
             X = np.delete(data, li, axis=1)
 
-    # rank-sharded slice (contiguous, reference pre_partition-style)
+    # rank-sharded slice (contiguous, reference pre_partition-style).
+    # Ranking data: slice boundaries ALIGN to query boundaries so every
+    # rank holds whole queries (ref: metadata.cpp:141 CheckOrPartition —
+    # "Data partition error, data didn't match queries" is a hard error
+    # there; here the partition is computed query-aligned up front)
     if num_machines > 1:
-        per = (n_rows + num_machines - 1) // num_machines
-        sl = slice(rank * per, min(n_rows, (rank + 1) * per))
+        qside = next((path + sfx for sfx in (".query", ".group")
+                      if os.path.exists(path + sfx)), None)
+        if qside is not None:
+            sizes = np.loadtxt(qside, dtype=np.float64,
+                               ndmin=1).astype(np.int64)
+            ends = np.cumsum(sizes)
+            if int(ends[-1]) != n_rows:
+                raise ValueError(
+                    f"query sizes sum to {int(ends[-1])} but the file has "
+                    f"{n_rows} rows")
+            cuts = [0]
+            for r in range(1, num_machines):
+                target = (r * n_rows) // num_machines
+                qi = int(np.searchsorted(ends, target, side="left"))
+                cuts.append(int(ends[min(qi, len(ends) - 1)]))
+            cuts.append(n_rows)
+            sl = slice(cuts[rank], cuts[rank + 1])
+        else:
+            per = (n_rows + num_machines - 1) // num_machines
+            sl = slice(rank * per, min(n_rows, (rank + 1) * per))
         X = X[sl]
         y = None if y is None else y[sl]
     else:

@@ -66,6 +66,9 @@ class Metric:
         self.label = metadata.label
         self.weight = metadata.weight
         self.query_boundaries = metadata.query_boundaries
+        # multi-process ranking: compacted-row -> padded-global-row map
+        # (parallel/multiproc.GlobalMetadata)
+        self.query_row_map = getattr(metadata, "query_row_map", None)
         if self.weight is not None:
             self.sum_weights = float(np.sum(self.weight))
         else:
@@ -75,6 +78,52 @@ class Metric:
 
     def eval(self, score: np.ndarray, objective) -> List[float]:
         raise NotImplementedError
+
+    def _query_rows(self, q):
+        """Global row indices of compacted query q (identity without a
+        row map)."""
+        qb = self.query_boundaries
+        rows = np.arange(qb[q], qb[q + 1])
+        return rows if self.query_row_map is None \
+            else self.query_row_map[rows]
+
+    def _eval_mp_ranked(self, score_dev, mp, accum_fn, width):
+        """Distributed per-query metric: each rank accumulates over its
+        LOCAL whole queries, sums + query counts allreduce — the
+        reference's distributed metric contract (its per-query sums ride
+        Network::GlobalSum)."""
+        qb = self.query_boundaries
+        off = mp.process_index * mp.block
+        loc = mp.local_block(score_dev, axis=1)
+        sums = np.zeros(width, np.float64)
+        cnt = 0
+        for q in range(len(qb) - 1):
+            rows_g = self._query_rows(q)
+            if rows_g.size == 0:
+                # zero-size query: owned by rank 0 so it is counted
+                # exactly once (the single-process eval tolerates them)
+                if mp.process_index == 0:
+                    accum_fn(q, np.zeros(0), np.zeros(0), sums)
+                    cnt += 1
+                continue
+            if not (off <= rows_g[0] < off + mp.block):
+                continue
+            lab = np.asarray(self.label)[rows_g]
+            sc = np.asarray(loc[0][rows_g - off], np.float64)
+            accum_fn(q, lab, sc, sums)
+            cnt += 1
+        from jax.experimental import multihost_utils
+        allg = np.asarray(multihost_utils.process_allgather(
+            np.concatenate([sums, [float(cnt)]])))
+        allg = allg.reshape(mp.process_count, width + 1)
+        tot = allg[:, :width].sum(axis=0)
+        n_q = allg[:, width].sum()
+        return list(tot / max(1.0, n_q))
+
+    def eval_mp(self, score_dev, objective, mp):
+        """Distributed (multi-process) evaluation, or None when this
+        metric has no distributed form."""
+        return None
 
     # -- on-device evaluation ------------------------------------------
     # The pipelined driver evaluates per iteration; pulling the full
@@ -548,7 +597,7 @@ class NDCGMetric(Metric):
         # per-query ideal DCGs
         self.inv_max_dcgs = np.zeros((self.num_queries, len(self.eval_at)))
         for q in range(self.num_queries):
-            lab = self.label[qb[q]:qb[q + 1]]
+            lab = np.asarray(self.label)[self._query_rows(q)]
             for ki, k in enumerate(self.eval_at):
                 m = dcg.max_dcg_at_k(k, lab, self.label_gain)
                 self.inv_max_dcgs[q, ki] = 1.0 / m if m > 0 else -1.0
@@ -567,6 +616,20 @@ class NDCGMetric(Metric):
                     d = dcg.dcg_at_k([k], lab, sc, self.label_gain)[0]
                     result[ki] += d * self.inv_max_dcgs[q, ki]
         return list(result / self.num_queries)
+
+    def eval_mp(self, score_dev, objective, mp):
+        if self.query_row_map is None:
+            return None
+
+        def acc(q, lab, sc, sums):
+            for ki, k in enumerate(self.eval_at):
+                if self.inv_max_dcgs[q, ki] <= 0:
+                    sums[ki] += 1.0
+                else:
+                    d = dcg.dcg_at_k([k], lab, sc, self.label_gain)[0]
+                    sums[ki] += d * self.inv_max_dcgs[q, ki]
+        return self._eval_mp_ranked(score_dev, mp, acc,
+                                    len(self.eval_at))
 
 
 class MapMetric(Metric):

