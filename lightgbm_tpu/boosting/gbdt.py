@@ -603,8 +603,12 @@ class GBDT:
         parallel/multiproc.py for the layout contract)."""
         from ..parallel.multiproc import MultiProcLayout
         if bool(config.linear_tree):
-            log.fatal("linear_tree needs host raw-data access per leaf and "
-                      "is not supported with multi-process training")
+            # REFERENCE PARITY: the reference also refuses this —
+            # "Linear tree learner must be serial" (config.cpp:348
+            # forces tree_learner=serial + device=cpu under linear_tree)
+            log.fatal("linear_tree is serial-only (the reference forces "
+                      "tree_learner=serial for linear trees too); not "
+                      "supported with multi-process training")
         # DART/GOSS/RF compose since round 5: drop-set and bagging
         # streams are seeded identically on every rank (SPMD control
         # flow), GOSS resampling is rank-local like the reference's
