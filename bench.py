@@ -35,6 +35,29 @@ import numpy as np
 _RESULT = {"metric": "higgs_sec_per_iter_10.5M_rows", "value": None,
            "unit": "s", "vs_baseline": None, "probe_tfs": None}
 
+# Failure trail: which phases completed + the timer/telemetry snapshot
+# collected so far, attached to the record as "tail" whenever a leg dies
+# (a `tunnel_stuck_backend_init` must say where the time went, not just
+# that it went — ISSUE 3 satellite).
+_TAIL = {"phases": []}
+_T0 = time.time()
+
+
+def _phase(name: str):
+    _TAIL["phases"].append({"phase": name, "t": round(time.time() - _T0, 3)})
+    print(f"bench phase: {name}", file=sys.stderr)
+
+
+def _attach_tail():
+    try:
+        from lightgbm_tpu.utils.timer import global_timer
+        _TAIL["timer"] = {name: {"total": round(st.total, 4),
+                                 "count": st.count}
+                          for name, st in global_timer.stats().items()}
+    except Exception as e:  # the tail must never kill the record
+        _TAIL["timer_error"] = f"{type(e).__name__}: {e}"[:200]
+    _RESULT["tail"] = _TAIL
+
 
 def _emit():
     print(json.dumps(_RESULT), flush=True)
@@ -42,6 +65,7 @@ def _emit():
 
 def _die_with_record(reason: str):
     _RESULT.setdefault("error", reason)
+    _attach_tail()
     _emit()
     os._exit(0)
 
@@ -239,6 +263,11 @@ def _quality_leg(engine: str, iters: int = 500) -> dict:
 
 def main() -> None:
     _install_guards()
+    # the TIMETAG timer collects section times for the failure tail (its
+    # sections carry no sync points, so the pipelined hot loop stays hot)
+    from lightgbm_tpu.utils.timer import global_timer
+    global_timer.enable()
+    _phase("start")
 
     # chip-health probe FIRST, in a bounded subprocess: the tunnel's
     # delivered throughput swings >10x over hours and its failure mode is
@@ -247,6 +276,7 @@ def main() -> None:
     probe, probe_err = _probe_chip()
     if probe is None:
         print(f"chip probe failed: {probe_err}", file=sys.stderr)
+        _phase(f"probe_failed:{probe_err[:60]}")
         if "tunnel_stuck" in probe_err:
             # backend init hangs: the perf leg would hang forever too —
             # emit the record and stop
@@ -258,6 +288,7 @@ def main() -> None:
         tfs = float(probe.get("probe_tfs", 0.0))
         _RESULT["probe_tfs"] = tfs
         _RESULT["platform"] = probe.get("platform")
+        _phase(f"probe_ok:{tfs:.1f}tfs")
         print(f"chip probe: {tfs:.1f} TF/s (chained bf16 4096^3 matmul; "
               f"v5e spec 197)", file=sys.stderr)
 
@@ -285,9 +316,12 @@ def main() -> None:
             try:
                 sec_per_iter = _run(engine, X, y, n_iters)
                 print(f"bench engine: {engine}", file=sys.stderr)
+                _phase(f"perf_{engine}_ok")
                 break
             except Exception as e:  # degrade, don't zero the round
                 msg = str(e)
+                _phase(f"perf_{engine}_attempt{attempt}_failed:"
+                       f"{type(e).__name__}")
                 print(f"bench engine {engine} attempt {attempt} failed: "
                       f"{type(e).__name__}: {msg[:500]}", file=sys.stderr)
                 transient = ("remote_compile" in msg or "INTERNAL" in msg
@@ -327,10 +361,13 @@ def main() -> None:
             _RESULT["quality_iters"] = q_iters
             try:
                 _RESULT.update(_quality_leg(engine, iters=q_iters))
+                _phase("quality_ok")
             except Exception as e:
                 print(f"quality leg failed: {type(e).__name__}: "
                       f"{str(e)[:300]}", file=sys.stderr)
+                _phase(f"quality_failed:{type(e).__name__}")
                 _RESULT["quality_error"] = f"{type(e).__name__}"
+                _attach_tail()   # leave the where-did-the-time-go trail
         _emit()   # merged record; last stdout line wins
 
 

@@ -8,7 +8,7 @@ socket/MPI network layer.
 """
 from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, record_telemetry, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
@@ -29,6 +29,6 @@ __all__ = [
     "train", "cv",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException", "register_logger",
-    "LightGBMError",
+    "record_telemetry", "reset_parameter", "EarlyStopException",
+    "register_logger", "LightGBMError",
 ] + _PLOT

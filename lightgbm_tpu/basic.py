@@ -466,6 +466,25 @@ class Booster:
         self._model_version += 1
         return self
 
+    # ------------------------------------------------------------------
+    def telemetry(self) -> Dict[str, Any]:
+        """Snapshot of the training telemetry registry (obs/): counters,
+        gauges, per-section timing distributions and the recent
+        structured-event ring. Empty dict for model-file boosters (no
+        live driver); {"enabled": False, ...} shell when telemetry was
+        never enabled (enable it with the ``telemetry_out`` param or the
+        ``record_telemetry`` callback). See docs/Observability.md."""
+        if self._gbdt is None:
+            return {}
+        self._gbdt.drain_pending()
+        return self._gbdt.telemetry.snapshot()
+
+    def _finalize_telemetry(self) -> None:
+        """End-of-training telemetry epilogue (engine.train calls this):
+        profiler stop + summary event + JSONL flush."""
+        if self._gbdt is not None:
+            self._gbdt.finalize_telemetry()
+
     def _drain(self) -> None:
         """Materialise any device trees still queued by the training fast
         path before reading the host model list."""

@@ -11,6 +11,8 @@ device.  Semantics follow gbdt.cpp:371 TrainOneIter:
 """
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -21,13 +23,47 @@ from ..config import Config
 from ..dataset import TpuDataset
 from ..models.learner import FeatureMeta, grow_tree_depthwise, grow_tree_leafwise
 from ..models.tree import HostTree, TreeArrays
+from ..obs import Telemetry, device_memory_stats
 from ..ops.predict import add_tree_score
 from ..ops.split import SplitParams, calculate_leaf_output
 from ..utils import log
+from ..parallel.mesh import shard_map as _shard_map
 from ..utils.timer import global_timer as timer
 from ..utils import random as ref_random
 
 K_EPSILON = 1e-15
+
+
+class _SecHandle:
+    """Late-bound sync target for a timed section: the arrays to block
+    on are produced INSIDE the section body (``with self._sec(..) as s:
+    ...; s.sync(tree)``), so the handle carries them to section exit —
+    the honest-attribution idiom timer.section(sync=...) can't express
+    for values that don't exist yet."""
+
+    __slots__ = ("_sync",)
+
+    def __init__(self):
+        self._sync = None
+
+    def sync(self, arrays) -> None:
+        self._sync = arrays
+
+
+class _NullSecHandle:
+    """Disabled-path handle: sync() must NOT store its argument — a
+    module-level global retaining the last score matrix would pin its
+    device buffer for the process lifetime."""
+
+    __slots__ = ()
+
+    def sync(self, arrays) -> None:
+        pass
+
+
+# shared no-op handle: zero per-section allocation when telemetry and
+# the TIMETAG timer are both off
+_NULL_SEC = _NullSecHandle()
 
 
 def feature_meta_from_dataset(ds: TpuDataset) -> FeatureMeta:
@@ -132,6 +168,15 @@ class GBDT:
         self.n_shards = 1
         self.axis_name = None
         self._par_fns: Dict[str, object] = {}
+        # telemetry registry (obs/): disabled by default — every record
+        # call is a single attribute check until telemetry_out or
+        # record_telemetry enables it
+        self.telemetry = Telemetry()
+        self._prof_dir = ""
+        self._prof_start = 0
+        self._prof_n = -1
+        self._prof_active = False
+        self._prof_done = False
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TpuDataset, objective,
@@ -139,6 +184,7 @@ class GBDT:
         self.config = config
         self.train_data = train_data
         self.objective = objective
+        self._setup_telemetry(config)
         self.training_metrics = list(training_metrics)
         self.num_data = train_data.num_data
         self.num_tree_per_iteration = (objective.num_model_per_iteration
@@ -247,6 +293,114 @@ class GBDT:
         return self._bins_dev
 
     # ------------------------------------------------------------------
+    def _setup_telemetry(self, config: Config) -> None:
+        """Telemetry registry + profiler window from the config (re-run
+        by reset_config so reset_parameter can turn either on). Runs
+        FIRST in init so mode/engine degradation events route through
+        the registry."""
+        tel = self.telemetry
+        out = str(getattr(config, "telemetry_out", "") or "")
+        if out:
+            # enable() attaches the sink even when the registry is
+            # already on sink-less (record_telemetry first, then
+            # reset_parameter(telemetry_out=...) must still get a file)
+            had_sink = tel._sink is not None
+            tel.enable(sink_path=out)
+            if not had_sink:
+                tel.event("telemetry_enabled", sink=out)
+        self._prof_dir = str(getattr(config, "profile_dir", "") or "")
+        self._prof_start = max(
+            0, int(getattr(config, "profile_start_iteration", 0)))
+        self._prof_n = int(getattr(config, "profile_num_iterations", -1))
+
+    @contextlib.contextmanager
+    def _sec(self, name: str):
+        """Dual-sink timed section: one measurement feeds both the
+        TIMETAG global timer (as GBDT::<name>) and the telemetry
+        registry's per-iteration record. Yields a handle whose
+        ``sync(arrays)`` blocks before the section closes, attributing
+        asynchronous device work honestly (the timer.section(sync=...)
+        idiom, late-bound). No-op when both sinks are off."""
+        tel = self.telemetry
+        timing = timer.enabled
+        if not (tel.enabled or timing):
+            yield _NULL_SEC
+            return
+        h = _SecHandle()
+        t0 = time.perf_counter()
+        try:
+            yield h
+        finally:
+            if h._sync is not None:
+                jax.block_until_ready(h._sync)
+            dt = time.perf_counter() - t0
+            if timing:
+                timer.add("GBDT::" + name, dt)
+            if tel.enabled:
+                tel.section(name, dt)
+
+    def _profiler_step(self) -> None:
+        """Open/close the jax.profiler trace window at iteration edges
+        (profile_dir + profile_start_iteration + profile_num_iterations:
+        a TensorBoard/Perfetto trace of iterations K..K+n is one config
+        key away)."""
+        if self._prof_done or not self._prof_dir:
+            return
+        it = self.iter
+        if not self._prof_active:
+            if it >= self._prof_start:
+                try:
+                    jax.block_until_ready(self.scores)
+                    jax.profiler.start_trace(self._prof_dir)
+                except Exception as e:
+                    log.warning("profiler trace failed to start: %s", e)
+                    self._prof_done = True
+                    return
+                self._prof_active = True
+                self.telemetry.event("profiler_trace_start", iteration=it,
+                                     log_dir=self._prof_dir)
+        elif 0 <= self._prof_n <= it - self._prof_start:
+            self._profiler_stop()
+
+    def _profiler_stop(self) -> None:
+        if not getattr(self, "_prof_active", False):
+            return
+        try:
+            jax.block_until_ready(self.scores)
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("profiler trace failed to stop: %s", e)
+        self._prof_active = False
+        self._prof_done = True
+        self.telemetry.event("profiler_trace_stop", iteration=self.iter,
+                             log_dir=self._prof_dir)
+
+    def finalize_telemetry(self) -> None:
+        """End-of-training hook: stop an open profiler trace, emit the
+        summary event (per-rank counters aggregated at rank 0 under
+        multi-process — SPMD: every rank calls this at the same point),
+        flush the JSONL sink."""
+        self._profiler_stop()
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        self.drain_pending()
+        snap = tel.snapshot()
+        if getattr(self, "mp", None) is not None:
+            from ..obs import allgather_json
+            per_rank = allgather_json({"rank": snap["rank"],
+                                       "counters": snap["counters"]})
+            if tel.rank == 0:
+                tel.event("summary", iteration=self.iter,
+                          counters=snap["counters"],
+                          timings=snap["timings"], ranks=per_rank)
+        else:
+            tel.event("summary", iteration=self.iter,
+                      counters=snap["counters"],
+                      timings=snap["timings"])
+        tel.flush()
+
+    # ------------------------------------------------------------------
     def _setup_bundles(self, config: Config, train_data) -> None:
         """Exclusive feature bundling for the fused and depthwise growers
         (ref: src/io/dataset.cpp FindGroups/FastFeatureBundling). On by
@@ -308,6 +462,7 @@ class GBDT:
             if sb is None:
                 log.warning("no shared binning sample retained; skipping "
                             "EFB for this multi-process run")
+                self.telemetry.degrade("efb_no_shared_sample")
                 return
             masks = [sb[:, k] != mfb[k]
                      for k in range(train_data.num_features)]
@@ -346,6 +501,8 @@ class GBDT:
                                     np.asarray(mfb, np.int32))
         log.info("EFB: %d features bundled into %d columns",
                  train_data.num_features, layout.num_columns)
+        self.telemetry.event("efb", features=train_data.num_features,
+                             columns=layout.num_columns)
 
     def _install_bundle_layout(self, train_data, layout, enc_np,
                                mfb_np) -> None:
@@ -514,12 +671,15 @@ class GBDT:
                 "tree_learner=%s requested but only one device is visible; "
                 "training serially (multi-chip needs a TPU slice or "
                 "XLA_FLAGS=--xla_force_host_platform_device_count)", mode)
+            self.telemetry.degrade("parallel_single_device",
+                                   requested=mode, to="serial")
             return
         if getattr(self, "use_cegb_lazy", False):
             log.warning("cegb_penalty_feature_lazy keeps a per-(row, "
                         "feature) bitmap on one device and is not wired "
                         "into the distributed growers; dropping the lazy "
                         "penalties for this parallel run")
+            self.telemetry.degrade("cegb_lazy_not_distributed")
             self.use_cegb_lazy = False
         if jax.process_count() > 1 and mode == "feature":
             # feature-parallel replicates rows on every shard; multi-
@@ -527,6 +687,8 @@ class GBDT:
             log.warning("tree_learner=feature needs row-replicated data; "
                         "multi-process runs shard rows per rank — using "
                         "data-parallel")
+            self.telemetry.degrade("feature_parallel_multiproc_rows",
+                                   requested="feature", to="data")
             mode = "data"
         # feature-parallel composition: the FUSED feature engine keeps
         # the whole replicated layout (global feature indices), so EFB
@@ -542,11 +704,15 @@ class GBDT:
                         "XLA grower, whose feature-parallel column "
                         "slicing cannot carry the global per-feature "
                         "cost state; using data-parallel")
+            self.telemetry.degrade("feature_parallel_cegb",
+                                   requested="feature", to="data")
             mode = "data"
         if mode == "feature" and getattr(self, "n_forced", 0):
             log.warning("forced splits run on the leaf-wise grower; "
                         "feature-parallel is depth-wise — using "
                         "data-parallel")
+            self.telemetry.degrade("feature_parallel_forced_splits",
+                                   requested="feature", to="data")
             mode = "data"
         if mode == "feature" and not fused_capable \
                 and (self.use_node_masks
@@ -556,6 +722,8 @@ class GBDT:
                         "EFB (local/global feature indexing); set "
                         "tpu_engine=fused (replicated layout) or use "
                         "data-parallel — using data-parallel")
+            self.telemetry.degrade("feature_parallel_xla_constraints",
+                                   requested="feature", to="data")
             mode = "data"
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
         axis = FEATURE_AXIS if mode == "feature" else DATA_AXIS
@@ -634,7 +802,8 @@ class GBDT:
                            and HAS_PALLAS))
         self.mp = MultiProcLayout(self.mesh, self.axis_name,
                                   self.train_data.num_data,
-                                  row_align=2048 if wants_fused else 1)
+                                  row_align=2048 if wants_fused else 1,
+                                  telemetry=self.telemetry)
         self.num_data = self.mp.Np
         self.par_rows = self.mp.Np
         self._mp_real_mask = self.mp.real_mask_np()
@@ -750,7 +919,7 @@ class GBDT:
                 in_specs = (P(None, axis), P(None, axis), P()) + \
                     ((P(),) if use_nm else ())
                 out_specs = (P(), P(axis))
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 per_shard, mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs, check_vma=False))
 
@@ -794,7 +963,7 @@ class GBDT:
                         route_bins=bins_full, route_meta=self.par_meta,
                         feature_offset=f0,
                         use_mono_bounds=self.use_mono_bounds)
-                return jax.jit(jax.shard_map(
+                return jax.jit(_shard_map(
                     per_shard, mesh=self.mesh, in_specs=(P(), P(), P()),
                     out_specs=(P(), P()), check_vma=False))
 
@@ -838,7 +1007,7 @@ class GBDT:
             in_specs = (P(axis, None), P(axis, None), P()) \
                 + ((P(),) if use_nm else ()) \
                 + ((P(),) if use_cegb else ())
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 per_shard, mesh=self.mesh, in_specs=in_specs,
                 out_specs=(P(), P(axis)), check_vma=False))
         raise KeyError(kind)
@@ -914,15 +1083,22 @@ class GBDT:
             # fused would trip the Rp/Np alignment guard
             log.info("multi-process training runs on the XLA or fused "
                      "engines; using xla")
+            self.telemetry.degrade("engine_multiproc_needs_xla_or_fused",
+                                   requested=config.tpu_engine, to="xla")
             engine = "xla"
         if self.parallel_mode in ("voting", "feature") \
                 and engine not in ("xla", "fused"):
             log.info("tree_learner=%s runs on the XLA or fused engines",
                      self.parallel_mode)
+            self.telemetry.degrade("engine_parallel_needs_xla_or_fused",
+                                   requested=config.tpu_engine, to="xla",
+                                   mode=self.parallel_mode)
             engine = "xla"
         if self.parallel_mode == "data" and engine == "frontier":
             log.info("the frontier-v1 engine has no multi-chip path; "
                      "using the fused engine")
+            self.telemetry.degrade("frontier_no_multichip",
+                                   requested="frontier", to="fused")
             engine = "fused"
         # intermediate/advanced monotone modes need the stale-leaf
         # recompute, implemented on the leaf-wise grower (the reference
@@ -938,16 +1114,22 @@ class GBDT:
                 self.mono_mode = method
         if getattr(self, "n_forced", 0) > 0 and engine != "xla":
             log.info("forced splits use the leaf-wise XLA engine")
+            self.telemetry.degrade("forced_splits_need_xla",
+                                   requested=engine, to="xla")
             engine = "xla"
         if getattr(self, "use_bundles", False) and engine == "frontier":
             log.info("feature bundling is not wired into the frontier-v1 "
                      "engine; using the fused engine")
+            self.telemetry.degrade("frontier_no_bundling",
+                                   requested="frontier", to="fused")
             engine = "fused"
         if getattr(self, "use_cegb", False) and engine != "xla":
             # CEGB gain deltas are wired into the depthwise XLA grower;
             # must override BEFORE the engine flags are derived
             log.info("cost-effective gradient boosting uses the "
                      "depthwise XLA engine")
+            self.telemetry.degrade("cegb_needs_xla", requested=engine,
+                                   to="xla")
             engine = "xla"
         self.use_fused = engine == "fused" and HAS_PALLAS
         self.fused_interpret = self.use_fused and not self.on_tpu
@@ -961,6 +1143,8 @@ class GBDT:
             log.warning("tpu_engine=frontier supports neither categorical "
                         "features, monotone bounds, nor interaction/bynode "
                         "constraints; using the fused engine")
+            self.telemetry.degrade("frontier_missing_features",
+                                   requested="frontier", to="fused")
             self.use_frontier = False
             self.use_fused = True
             self.fused_interpret = not self.on_tpu
@@ -977,11 +1161,15 @@ class GBDT:
             # stays on the depthwise column-slice exchange
             log.warning("tree_learner=feature is implemented on the "
                         "depthwise grower; switching grow_policy")
+            self.telemetry.degrade("feature_parallel_needs_depthwise",
+                                   to="depthwise")
             self.grow_policy = "depthwise"
         if self.mono_mode == "advanced" and self.grow_policy != "leafwise":
             log.warning("monotone_constraints_method=advanced (segment "
                         "bound planes) runs on the leaf-wise grower; this "
                         "configuration uses intermediate instead")
+            self.telemetry.degrade("mono_advanced_needs_leafwise",
+                                   to="intermediate")
             self.mono_mode = "intermediate"
         if self.mono_mode in ("intermediate", "advanced") \
                 and self.parallel_mode == "feature" and not self.use_fused:
@@ -995,11 +1183,14 @@ class GBDT:
                         "sliced feature-parallel grower does not hold; "
                         "this configuration enforces the basic mode "
                         "(tpu_engine=fused composes)")
+            self.telemetry.degrade("mono_inter_needs_full_regions",
+                                   to="basic")
             self.mono_mode = "basic"
         if getattr(self, "use_cegb", False) \
                 and self.grow_policy != "depthwise":
             log.warning("CEGB is implemented on the depthwise grower; "
                         "switching grow_policy")
+            self.telemetry.degrade("cegb_needs_depthwise", to="depthwise")
             self.grow_policy = "depthwise"
         if getattr(self, "use_bundles", False) \
                 and getattr(self, "n_forced", 0) > 0:
@@ -1009,16 +1200,20 @@ class GBDT:
                 log.fatal("forced splits are not supported on sparse-"
                           "built (prebundled) datasets")
             log.warning("forced splits disable feature bundling")
+            self.telemetry.degrade("forced_splits_disable_efb")
             self.use_bundles = False
         if getattr(self, "n_forced", 0) > 0 \
                 and self.grow_policy != "leafwise":
             log.warning("forced splits are implemented on the leaf-wise "
                         "grower; switching grow_policy")
+            self.telemetry.degrade("forced_splits_need_leafwise",
+                                   to="leafwise")
             self.grow_policy = "leafwise"
         if getattr(self, "n_forced", 0) > 0 \
                 and getattr(self, "use_cegb", False):
             log.warning("CEGB penalties are not applied when forced splits "
                         "are enabled (leaf-wise grower); disabling CEGB")
+            self.telemetry.degrade("forced_splits_disable_cegb")
             self.use_cegb = False
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
@@ -1823,6 +2018,13 @@ class GBDT:
         folding. Valid sets stay on the fast path since round 3: their
         score updates run in-jit from the device TreeArrays
         (_update_valid_from_trees) and eval pulls scalars, not matrices."""
+        if self.telemetry.enabled:
+            # telemetry attributes per-iteration sections by blocking on
+            # each phase — only the synchronous driver can do that
+            # honestly (same reason the reference's TIMETAG is sync);
+            # checked outside the cache so a callback can enable
+            # telemetry mid-training
+            return False
         if self._fast_ok_cache is None:
             obj = self.objective
             self._fast_ok_cache = bool(
@@ -1942,7 +2144,7 @@ class GBDT:
                                      tree.leaf_value * shrink,
                                      interpret=interp)[0]
                 return tree, delta
-            grow_one_sharded = jax.shard_map(
+            grow_one_sharded = _shard_map(
                 grow_one, mesh=self.mesh,
                 in_specs=(P(None, axis), P(None, axis), P()),
                 out_specs=(P(), P(axis)), check_vma=False)
@@ -2305,6 +2507,7 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration (ref: gbdt.cpp:371 TrainOneIter).
         Returns True if training should stop."""
+        self._profiler_step()
         if (gradients is None and hessians is None
                 and not self._stopped_early and self._fast_path_ok()):
             return self._train_one_iter_fast()
@@ -2317,121 +2520,152 @@ class GBDT:
     def _sync_iter_body(self, gradients, hessians) -> bool:
         self._epi_carry = None   # sync iterations mutate scores directly
         k, n = self.num_tree_per_iteration, self.num_data
+        tel = self.telemetry
+        it = self.iter
+        tel.begin_iteration(it)
         init_scores = [0.0] * k
-        if gradients is None or hessians is None:
-            if self.objective is None:
-                log.fatal("Cannot train without an objective: pass a "
-                          "built-in objective or supply gradients via "
-                          "Booster.update(fobj=...)")
-            for tid in range(k):
-                init_scores[tid] = self._boost_from_average(tid, True)
-            grad, hess = self._get_gradients()
-        elif getattr(self, "mp", None) is not None:
-            # custom gradients are per-ROW data: each rank's fobj returns
-            # [k, local_real] for its own shard (the reference's
-            # distributed custom objective is rank-local the same way);
-            # pad rows carry zero grad/hess and zero bag weight
-            mp = self.mp
-            gl = np.asarray(gradients, np.float32).reshape(
-                k, mp.local_real)
-            hl = np.asarray(hessians, np.float32).reshape(
-                k, mp.local_real)
-            pad = mp.block - mp.local_real
-            grad = mp.shard_local_cols(np.pad(gl, ((0, 0), (0, pad))))
-            hess = mp.shard_local_cols(np.pad(hl, ((0, 0), (0, pad))))
+        with self._sec("boosting") as s:
+            if gradients is None or hessians is None:
+                if self.objective is None:
+                    log.fatal("Cannot train without an objective: pass a "
+                              "built-in objective or supply gradients via "
+                              "Booster.update(fobj=...)")
+                for tid in range(k):
+                    init_scores[tid] = self._boost_from_average(tid, True)
+                grad, hess = self._get_gradients()
+            elif getattr(self, "mp", None) is not None:
+                # custom gradients are per-ROW data: each rank's fobj
+                # returns [k, local_real] for its own shard (the
+                # reference's distributed custom objective is rank-local
+                # the same way); pad rows carry zero grad/hess and zero
+                # bag weight
+                mp = self.mp
+                gl = np.asarray(gradients, np.float32).reshape(
+                    k, mp.local_real)
+                hl = np.asarray(hessians, np.float32).reshape(
+                    k, mp.local_real)
+                pad = mp.block - mp.local_real
+                grad = mp.shard_local_cols(np.pad(gl, ((0, 0), (0, pad))))
+                hess = mp.shard_local_cols(np.pad(hl, ((0, 0), (0, pad))))
+            else:
+                # single-process custom gradients: [k, n] host arrays
+                # from Booster.__boost
+                grad = jnp.asarray(np.asarray(gradients, np.float32)
+                                   .reshape(k, n))
+                hess = jnp.asarray(np.asarray(hessians, np.float32)
+                                   .reshape(k, n))
 
-        grad, hess = self._bagging(self.iter, grad, hess)
+            grad, hess = self._bagging(self.iter, grad, hess)
+            s.sync((grad, hess))
 
         should_continue = False
+        nl_per_class = []
         for tid in range(k):
             if self.class_need_train[tid] and self.train_data.num_features > 0:
                 gh = jnp.stack([grad[tid] * self.bag_weight,
                                 hess[tid] * self.bag_weight,
                                 self.bag_weight], axis=1)
-                tree, row_leaf = self._grow(gh)
+                # histogram build + split eval run fused inside the
+                # jitted grower — one section attributes them jointly
+                # (profile_dir splits them at the XLA op level)
+                with self._sec("histogram_split") as s:
+                    tree, row_leaf = self._grow(gh)
+                    s.sync((tree, row_leaf))
                 nl = int(tree.num_leaves)
             else:
                 nl = 1
+            nl_per_class.append(nl)
 
             if nl > 1:
                 should_continue = True
-                ht, sf_inner = self._to_host_tree(tree, self.shrinkage_rate)
-                if self.use_cegb:
-                    for f in sf_inner:
-                        if f >= 0:
-                            self.cegb_used[int(f)] = True
-                row_leaf_np = None
-                if bool(self.config.linear_tree):
-                    row_leaf_np = np.asarray(row_leaf)
-                    self._fit_linear_leaves(ht, row_leaf_np, grad[tid],
-                                            hess[tid])
+                with self._sec("tree_materialize"):
+                    ht, sf_inner = self._to_host_tree(tree,
+                                                      self.shrinkage_rate)
+                    if self.use_cegb:
+                        for f in sf_inner:
+                            if f >= 0:
+                                self.cegb_used[int(f)] = True
+                    row_leaf_np = None
+                    if bool(self.config.linear_tree):
+                        row_leaf_np = np.asarray(row_leaf)
+                        self._fit_linear_leaves(ht, row_leaf_np, grad[tid],
+                                                hess[tid])
                 if (self.objective is not None
                         and self.objective.is_renew_tree_output):
-                    if getattr(self, "mp", None) is not None:
-                        self._renew_tree_output_mp(ht, row_leaf, tid)
-                    else:
-                        row_leaf_np = np.asarray(row_leaf)
-                        self._renew_tree_output(ht, row_leaf_np, tid)
+                    with self._sec("renew_leaf"):
+                        if getattr(self, "mp", None) is not None:
+                            self._renew_tree_output_mp(ht, row_leaf, tid)
+                        else:
+                            row_leaf_np = np.asarray(row_leaf)
+                            self._renew_tree_output(ht, row_leaf_np, tid)
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
-                if bool(self.config.linear_tree) and ht.is_linear \
-                        and self.train_data.raw_data is not None:
-                    # linear leaves: per-row outputs on host raw data
-                    rl = (row_leaf_np if row_leaf_np is not None
-                          else np.asarray(row_leaf))
-                    delta_lin = ht._linear_outputs(
-                        self.train_data.raw_data, rl)
-                    self.scores = self.scores.at[tid].add(
-                        jnp.asarray(delta_lin, jnp.float32))
-                    dt = _DeviceTree(ht, sf_inner)
+                with self._sec("score_update") as s:
+                    if bool(self.config.linear_tree) and ht.is_linear \
+                            and self.train_data.raw_data is not None:
+                        # linear leaves: per-row outputs on host raw data
+                        rl = (row_leaf_np if row_leaf_np is not None
+                              else np.asarray(row_leaf))
+                        delta_lin = ht._linear_outputs(
+                            self.train_data.raw_data, rl)
+                        self.scores = self.scores.at[tid].add(
+                            jnp.asarray(delta_lin, jnp.float32))
+                        dt = _DeviceTree(ht, sf_inner)
+                        for vi in range(len(self.valid_scores)):
+                            if self.valid_data[vi].raw_data is not None:
+                                vp = ht.predict_rows(
+                                    self.valid_data[vi].raw_data)
+                                self.valid_scores[vi] = \
+                                    self.valid_scores[vi].at[tid].add(
+                                        jnp.asarray(vp, jnp.float32))
+                            else:
+                                self.valid_scores[vi] = \
+                                    self._add_tree_to_score(
+                                        self.valid_scores[vi],
+                                        self.valid_bins[vi],
+                                        dt, tid,
+                                        bundle=self._valid_bundle(vi))
+                        if abs(init_scores[tid]) > K_EPSILON:
+                            ht.add_bias(init_scores[tid])
+                            dt.leaf_value = jnp.asarray(ht.leaf_value,
+                                                        jnp.float32)
+                        self.models.append(ht)
+                        self.device_trees.append(dt)
+                        s.sync(self.scores)
+                        continue
+                    lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
+                    if self.parallel_mode != "serial":
+                        # sharded row_leaf: plain sharded gather (the
+                        # pallas lookup kernel is not SPMD-partitionable
+                        # from outside a shard_map region)
+                        delta = lv_dev[row_leaf]
+                    elif self.use_fused:
+                        # per-row gathers are slow on TPU; streaming lookup
+                        from ..ops.fused_level import table_lookup
+                        delta = table_lookup(
+                            row_leaf[None, :], lv_dev,
+                            interpret=self.fused_interpret)[0]
+                    elif self.use_frontier:
+                        # per-row gathers are slow on TPU; where-chain
+                        from ..models.frontier import leaf_value_lookup
+                        delta = leaf_value_lookup(lv_dev, row_leaf,
+                                                  self.max_leaves)
+                    else:
+                        delta = lv_dev[row_leaf]
+                    self.scores = self.scores.at[tid].add(delta)
+                    cf, cm = self._last_cat or (None, None)
+                    dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
                     for vi in range(len(self.valid_scores)):
-                        if self.valid_data[vi].raw_data is not None:
-                            vp = ht.predict_rows(
-                                self.valid_data[vi].raw_data)
-                            self.valid_scores[vi] = \
-                                self.valid_scores[vi].at[tid].add(
-                                    jnp.asarray(vp, jnp.float32))
-                        else:
-                            self.valid_scores[vi] = self._add_tree_to_score(
-                                self.valid_scores[vi], self.valid_bins[vi],
-                                dt, tid, bundle=self._valid_bundle(vi))
+                        self.valid_scores[vi] = self._add_tree_to_score(
+                            self.valid_scores[vi], self.valid_bins[vi],
+                            dt, tid, bundle=self._valid_bundle(vi))
                     if abs(init_scores[tid]) > K_EPSILON:
                         ht.add_bias(init_scores[tid])
                         dt.leaf_value = jnp.asarray(ht.leaf_value,
                                                     jnp.float32)
                     self.models.append(ht)
                     self.device_trees.append(dt)
-                    continue
-                lv_dev = jnp.asarray(ht.leaf_value, jnp.float32)
-                if self.parallel_mode != "serial":
-                    # sharded row_leaf: plain sharded gather (the pallas
-                    # lookup kernel is not SPMD-partitionable from outside
-                    # a shard_map region)
-                    delta = lv_dev[row_leaf]
-                elif self.use_fused:
-                    # per-row gathers are slow on TPU; streaming lookup
-                    from ..ops.fused_level import table_lookup
-                    delta = table_lookup(row_leaf[None, :], lv_dev,
-                                         interpret=self.fused_interpret)[0]
-                elif self.use_frontier:
-                    # per-row gathers are slow on TPU; use the where-chain
-                    from ..models.frontier import leaf_value_lookup
-                    delta = leaf_value_lookup(lv_dev, row_leaf,
-                                              self.max_leaves)
-                else:
-                    delta = lv_dev[row_leaf]
-                self.scores = self.scores.at[tid].add(delta)
-                cf, cm = self._last_cat or (None, None)
-                dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
-                for vi in range(len(self.valid_scores)):
-                    self.valid_scores[vi] = self._add_tree_to_score(
-                        self.valid_scores[vi], self.valid_bins[vi], dt, tid,
-                        bundle=self._valid_bundle(vi))
-                if abs(init_scores[tid]) > K_EPSILON:
-                    ht.add_bias(init_scores[tid])
-                    dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
-                self.models.append(ht)
-                self.device_trees.append(dt)
+                    s.sync(self.scores)
             else:
                 # constant tree (ref: gbdt.cpp:422-441)
                 ht = HostTree(1)
@@ -2453,13 +2687,49 @@ class GBDT:
         if not should_continue:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
+            tel.event("stopped_no_splits", iteration=it)
             if len(self.models) > k:
                 for _ in range(k):
                     self.models.pop()
                     self.device_trees.pop()
             return True
+        if tel.enabled:
+            self._emit_iteration_record(it, nl_per_class)
         self.iter += 1
         return False
+
+    def _emit_iteration_record(self, it: int, nl_per_class: List[int]
+                               ) -> None:
+        """Close iteration ``it``'s telemetry record: estimated collective
+        traffic for the distributed growers (the multiproc host-plane
+        allgathers are counted for real by MultiProcLayout), device
+        memory, per-class leaf counts."""
+        tel = self.telemetry
+        if self.parallel_mode != "serial":
+            # analytic estimate of the in-jit psum payloads this
+            # iteration's trees exchanged; each learner's profile is
+            # documented in parallel/ next to the shard_map it models
+            from ..parallel import collective_profile
+            for nl in nl_per_class:
+                if nl > 1:
+                    cnt, nbytes = collective_profile(
+                        self.parallel_mode, num_leaves=nl,
+                        num_features=self.train_data.num_features,
+                        max_bins=self.max_bins,
+                        top_k=int(self.config.top_k),
+                        leafwise=self.grow_policy == "leafwise")
+                    tel.collective("psum_" + self.parallel_mode,
+                                   cnt, nbytes)
+        extra = {"num_leaves": nl_per_class,
+                 "bag_cnt": int(self.bag_cnt),
+                 "engine": ("fused" if self.use_fused else
+                            "frontier" if self.use_frontier else "xla"),
+                 "mode": self.parallel_mode}
+        mem = device_memory_stats()
+        if mem:
+            extra["memory"] = mem
+            tel.gauge("device.bytes_in_use", mem.get("bytes_in_use", 0))
+        tel.end_iteration(it, **extra)
 
     # ------------------------------------------------------------------
     def reset_config(self, config: Config) -> None:
@@ -2471,6 +2741,7 @@ class GBDT:
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
         self._stopped_early = False   # a relaxed config may split again
+        self._setup_telemetry(config)
         self._setup_cegb(config)
         self._setup_forced_splits(config, self.train_data)
         # mode-compatibility guards must re-fire: a reset can enable CEGB/
@@ -2634,6 +2905,9 @@ class GBDT:
                         if self.best_iter else self.iter
                     log.info("Early stopping at iteration %d, the best "
                              "iteration round is %d", self.iter, best)
+                    self.telemetry.event("early_stopping",
+                                         iteration=self.iter,
+                                         best_iteration=best)
                     # drop trees after the best iteration
                     extra = (self.iter - best) * self.num_tree_per_iteration
                     for _ in range(extra):
@@ -2642,6 +2916,7 @@ class GBDT:
                     self.iter = best
             if finished:
                 break
+        self.finalize_telemetry()
 
     # ------------------------------------------------------------------
     @property
@@ -3043,18 +3318,30 @@ class RF(GBDT):
         return self._fixed_grad, self._fixed_hess
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._profiler_step()
         k = self.num_tree_per_iteration
-        grad, hess = (self._get_gradients() if gradients is None
-                      else (jnp.asarray(gradients).reshape(k, self.num_data),
-                            jnp.asarray(hessians).reshape(k, self.num_data)))
-        grad, hess = self._bagging(self.iter, grad, hess)
+        tel = self.telemetry
+        it = self.iter
+        tel.begin_iteration(it)
+        nl_per_class = []
+        with self._sec("boosting") as s:
+            grad, hess = (self._get_gradients() if gradients is None
+                          else (jnp.asarray(gradients)
+                                .reshape(k, self.num_data),
+                                jnp.asarray(hessians)
+                                .reshape(k, self.num_data)))
+            grad, hess = self._bagging(self.iter, grad, hess)
+            s.sync((grad, hess))
         should_continue = False
         for tid in range(k):
             gh = jnp.stack([grad[tid] * self.bag_weight,
                             hess[tid] * self.bag_weight,
                             self.bag_weight], axis=1)
-            tree, row_leaf = self._grow(gh)
+            with self._sec("histogram_split") as s:
+                tree, row_leaf = self._grow(gh)
+                s.sync((tree, row_leaf))
             nl = int(tree.num_leaves)
+            nl_per_class.append(nl)
             if nl > 1:
                 should_continue = True
                 ht, sf_inner = self._to_host_tree(tree, 1.0)
@@ -3088,11 +3375,14 @@ class RF(GBDT):
         if not should_continue:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
+            tel.event("stopped_no_splits", iteration=it)
             if len(self.models) > k:
                 for _ in range(k):
                     self.models.pop()
                     self.device_trees.pop()
             return True
+        if tel.enabled:
+            self._emit_iteration_record(it, nl_per_class)
         self.iter += 1
         return False
 

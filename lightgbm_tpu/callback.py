@@ -59,6 +59,56 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]):
     return _callback
 
 
+def record_telemetry(telemetry_result: Dict[str, Any]):
+    """Record per-iteration telemetry into ``telemetry_result``
+    (symmetric with :func:`record_evaluation`, but fed by the obs
+    registry instead of the eval loop).
+
+    Enables the booster's telemetry registry before the first iteration
+    runs (so iteration 0 is covered), then drains completed per-iteration
+    records into ``telemetry_result["iterations"]`` as training
+    progresses; at the end of ``engine.train`` the finalize hook drains
+    the tail and stores the registry snapshot (counters, gauges, timing
+    distributions, recent events) under ``telemetry_result["summary"]``.
+
+    Note: an enabled registry runs the synchronous per-iteration driver
+    (honest section attribution; see docs/Observability.md), like
+    ``telemetry_out=...`` does.
+    """
+    if not isinstance(telemetry_result, dict):
+        raise TypeError("telemetry_result should be a dictionary")
+
+    def _registry(env):
+        # plain Booster only: CVBooster proxies attribute access, so read
+        # the instance dict (sub-boosters each own a registry)
+        gb = env.model.__dict__.get("_gbdt")
+        return None if gb is None else gb.telemetry
+
+    def _drain(tel) -> None:
+        recs = tel.drain_records()
+        if recs:
+            telemetry_result.setdefault("iterations", []).extend(recs)
+
+    def _callback(env: CallbackEnv) -> None:
+        tel = _registry(env)
+        if tel is None:
+            return
+        if not tel.enabled:
+            tel.enable()
+        _drain(tel)
+    _callback.before_iteration = True
+    _callback.order = 5
+
+    def _finalize(env: CallbackEnv) -> None:
+        tel = _registry(env)
+        if tel is None:
+            return
+        _drain(tel)
+        telemetry_result["summary"] = tel.snapshot()
+    _callback.finalize = _finalize
+    return _callback
+
+
 def reset_parameter(**kwargs: Union[list, Callable[[int], Any]]):
     """Reset parameters on schedule, e.g.
     ``reset_parameter(learning_rate=lambda i: 0.1 * 0.99 ** i)``

@@ -10,6 +10,11 @@ wins), tasks train / predict / refit-free convert paths:
         objective=binary num_iterations=100 output_model=model.txt
     python -m lightgbm_tpu task=predict data=test.csv \\
         input_model=model.txt output_result=preds.tsv
+
+Observability flags (docs/Observability.md): ``telemetry_out=<path>``
+streams per-iteration JSONL telemetry, ``profile_dir=<dir>`` captures a
+jax.profiler trace of the training loop — both are ordinary config keys,
+so they work from the command line and from config files alike.
 """
 from __future__ import annotations
 
@@ -69,6 +74,9 @@ def run_train(params: Dict[str, str]) -> None:
     # Python facade's best_iteration default
     booster.save_model(output_model, num_iteration=-1)
     log.info("Finished training; model saved to %s", output_model)
+    tel_out = params.get("telemetry_out", params.get("telemetry_output"))
+    if tel_out:
+        log.info("Telemetry JSONL written to %s", tel_out)
 
 
 def run_predict(params: Dict[str, str]) -> None:

@@ -265,6 +265,22 @@ _PARAMS: List[_Param] = [
     _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),
     _p("mesh_axis_feature", str, "feature",
        desc="mesh axis name for feature sharding"),
+    # ---- Observability (docs/Observability.md) ----
+    _p("telemetry_out", str, "", ("telemetry_output", "telemetry_file"),
+       desc="path: stream structured JSONL telemetry (per-iteration "
+            "section times, collective traffic, compile and degradation "
+            "events); multi-process ranks write <path>.rank<r>, rank 0 "
+            "the bare path. Enabling telemetry runs the synchronous "
+            "per-iteration driver so section times are honestly "
+            "attributable"),
+    _p("profile_dir", str, "", ("profiler_dir", "profile_log_dir"),
+       desc="directory: capture a jax.profiler trace of the training "
+            "loop (TensorBoard/Perfetto viewable)"),
+    _p("profile_start_iteration", int, 0, check=(">=", 0),
+       desc="first boosting iteration covered by the profile_dir trace"),
+    _p("profile_num_iterations", int, -1,
+       desc="iterations covered by the profile_dir trace; <0 = until "
+            "training ends"),
 ]
 
 _BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}

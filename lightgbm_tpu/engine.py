@@ -108,6 +108,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     # main loop (ref: engine.py:260-283)
     evaluation_result_list: List = []
+    i = -1
     for i in range(num_boost_round):
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(
@@ -144,6 +145,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for name, metric, value, _ in (evaluation_result_list or []):
         booster.best_score[name][metric] = value
+    # observability epilogue: stop an open profiler trace, write the
+    # telemetry summary + flush the JSONL sink, then let callbacks with a
+    # finalize hook (record_telemetry) drain the completed records
+    booster._finalize_telemetry()
+    for cb in callbacks_before + callbacks_after:
+        fin = getattr(cb, "finalize", None)
+        if fin is not None:
+            fin(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=evaluation_result_list))
     return booster
 
 

@@ -1,0 +1,27 @@
+"""Structured training telemetry.
+
+The reference's only introspection is the compile-time TIMETAG section
+timer (ref: include/LightGBM/utils/common.h:978); SURVEY §5 calls the
+profiling gap out explicitly, and PROFILE.md documents why ad-hoc
+wall-clock timing through the remote TPU tunnel cannot be trusted.  This
+package is the permanent, low-overhead replacement:
+
+- :class:`Telemetry` (registry.py) — thread-safe registry of counters,
+  gauges and per-section timing distributions, plus a structured event
+  stream (degradations with reasons, compile events, per-iteration
+  records) that can sink to a JSONL file;
+- :class:`JsonlSink` (events.py) — the rank-aware JSONL writer behind
+  ``telemetry_out=<path>``;
+- jaxmon.py — ``jax.monitoring`` bridge (XLA compile events) and device
+  memory stats.
+
+Every recording method is a no-op behind a single attribute check while
+the registry is disabled, so instrumentation stays in the hot driver
+paths permanently, like the reference's TIMETAG sections.
+"""
+from .events import JsonlSink
+from .jaxmon import device_memory_stats
+from .registry import Telemetry, allgather_json
+
+__all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
+           "allgather_json"]

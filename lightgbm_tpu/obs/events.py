@@ -1,0 +1,63 @@
+"""JSONL event sink for the telemetry registry.
+
+One JSON object per line.  Schema (docs/Observability.md):
+
+- every record carries ``ts`` (unix seconds), ``rank`` (jax process
+  index) and ``event`` (name);
+- iteration records (``event == "iteration"``) add ``iter`` plus the
+  per-iteration payload (``sections``, ``collectives``, ``compile``,
+  ``num_leaves``, optionally ``memory``);
+- other events carry their attributes as flat extra keys.
+
+Multi-process runs write one file per rank: rank 0 owns the configured
+path, rank r writes ``<path>.rank<r>`` (a shared file over NFS would
+interleave partial lines).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+from typing import Any, Dict
+
+
+def _json_default(o: Any):
+    """Last-resort coercion so numpy scalars / device arrays in event
+    attributes cannot kill the sink."""
+    for cast in (int, float):
+        try:
+            return cast(o)
+        except (TypeError, ValueError):
+            continue
+    return str(o)
+
+
+class JsonlSink:
+    """Line-buffered JSONL writer (one flush per record — telemetry
+    records are per-iteration scale, not per-op scale)."""
+
+    def __init__(self, path: str, rank: int = 0):
+        if rank:
+            path = f"{path}.rank{rank}"
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "w", buffering=1)
+        atexit.register(self.close)
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, separators=(",", ":"),
+                          default=_json_default)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

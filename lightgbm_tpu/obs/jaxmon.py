@@ -1,0 +1,70 @@
+"""jax.monitoring bridge + device memory stats.
+
+JAX reports compile phases through ``jax.monitoring`` duration events
+(``/jax/core/compile/jaxpr_trace_duration``,
+``.../jaxpr_to_mlir_module_duration``, ``.../backend_compile_duration``).
+A single process-wide listener is installed on first attach and fans the
+events out to every live, enabled :class:`Telemetry` — so per-booster
+registries see the compiles their iterations trigger (a recompile
+mid-training is exactly the kind of cliff PROFILE.md says one-off timing
+scripts keep missing).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional
+
+_COMPILE_PREFIX = "/jax/core/compile"
+
+_lock = threading.Lock()
+_installed = False
+_active: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def attach(tel) -> None:
+    """Subscribe a Telemetry instance to compile events (idempotent)."""
+    global _installed
+    with _lock:
+        _active.add(tel)
+        if _installed:
+            return
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # monitoring API unavailable: degrade silently
+            pass
+        _installed = True
+
+
+def detach(tel) -> None:
+    with _lock:
+        _active.discard(tel)
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if not event.startswith(_COMPILE_PREFIX):
+        return
+    # short phase name: "backend_compile_duration" etc.
+    phase = event.rsplit("/", 1)[-1]
+    for tel in list(_active):
+        if tel.enabled:
+            tel.compile_event(phase, float(duration))
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Allocator stats of the first local device ({} keys vary by
+    backend; TPU/GPU report bytes_in_use etc., CPU returns None)."""
+    try:
+        import jax
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size"):
+        if key in ms:
+            out[key] = int(ms[key])
+    return out or None

@@ -1,0 +1,271 @@
+"""Thread-safe telemetry registry.
+
+One :class:`Telemetry` instance per booster (GBDT driver).  It holds
+
+- **counters** — monotone sums (iterations, collective bytes, degrade
+  reasons, compile events);
+- **gauges** — last-written values (device memory, bag counts);
+- **timings** — per-name duration distributions ``{count, total, min,
+  max}`` fed by the driver's per-iteration sections and by compile
+  events;
+- **events** — a bounded ring of structured records, mirrored to the
+  JSONL sink when one is attached (``telemetry_out=<path>``);
+- **records** — completed per-iteration records queued for the
+  ``record_telemetry`` callback to drain.
+
+Disabled-path contract: every recording method returns after a single
+``self.enabled`` attribute check — no allocation, no locking, no
+serialization — so the instrumentation can live in the training loop
+permanently (the acceptance bar the ISSUE sets for the disabled path).
+
+Rank handling: every record is tagged with ``jax.process_index()``;
+``allgather_json`` is the SPMD helper the driver uses to aggregate
+per-rank counter snapshots at rank 0 when emitting the end-of-training
+summary.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_EVENT_RING = 512       # bounded in-memory event history
+_RECORD_RING = 65536    # per-iteration records awaiting a drain
+
+
+class Telemetry:
+    """Counters + gauges + timing distributions + structured events."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._lock = threading.RLock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._timings: Dict[str, Dict[str, float]] = {}
+        self._events = collections.deque(maxlen=_EVENT_RING)
+        self._records = collections.deque(maxlen=_RECORD_RING)
+        self._sink = None
+        self._rank: Optional[int] = None
+        # per-iteration scratch (begin_iteration .. end_iteration)
+        self._cur_iter: Optional[int] = None
+        self._cur_sections: Dict[str, float] = {}
+        self._cur_collectives: Dict[str, Dict[str, int]] = {}
+        self._cur_compile: Dict[str, float] = {}
+
+    # ------------------------------------------------------------ admin
+    @property
+    def rank(self) -> int:
+        if self._rank is None:
+            try:
+                import jax
+                self._rank = int(jax.process_index())
+            except Exception:
+                self._rank = 0
+        return self._rank
+
+    def enable(self, sink_path: Optional[str] = None) -> None:
+        """Turn recording on; ``sink_path`` additionally streams every
+        event as a JSONL line (rank-suffixed under multi-process)."""
+        from . import jaxmon
+        from .events import JsonlSink
+        with self._lock:
+            if sink_path and self._sink is None:
+                self._sink = JsonlSink(sink_path, rank=self.rank)
+            self.enabled = True
+        jaxmon.attach(self)
+
+    def disable(self) -> None:
+        from . import jaxmon
+        jaxmon.detach(self)
+        self.flush()
+        self.enabled = False
+
+    def flush(self) -> None:
+        sink = self._sink
+        if sink is not None:
+            sink.flush()
+
+    def close(self) -> None:
+        self.disable()
+        sink, self._sink = self._sink, None
+        if sink is not None:
+            sink.close()
+
+    # ------------------------------------------------------- primitives
+    def inc(self, name: str, value: float = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._observe_locked(name, seconds)
+
+    def _observe_locked(self, name: str, seconds: float) -> None:
+        t = self._timings.get(name)
+        if t is None:
+            t = self._timings[name] = {"count": 0, "total": 0.0,
+                                       "min": float("inf"), "max": 0.0}
+        t["count"] += 1
+        t["total"] += seconds
+        t["min"] = min(t["min"], seconds)
+        t["max"] = max(t["max"], seconds)
+
+    def event(self, name: str, iteration: Optional[int] = None,
+              **attrs: Any) -> None:
+        """Structured event: ring-buffered, counted, sunk to JSONL."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {"ts": time.time(), "rank": self.rank,
+                               "event": name}
+        if iteration is not None:
+            rec["iter"] = int(iteration)
+        rec.update(attrs)
+        with self._lock:
+            self._events.append(rec)
+            key = "events." + name
+            self._counters[key] = self._counters.get(key, 0) + 1
+            sink = self._sink
+        if sink is not None:
+            sink.write(rec)
+
+    def degrade(self, reason: str, **attrs: Any) -> None:
+        """A requested mode/engine fell back: the reason is the record,
+        not a log string (the registry's analog of the driver's
+        log.warning degradation messages)."""
+        if not self.enabled:
+            return
+        self.inc("degrade." + reason)
+        self.event("degrade", reason=reason, **attrs)
+
+    # ---------------------------------------------------- per-iteration
+    def begin_iteration(self, it: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._cur_iter = int(it)
+            self._cur_sections = {}
+            self._cur_collectives = {}
+            self._cur_compile = {"count": 0, "secs": 0.0}
+
+    def section(self, name: str, seconds: float) -> None:
+        """Accumulate a named section's duration into the current
+        iteration record and the global timing distribution."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._cur_sections[name] = (self._cur_sections.get(name, 0.0)
+                                        + seconds)
+            self._observe_locked("section." + name, seconds)
+
+    def collective(self, kind: str, count: int, nbytes: int) -> None:
+        """Record collective traffic (count + payload bytes) against the
+        current iteration (if one is open) and the global counters."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._cur_iter is not None:
+                c = self._cur_collectives.setdefault(
+                    kind, {"count": 0, "bytes": 0})
+                c["count"] += int(count)
+                c["bytes"] += int(nbytes)
+            self._counters["collectives.count"] = \
+                self._counters.get("collectives.count", 0) + int(count)
+            self._counters["collectives.bytes"] = \
+                self._counters.get("collectives.bytes", 0) + int(nbytes)
+
+    def compile_event(self, phase: str, seconds: float) -> None:
+        """XLA compile phase (fed by obs.jaxmon); attributed to the open
+        iteration when one is active."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters["compile.events"] = \
+                self._counters.get("compile.events", 0) + 1
+            self._observe_locked("compile." + phase, seconds)
+            if self._cur_iter is not None:
+                self._cur_compile["count"] += 1
+                self._cur_compile["secs"] += seconds
+
+    def end_iteration(self, it: int, **attrs: Any) -> None:
+        """Close the iteration: emit its record (sections, collectives,
+        compile activity + caller attrs) and queue it for draining."""
+        if not self.enabled:
+            return
+        with self._lock:
+            sections = {k: round(v, 9)
+                        for k, v in self._cur_sections.items()}
+            coll = {k: dict(v) for k, v in self._cur_collectives.items()}
+            comp = dict(self._cur_compile)
+            comp["secs"] = round(comp.get("secs", 0.0), 9)
+            self._cur_iter = None
+            self._counters["iterations"] = \
+                self._counters.get("iterations", 0) + 1
+            rec: Dict[str, Any] = {"ts": time.time(), "rank": self.rank,
+                                   "event": "iteration", "iter": int(it),
+                                   "sections": sections,
+                                   "collectives": coll, "compile": comp}
+            rec.update(attrs)
+            self._events.append(rec)
+            self._records.append(rec)
+            sink = self._sink
+        if sink is not None:
+            sink.write(rec)
+
+    def drain_records(self) -> List[Dict[str, Any]]:
+        """Completed iteration records since the last drain (the
+        record_telemetry callback's feed)."""
+        with self._lock:
+            out = list(self._records)
+            self._records.clear()
+        return out
+
+    # --------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time dict view: counters, gauges, timing
+        distributions and the recent event ring (rank-local; the
+        end-of-training summary event carries the rank aggregate)."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "rank": self.rank,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timings": {k: dict(v) for k, v in self._timings.items()},
+                "events": [dict(e) for e in self._events],
+            }
+
+
+def allgather_json(obj: Any) -> List[Any]:
+    """SPMD allgather of one JSON-serializable value per rank (returns
+    ``[obj]`` single-process).  Every rank must call this at the same
+    point — the driver only does so from finalize_telemetry, which runs
+    on all ranks by the SPMD contract."""
+    import json as _json
+
+    import jax
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+    payload = np.frombuffer(_json.dumps(obj).encode("utf-8"), np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))).reshape(-1)
+    width = int(sizes.max())
+    buf = np.zeros(width, np.uint8)
+    buf[:payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf)) \
+        .reshape(sizes.size, width)
+    return [_json.loads(bytes(gathered[r, :int(sizes[r])]).decode("utf-8"))
+            for r in range(sizes.size)]

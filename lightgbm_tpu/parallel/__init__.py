@@ -25,5 +25,25 @@ __all__ = [
     "make_mesh", "replicate", "shard_rows",
     "make_sharded_grow_fn",
     "make_feature_parallel_grow_fn", "make_voting_parallel_grow_fn",
-    "distributed", "train_distributed",
+    "distributed", "train_distributed", "collective_profile",
 ]
+
+
+def collective_profile(mode: str, num_leaves: int, num_features: int,
+                       max_bins: int, top_k: int = 20,
+                       leafwise: bool = True):
+    """(count, bytes) estimate of one tree's in-jit collective traffic
+    for the telemetry registry — dispatches to the per-learner profiles
+    (each documents the exchange it models next to the shard_map that
+    performs it). Multi-process host-plane allgathers are counted for
+    real by MultiProcLayout, not estimated here."""
+    from . import data_parallel, tree_parallel
+    if mode == "data":
+        return data_parallel.collective_profile(num_leaves, num_features,
+                                                max_bins, leafwise)
+    if mode == "voting":
+        return tree_parallel.voting_collective_profile(
+            num_leaves, num_features, max_bins, top_k)
+    if mode == "feature":
+        return tree_parallel.feature_collective_profile(num_leaves)
+    return 0, 0

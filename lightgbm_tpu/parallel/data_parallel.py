@@ -22,7 +22,7 @@ from ..models.learner import (FeatureMeta, grow_tree_depthwise,
                               grow_tree_leafwise)
 from ..models.tree import TreeArrays
 from ..ops.split import SplitParams
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map
 
 
 def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
@@ -61,9 +61,27 @@ def make_sharded_grow_fn(mesh: Mesh, params: SplitParams, num_leaves: int,
                         "forced_thr": forced_thr}
                        if policy == "leafwise" and n_forced else {}))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
         out_specs=(P(), P(axis_name)),
         check_vma=False)
     return jax.jit(sharded)
+
+
+def collective_profile(num_leaves: int, num_features: int, max_bins: int,
+                       leafwise: bool = True) -> Tuple[int, int]:
+    """(count, bytes) estimate of one tree's in-jit histogram allreduce
+    traffic under data-parallel growth, for the telemetry registry.
+
+    The exchange is the reference's reduce-scatter of [F, B, 3] f32
+    histograms (data_parallel_tree_learner.cpp:155-189), collapsed here
+    into one ``psum`` per histogrammed node: leaf-wise growth histograms
+    the root plus one child per split (the sibling is derived by
+    subtraction); depth-wise growth histograms every non-derived node of
+    every level — both are ~``num_leaves`` node histograms per tree.
+    Analytic payload of the lowered collectives, not a wire measurement
+    (XLA may fuse or reduce-scatter under the hood)."""
+    node_hists = max(1, int(num_leaves))
+    hist_bytes = int(num_features) * int(max_bins) * 3 * 4
+    return node_hists, node_hists * hist_bytes

@@ -19,6 +19,20 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable shard_map: ``jax.shard_map`` where it exists
+    (jax >= 0.6), else ``jax.experimental.shard_map.shard_map`` whose
+    replication check carries the older ``check_rep`` name. Every
+    shard_map in the tree learners routes through here so a jax upgrade
+    is a one-line change."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = DATA_AXIS,
               devices: Optional[Sequence] = None) -> Mesh:

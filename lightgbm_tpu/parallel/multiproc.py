@@ -61,10 +61,14 @@ class MultiProcLayout:
     """Row layout + placement helpers for one global mesh."""
 
     def __init__(self, mesh: Mesh, axis: str, local_rows: int,
-                 row_align: int = 1):
+                 row_align: int = 1, telemetry=None):
         from jax.experimental import multihost_utils
 
         self._mh = multihost_utils
+        # host-plane collective accounting: every process_allgather this
+        # layout performs is counted for real (count + payload bytes)
+        # into the driver's telemetry registry, rank-tagged there
+        self.telemetry = telemetry
         self.mesh = mesh
         self.axis = axis
         self.process_index = jax.process_index()
@@ -92,7 +96,7 @@ class MultiProcLayout:
                           "jax.devices() order", r * self.dev_per_proc,
                           blk[0].process_index)
         self.local_real = int(local_rows)
-        counts = np.asarray(self._mh.process_allgather(
+        counts = np.asarray(self._allgather(
             np.asarray([self.local_real], np.int64))).reshape(-1)
         self.counts = [int(c) for c in counts]
         self.total_real = int(sum(self.counts))
@@ -111,6 +115,18 @@ class MultiProcLayout:
                  self.Np, self.S)
 
     # ------------------------------------------------------------ host
+    def _allgather(self, arr: np.ndarray):
+        """process_allgather with telemetry accounting (real payloads,
+        not estimates: count 1, bytes = gathered result size)."""
+        out = self._mh.process_allgather(arr)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            a = np.asarray(arr)
+            tel.collective("host_allgather", 1,
+                           int(a.size) * int(a.dtype.itemsize)
+                           * int(self.process_count))
+        return out
+
     def pad_local(self, arr: np.ndarray) -> np.ndarray:
         """[local_real, ...] -> [block, ...] zero-padded."""
         arr = np.asarray(arr)
@@ -132,7 +148,7 @@ class MultiProcLayout:
         loc = self.pad_local(np.asarray(local))
         if fill != 0:
             loc[self.local_real:] = fill
-        out = np.asarray(self._mh.process_allgather(loc))
+        out = np.asarray(self._allgather(loc))
         return out.reshape((self.Np,) + loc.shape[1:])
 
     def real_mask_np(self) -> np.ndarray:
@@ -155,12 +171,12 @@ class MultiProcLayout:
             # compacted-row -> padded-global-row map (rank r's rows live
             # at [r*block, r*block + counts[r]))
             sizes = np.diff(np.asarray(md.query_boundaries, np.int64))
-            nq = np.asarray(self._mh.process_allgather(
+            nq = np.asarray(self._allgather(
                 np.asarray([sizes.size], np.int64))).reshape(-1)
             m = int(nq.max())
             pad = np.zeros(m, np.int64)
             pad[:sizes.size] = sizes
-            allq = np.asarray(self._mh.process_allgather(pad)) \
+            allq = np.asarray(self._allgather(pad)) \
                 .reshape(self.process_count, m)
             all_sizes = np.concatenate(
                 [allq[r, :int(nq[r])] for r in range(self.process_count)])

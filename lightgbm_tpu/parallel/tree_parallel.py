@@ -29,7 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.learner import FeatureMeta, grow_tree_depthwise
 from ..models.tree import TreeArrays
 from ..ops.split import SplitParams
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map
 
 FEATURE_AXIS = "feature"
 
@@ -72,7 +72,7 @@ def make_feature_parallel_grow_fn(mesh: Mesh, params: SplitParams,
             has_cat=has_cat, parallel_mode="feature",
             route_bins=bins_full, route_meta=meta, feature_offset=f0)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=(P(), P()),
@@ -93,9 +93,41 @@ def make_voting_parallel_grow_fn(mesh: Mesh, params: SplitParams,
             max_depth, hist_impl=hist_impl, psum_axis=axis_name,
             parallel_mode="voting", top_k=top_k)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),
         out_specs=(P(), P(axis_name)),
         check_vma=False)
     return jax.jit(sharded)
+
+
+# 48 bytes: the reference's allreduced SplitInfo record
+# (parallel_tree_learner.h:191-214 SyncUpGlobalBestSplit)
+_SPLIT_RECORD_BYTES = 48
+
+
+def feature_collective_profile(num_leaves: int,
+                               max_depth_grown: int = None
+                               ) -> Tuple[int, int]:
+    """(count, bytes) estimate of one tree's feature-parallel exchange:
+    zero histogram traffic, one best-split-record merge per level (the
+    SyncUpGlobalBestSplit analog; here a pmax over [L]-sized records).
+    Levels default to ceil(log2(num_leaves)) + 1 for a balanced tree."""
+    import math
+    L = max(2, int(num_leaves))
+    levels = (int(max_depth_grown) if max_depth_grown
+              else int(math.ceil(math.log2(L))) + 1)
+    return levels, levels * L * _SPLIT_RECORD_BYTES
+
+
+def voting_collective_profile(num_leaves: int, num_features: int,
+                              max_bins: int, top_k: int) -> Tuple[int, int]:
+    """(count, bytes) estimate of one tree's voting-parallel exchange:
+    per histogrammed node, a [F] int32 vote psum plus the 2*top_k
+    winning features' [B, 3] f32 histogram columns
+    (voting_parallel_tree_learner.cpp:151-184 GlobalVoting +
+    CopyLocalHistogram)."""
+    node_hists = max(1, int(num_leaves))
+    per_node = (int(num_features) * 4
+                + 2 * int(top_k) * int(max_bins) * 3 * 4)
+    return 2 * node_hists, node_hists * per_node

@@ -14,7 +14,8 @@ honest way to attribute device time to a section.
 
 ``profiler_trace`` wraps ``jax.profiler.trace`` for XLA-level traces
 viewable in TensorBoard/Perfetto — the deep-dive path the reference
-lacks (SURVEY §5: profiling gap).
+lacks (SURVEY §5: profiling gap). The training loop exposes the same
+trace via the ``profile_dir`` config key (docs/Observability.md).
 """
 from __future__ import annotations
 
@@ -23,9 +24,15 @@ import contextlib
 import os
 import threading
 import time
-from typing import Dict
+from typing import Dict, NamedTuple
 
 from . import log
+
+
+class SectionStat(NamedTuple):
+    """Accumulated cost of one named section."""
+    total: float
+    count: int
 
 
 class Timer:
@@ -37,6 +44,10 @@ class Timer:
         self._acc: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._tls = threading.local()
+        # bumped by reset(): invalidates every thread's open-start stack,
+        # so a section started before reset() cannot leak a stale start
+        # time into the next run
+        self._gen = 0
 
     # ------------------------------------------------------------------
     def enable(self) -> None:
@@ -53,26 +64,39 @@ class Timer:
         with self._lock:
             self._acc.clear()
             self._counts.clear()
+            self._gen += 1
 
     # ------------------------------------------------------------------
+    def _stack(self) -> Dict[str, float]:
+        """This thread's open-start stack, discarded when a reset() has
+        happened since it was last touched."""
+        tls = self._tls
+        if getattr(tls, "gen", None) != self._gen:
+            tls.stack = {}
+            tls.gen = self._gen
+        return tls.stack
+
     def start(self, name: str) -> None:
         if not self._enabled:
             return
-        stack = getattr(self._tls, "stack", None)
-        if stack is None:
-            stack = self._tls.stack = {}
-        stack[name] = time.perf_counter()
+        self._stack()[name] = time.perf_counter()
 
     def stop(self, name: str) -> None:
         if not self._enabled:
             return
-        stack = getattr(self._tls, "stack", {})
-        t0 = stack.pop(name, None)
+        t0 = self._stack().pop(name, None)
         if t0 is None:
             return
-        dt = time.perf_counter() - t0
+        self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally-measured duration (used by callers
+        that time once and feed both this timer and the telemetry
+        registry)."""
+        if not self._enabled:
+            return
         with self._lock:
-            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
             self._counts[name] = self._counts.get(name, 0) + 1
 
     @contextlib.contextmanager
@@ -89,16 +113,19 @@ class Timer:
             self.stop(name)
 
     # ------------------------------------------------------------------
-    def stats(self) -> Dict[str, float]:
+    def stats(self) -> Dict[str, SectionStat]:
+        """Per-section (total_seconds, call_count)."""
         with self._lock:
-            return dict(self._acc)
+            return {name: SectionStat(self._acc[name],
+                                      self._counts.get(name, 0))
+                    for name in self._acc}
 
     def print(self) -> None:
-        """(ref: common.h:1011 Timer::Print — '%s costs: %f' per name,
-        name-ordered)"""
+        """(ref: common.h:1011 Timer::Print — '%s costs: %f' per name;
+        costliest first so the hot section tops the report)"""
         if not self._acc:
             return
-        for name in sorted(self._acc):
+        for name in sorted(self._acc, key=self._acc.get, reverse=True):
             log.info("%s costs: %f seconds (%d calls)", name,
                      self._acc[name], self._counts.get(name, 0))
 

@@ -1,0 +1,68 @@
+"""Logging subsystem (utils/log.py — analog of the reference logger,
+ref: include/LightGBM/utils/log.h:71-170 + python register_logger)."""
+import pytest
+
+from lightgbm_tpu.utils import log
+
+
+@pytest.fixture(autouse=True)
+def _restore_log_state():
+    """Pin a known level (earlier tests' verbose=-1 params lower the
+    module-global threshold) and leave the logger state as found."""
+    level = log.get_log_level()
+    log.set_log_level(log.LogLevel.INFO)
+    yield
+    log.register_logger(None)
+    log.set_log_level(level)
+
+
+def test_register_logger_none_restores_stderr(capsys):
+    lines = []
+    log.register_logger(lines.append)
+    log.info("captured %d", 1)
+    assert lines and "captured 1" in lines[0]
+    log.register_logger(None)
+    log.info("back to stderr")
+    captured = capsys.readouterr()
+    assert "back to stderr" in captured.err
+    assert len(lines) == 1   # the callback no longer receives messages
+
+
+def test_callback_receives_levels_per_threshold():
+    lines = []
+    log.register_logger(lines.append)
+    log.set_log_level(log.LogLevel.DEBUG)
+    log.warning("w")
+    log.info("i")
+    log.debug("d")
+    assert [ln.rsplit("] ", 1)[1] for ln in lines] == ["w", "i", "d"]
+    assert "[Warning]" in lines[0]
+    assert "[Info]" in lines[1]
+    assert "[Debug]" in lines[2]
+
+    # raising the threshold filters info/debug but keeps warnings
+    lines.clear()
+    log.set_log_level(log.LogLevel.WARNING)
+    log.warning("w2")
+    log.info("i2")
+    log.debug("d2")
+    assert len(lines) == 1 and "w2" in lines[0]
+
+    # INFO level: warnings + info pass, debug filtered
+    lines.clear()
+    log.set_log_level(log.LogLevel.INFO)
+    log.warning("w3")
+    log.info("i3")
+    log.debug("d3")
+    assert len(lines) == 2
+
+
+def test_fatal_and_check_raise_lightgbm_error():
+    with pytest.raises(log.LightGBMError, match="boom 7"):
+        log.fatal("boom %d", 7)
+    with pytest.raises(log.LightGBMError, match="check failed"):
+        log.check(False)
+    with pytest.raises(log.LightGBMError, match="custom message"):
+        log.check(1 > 2, "custom message")
+    # a passing check is silent
+    log.check(True)

@@ -3,6 +3,7 @@ TIMETAG Timer, ref: include/LightGBM/utils/common.h:978)."""
 import numpy as np
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import log
 from lightgbm_tpu.utils.timer import Timer, global_timer
 
 
@@ -22,9 +23,45 @@ def test_timer_accumulates_sections():
     with t.section("b"):
         pass
     s = t.stats()
-    assert set(s) == {"a", "b"} and s["a"] >= 0.0
+    assert set(s) == {"a", "b"}
+    assert s["a"].total >= 0.0 and s["a"].count == 2
+    assert s["b"].count == 1
     t.reset()
     assert t.stats() == {}
+
+
+def test_timer_reset_clears_open_starts():
+    """A section started before reset() must not pollute the next run
+    (reset() bumps the generation that invalidates per-thread start
+    stacks)."""
+    t = Timer(enabled=True)
+    t.start("stale")
+    t.reset()
+    t.stop("stale")     # stale start discarded: no accumulation
+    assert t.stats() == {}
+    # and a fresh start/stop after the reset still records normally
+    t.start("fresh")
+    t.stop("fresh")
+    assert set(t.stats()) == {"fresh"}
+
+
+def test_timer_add_and_print_sorted_by_cost():
+    t = Timer(enabled=True)
+    t.add("cheap", 0.25)
+    t.add("costly", 2.0)
+    t.add("mid", 1.0)
+    lines = []
+    level = log.get_log_level()
+    log.set_log_level(log.LogLevel.INFO)
+    log.register_logger(lines.append)
+    try:
+        t.print()
+    finally:
+        log.register_logger(None)
+        log.set_log_level(level)
+    order = [name for line in lines
+             for name in ("costly", "mid", "cheap") if name in line]
+    assert order == ["costly", "mid", "cheap"]
 
 
 def test_training_sections_recorded():
@@ -43,6 +80,12 @@ def test_training_sections_recorded():
         assert ("GBDT::TrainOneIter" in s
                 or "GBDT::TrainOneIterFast" in s)
         assert "Predictor::Predict" in s
+        if "GBDT::TrainOneIter" in s:
+            # the synchronous driver also feeds the per-phase sections
+            # (the pipelined fast path on TPU intentionally does not —
+            # its phases overlap and cannot be attributed honestly)
+            assert "GBDT::histogram_split" in s
+            assert s["GBDT::histogram_split"].count >= 3
     finally:
         global_timer.disable()
         global_timer.reset()
