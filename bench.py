@@ -42,6 +42,41 @@ _RESULT = {"metric": "higgs_sec_per_iter_10.5M_rows", "value": None,
 _TAIL = {"phases": []}
 _T0 = time.time()
 
+# Persistent bench trajectory: every run appends its (latest) record to
+# BENCH_TRAJECTORY.jsonl so scripts/bench_compare.py can diff consecutive
+# runs and flag regressions — the bench history must outlive any single
+# round's stdout (ISSUE 4 satellite).
+_RUN_ID = f"{time.strftime('%Y%m%dT%H%M%S')}_{os.getpid()}"
+_TRAJECTORY_PATH = os.environ.get(
+    "BENCH_TRAJECTORY",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_TRAJECTORY.jsonl"))
+
+
+def _append_trajectory():
+    """Mirror the current record into the trajectory file, each line
+    carrying the timer's phase totals so bench_compare.py can diff
+    per-phase, not just the headline number. A run that emits twice
+    appends twice — the reader (bench_compare.load_trajectory) keeps
+    each run_id's last line, so a plain O(1) append suffices and
+    concurrent runs cannot erase each other's records the way a
+    read-modify-replace would. Must never kill a run."""
+    rec = dict(_RESULT)
+    rec["run_id"] = _RUN_ID
+    rec["ts"] = round(time.time(), 3)
+    try:
+        from lightgbm_tpu.utils.timer import global_timer
+        rec["phase_timings"] = {
+            name: {"total": round(st.total, 4), "count": st.count}
+            for name, st in global_timer.stats().items()}
+    except Exception:
+        pass
+    try:
+        with open(_TRAJECTORY_PATH, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except Exception as e:
+        print(f"bench trajectory append failed: {e}", file=sys.stderr)
+
 
 def _phase(name: str):
     _TAIL["phases"].append({"phase": name, "t": round(time.time() - _T0, 3)})
@@ -61,6 +96,7 @@ def _attach_tail():
 
 def _emit():
     print(json.dumps(_RESULT), flush=True)
+    _append_trajectory()
 
 
 def _die_with_record(reason: str):
@@ -303,6 +339,10 @@ def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", 10_500_000))
     n_feat = 28
     n_iters = int(os.environ.get("BENCH_ITERS", 10))
+    # the trajectory record carries the run shape so bench_compare.py
+    # only diffs like-for-like (a 20k-row smoke next to a full run would
+    # otherwise flag order-of-magnitude fake regressions)
+    _RESULT["bench_config"] = {"rows": n_rows, "iters": n_iters}
     baseline_sec_per_iter = 130.094 / 500  # ref: docs/Experiments.rst:113
 
     X, y = _make_data(n_rows, n_feat)

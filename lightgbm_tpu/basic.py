@@ -481,9 +481,16 @@ class Booster:
 
     def _finalize_telemetry(self) -> None:
         """End-of-training telemetry epilogue (engine.train calls this):
-        profiler stop + summary event + JSONL flush."""
+        profiler stop + summary event + trace export + JSONL flush."""
         if self._gbdt is not None:
             self._gbdt.finalize_telemetry()
+
+    def _dump_crash(self, exc: BaseException) -> None:
+        """Crash flight recorder hook (engine.train calls this when an
+        exception unwinds out of the train loop): dump the telemetry
+        ring + section stack + config to <telemetry_out>.crash.json."""
+        if self._gbdt is not None:
+            self._gbdt.dump_crash(exc)
 
     def _drain(self) -> None:
         """Materialise any device trees still queued by the training fast

@@ -34,6 +34,14 @@ from ..utils import random as ref_random
 K_EPSILON = 1e-15
 
 
+@jax.jit
+def _count_nonfinite(grad, hess):
+    """NaN/Inf element counts for the numerical guards (one fused
+    reduction; on sharded inputs the replicated scalars come back to
+    every rank, so the guard works unchanged under multi-process)."""
+    return (jnp.sum(~jnp.isfinite(grad)), jnp.sum(~jnp.isfinite(hess)))
+
+
 class _SecHandle:
     """Late-bound sync target for a timed section: the arrays to block
     on are produced INSIDE the section body (``with self._sec(..) as s:
@@ -172,6 +180,9 @@ class GBDT:
         # call is a single attribute check until telemetry_out or
         # record_telemetry enables it
         self.telemetry = Telemetry()
+        self._health = None
+        self._trace_out = ""
+        self._trace_written = False
         self._prof_dir = ""
         self._prof_start = 0
         self._prof_n = -1
@@ -300,14 +311,31 @@ class GBDT:
         the registry."""
         tel = self.telemetry
         out = str(getattr(config, "telemetry_out", "") or "")
-        if out:
+        self._trace_out = str(getattr(config, "trace_out", "") or "")
+        period = int(getattr(config, "health_check_period", 0) or 0)
+        if out or self._trace_out or period > 0:
             # enable() attaches the sink even when the registry is
             # already on sink-less (record_telemetry first, then
-            # reset_parameter(telemetry_out=...) must still get a file)
-            had_sink = tel._sink is not None
-            tel.enable(sink_path=out)
-            if not had_sink:
+            # reset_parameter(telemetry_out=...) must still get a file);
+            # it reports whether THIS call attached a new sink, so the
+            # enablement event fires once per stream, and trace_out /
+            # health_check_period enable the registry sink-less
+            newly_attached = tel.enable(sink_path=out or None,
+                                        trace=bool(self._trace_out))
+            if newly_attached:
                 tel.event("telemetry_enabled", sink=out)
+        elif tel.enabled:
+            # every observability key cleared on an already-enabled
+            # registry (reset_parameter round trip): span collection
+            # must stop too, or each section keeps paying the append
+            # with no exporter left to drain it
+            tel.enable(trace=False)
+        self._health = None
+        if period > 0:
+            from ..obs.health import HealthAuditor
+            self._health = HealthAuditor(
+                tel, period,
+                float(getattr(config, "health_skew_threshold", 2.0)))
         self._prof_dir = str(getattr(config, "profile_dir", "") or "")
         self._prof_start = max(
             0, int(getattr(config, "profile_start_iteration", 0)))
@@ -327,17 +355,22 @@ class GBDT:
             yield _NULL_SEC
             return
         h = _SecHandle()
+        tel.push_section(name)   # crash flight recorder's "where"
+        w0 = tel.wall_now()
         t0 = time.perf_counter()
-        try:
-            yield h
-        finally:
-            if h._sync is not None:
-                jax.block_until_ready(h._sync)
-            dt = time.perf_counter() - t0
-            if timing:
-                timer.add("GBDT::" + name, dt)
-            if tel.enabled:
-                tel.section(name, dt)
+        # everything below the yield runs on CLEAN exit only: an
+        # exception must leave the section on the stack so the crash
+        # flight recorder can dump where training was (a finally-pop
+        # would erase the evidence during unwind)
+        yield h
+        if h._sync is not None:
+            jax.block_until_ready(h._sync)
+        dt = time.perf_counter() - t0
+        tel.pop_section()
+        if timing:
+            timer.add("GBDT::" + name, dt)
+        if tel.enabled:
+            tel.section(name, dt, wall_start=w0)
 
     def _profiler_step(self) -> None:
         """Open/close the jax.profiler trace window at iteration edges
@@ -398,7 +431,89 @@ class GBDT:
             tel.event("summary", iteration=self.iter,
                       counters=snap["counters"],
                       timings=snap["timings"])
+        self._export_trace()
         tel.flush()
+
+    def _export_trace(self) -> None:
+        """Write the Chrome-trace timeline (trace_out): drain this
+        rank's spans, allgather them under multi-process (SPMD — every
+        rank reaches finalize), and let rank 0 write the merged file
+        with one track per rank."""
+        tel = self.telemetry
+        if not self._trace_out or self._trace_written:
+            return
+        self._trace_written = True
+        # each rank ships its dropped-span count with its spans: a ring
+        # overflow on ANY rank truncates that rank's track, so rank 0's
+        # local counter alone cannot vouch for the merged file
+        local = {"spans": tel.drain_spans(),
+                 "dropped": int(tel.snapshot()["counters"].get(
+                     "trace.spans_dropped", 0))}
+        if getattr(self, "mp", None) is not None:
+            from ..obs import allgather_json
+            payloads = allgather_json(local)
+        else:
+            payloads = [local]
+        if tel.rank != 0:
+            return
+        from ..obs import trace as trace_mod
+        per_rank = [p["spans"] for p in payloads]
+        try:
+            trace_mod.write_trace(self._trace_out, per_rank)
+        except Exception as e:
+            log.warning("trace export to %s failed: %s",
+                        self._trace_out, e)
+            return
+        dropped = sum(int(p.get("dropped", 0)) for p in payloads)
+        tel.event("trace_written", path=self._trace_out,
+                  spans=sum(len(s) for s in per_rank), dropped=dropped)
+        if dropped:
+            log.warning("trace span ring overflowed: %d spans were "
+                        "evicted across ranks, %s starts mid-run",
+                        dropped, self._trace_out)
+        log.info("Chrome trace written to %s", self._trace_out)
+
+    def dump_crash(self, exc: BaseException) -> Optional[str]:
+        """Crash flight recorder: on an exception unwinding out of the
+        train loop, dump the telemetry event ring, the live section
+        stack, the counter/gauge state and a config snapshot to
+        ``<telemetry_out>.crash.json`` (rank-suffixed like the JSONL
+        sink) so a dead run leaves evidence, not just a traceback.
+        Returns the path written, or None (recorder off / no
+        telemetry_out). Must never raise — it runs on the unwind path."""
+        tel = self.telemetry
+        out = (str(getattr(self.config, "telemetry_out", "") or "")
+               if self.config is not None else "")
+        if not tel.enabled or not out:
+            return None
+        import json as _json
+        import traceback as _tb
+        path = out + ".crash.json"
+        if tel.rank:
+            path += f".rank{tel.rank}"
+        try:
+            payload = {
+                "ts": time.time(),
+                "rank": tel.rank,
+                "iteration": int(self.iter),
+                "exception": {
+                    "type": type(exc).__name__,
+                    "message": str(exc)[:4000],
+                    "traceback": _tb.format_exception(
+                        type(exc), exc, exc.__traceback__, limit=50),
+                },
+                "config": self.config.to_dict(),
+                "telemetry": tel.crash_payload(),
+            }
+            tel.flush()
+            with open(path, "w") as fh:
+                _json.dump(payload, fh, indent=1, default=str)
+        except Exception as dump_err:
+            log.warning("crash flight recorder failed: %s", dump_err)
+            return None
+        log.warning("training crashed (%s); flight record written to %s",
+                    type(exc).__name__, path)
+        return path
 
     # ------------------------------------------------------------------
     def _setup_bundles(self, config: Config, train_data) -> None:
@@ -2557,9 +2672,11 @@ class GBDT:
 
             grad, hess = self._bagging(self.iter, grad, hess)
             s.sync((grad, hess))
+        self._guard_gradients(it, grad, hess)
 
         should_continue = False
         nl_per_class = []
+        gain_acc: List[np.ndarray] = []
         for tid in range(k):
             if self.class_need_train[tid] and self.train_data.num_features > 0:
                 gh = jnp.stack([grad[tid] * self.bag_weight,
@@ -2581,6 +2698,7 @@ class GBDT:
                 with self._sec("tree_materialize"):
                     ht, sf_inner = self._to_host_tree(tree,
                                                       self.shrinkage_rate)
+                    self._guard_tree(it, tid, ht, gain_acc)
                     if self.use_cegb:
                         for f in sf_inner:
                             if f >= 0:
@@ -2694,16 +2812,74 @@ class GBDT:
                     self.device_trees.pop()
             return True
         if tel.enabled:
-            self._emit_iteration_record(it, nl_per_class)
+            rec = self._emit_iteration_record(it, nl_per_class, gain_acc)
+            if self._health is not None and self._health.due(it):
+                try:
+                    self._health.check(it, self.models,
+                                       rec.get("sections") or {})
+                except Exception as e:
+                    # rank-local failures degrade to a sentinel INSIDE
+                    # check (so the collective still pairs up); reaching
+                    # here means the allgather itself failed. Single
+                    # process that is survivable — disable and move on.
+                    # Multi-process it is NOT: a one-sided failure (e.g.
+                    # a timeout) leaves peers blocked in — or past — the
+                    # audit collective, and any rank-local recovery
+                    # desynchronizes every later host collective, so
+                    # re-raise and let the crash flight recorder dump
+                    if getattr(self, "mp", None) is not None:
+                        raise
+                    self._health = None
+                    log.warning("health check failed at iteration %d; "
+                                "auditing disabled for the rest of the "
+                                "run: %s", it, e)
         self.iter += 1
         return False
 
-    def _emit_iteration_record(self, it: int, nl_per_class: List[int]
-                               ) -> None:
+    # ------------------------------------------------ numerical guards
+    def _guard_gradients(self, it: int, grad, hess) -> None:
+        """NaN/Inf detection on the gradient/hessian tensors (sync path
+        only — gated on the registry like the sections; one fused device
+        reduction per iteration)."""
+        if not self.telemetry.enabled:
+            return
+        try:
+            bad_g, bad_h = _count_nonfinite(grad, hess)
+            bad_g, bad_h = int(bad_g), int(bad_h)
+        except Exception as e:      # a guard must never kill training
+            log.debug("gradient guard failed: %s", e)
+            return
+        if bad_g or bad_h:
+            self.telemetry.anomaly("nonfinite_grad_hess", iteration=it,
+                                   grad=bad_g, hess=bad_h)
+
+    def _guard_tree(self, it: int, tid: int, ht: HostTree,
+                    gain_acc: List[np.ndarray]) -> None:
+        """Post-materialize guards: non-finite leaf values / leaf
+        weights (hessian sums — the histogram outputs' downstream image)
+        or split gains raise an anomaly event; finite gains accumulate
+        for the iteration record's split-gain distribution stats."""
+        if not self.telemetry.enabled:
+            return
+        gains = np.asarray(ht.split_gain, np.float64)
+        bad = {"leaf_values": int(np.count_nonzero(
+                   ~np.isfinite(np.asarray(ht.leaf_value, np.float64)))),
+               "leaf_weights": int(np.count_nonzero(
+                   ~np.isfinite(np.asarray(ht.leaf_weight, np.float64)))),
+               "gains": int(np.count_nonzero(~np.isfinite(gains)))}
+        if any(bad.values()):
+            self.telemetry.anomaly("nonfinite_tree", iteration=it,
+                                   tree=tid, **bad)
+        if gains.size:
+            gain_acc.append(gains[np.isfinite(gains)])
+
+    def _emit_iteration_record(self, it: int, nl_per_class: List[int],
+                               gain_acc: Optional[List[np.ndarray]] = None
+                               ) -> Dict:
         """Close iteration ``it``'s telemetry record: estimated collective
         traffic for the distributed growers (the multiproc host-plane
         allgathers are counted for real by MultiProcLayout), device
-        memory, per-class leaf counts."""
+        memory, per-class leaf counts, split-gain distribution stats."""
         tel = self.telemetry
         if self.parallel_mode != "serial":
             # analytic estimate of the in-jit psum payloads this
@@ -2725,11 +2901,22 @@ class GBDT:
                  "engine": ("fused" if self.use_fused else
                             "frontier" if self.use_frontier else "xla"),
                  "mode": self.parallel_mode}
+        if gain_acc is not None:
+            # the key is always present so count == 0 (no finite gains
+            # at all — the broken-gradients symptom the docs point
+            # monitoring at) is an observable value, not a missing field
+            gains = (np.concatenate(gain_acc) if gain_acc
+                     else np.empty(0, np.float64))
+            sg = {"count": int(gains.size)}
+            if gains.size:
+                sg.update(min=float(gains.min()), max=float(gains.max()),
+                          mean=float(gains.mean()))
+            extra["split_gain"] = sg
         mem = device_memory_stats()
         if mem:
             extra["memory"] = mem
             tel.gauge("device.bytes_in_use", mem.get("bytes_in_use", 0))
-        tel.end_iteration(it, **extra)
+        return tel.end_iteration(it, **extra)
 
     # ------------------------------------------------------------------
     def reset_config(self, config: Config) -> None:
@@ -2894,7 +3081,18 @@ class GBDT:
 
     def train(self) -> None:
         """Full training loop (ref: gbdt.cpp:266 Train). Snapshotting lives
-        in engine.train (the driver that owns output paths)."""
+        in engine.train (the driver that owns output paths). Any
+        exception unwinding out of the loop triggers the crash flight
+        recorder (dump_crash) before re-raising."""
+        try:
+            self._train_loop()
+        except BaseException as exc:
+            # BaseException: a Ctrl-C on a wedged run must still dump
+            self.dump_crash(exc)
+            raise
+        self.finalize_telemetry()
+
+    def _train_loop(self) -> None:
         for it in range(self.iter, int(self.config.num_iterations)):
             finished = self.train_one_iter()
             if not finished:
@@ -2916,7 +3114,6 @@ class GBDT:
                     self.iter = best
             if finished:
                 break
-        self.finalize_telemetry()
 
     # ------------------------------------------------------------------
     @property

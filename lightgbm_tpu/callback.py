@@ -104,7 +104,15 @@ def record_telemetry(telemetry_result: Dict[str, Any]):
         if tel is None:
             return
         _drain(tel)
-        telemetry_result["summary"] = tel.snapshot()
+        snap = tel.snapshot()
+        telemetry_result["summary"] = snap
+        # surface the health/guard findings (anomaly events, rank
+        # divergence, stragglers) as a first-class list — callers
+        # checking run health should not have to sift the event ring.
+        # The registry keeps findings in their own ring, so early ones
+        # survive long runs that evict them from the general event ring;
+        # the key is always present (empty list == healthy run)
+        telemetry_result["anomalies"] = snap.get("findings", [])
     _callback.finalize = _finalize
     return _callback
 

@@ -12,9 +12,13 @@ wins), tasks train / predict / refit-free convert paths:
         input_model=model.txt output_result=preds.tsv
 
 Observability flags (docs/Observability.md): ``telemetry_out=<path>``
-streams per-iteration JSONL telemetry, ``profile_dir=<dir>`` captures a
-jax.profiler trace of the training loop — both are ordinary config keys,
-so they work from the command line and from config files alike.
+streams per-iteration JSONL telemetry, ``trace_out=<path>`` exports a
+Perfetto/Chrome-trace timeline (one track per rank),
+``health_check_period=N`` turns on the cross-rank health auditor, and
+``profile_dir=<dir>`` captures a jax.profiler trace of the training
+loop — all ordinary config keys, so they work from the command line and
+from config files alike. On a crash with ``telemetry_out`` set, the
+flight recorder dumps ``<telemetry_out>.crash.json``.
 """
 from __future__ import annotations
 
@@ -77,6 +81,10 @@ def run_train(params: Dict[str, str]) -> None:
     tel_out = params.get("telemetry_out", params.get("telemetry_output"))
     if tel_out:
         log.info("Telemetry JSONL written to %s", tel_out)
+    trace_out = params.get("trace_out", params.get("trace_output"))
+    if trace_out:
+        log.info("Load %s in chrome://tracing or ui.perfetto.dev",
+                 trace_out)
 
 
 def run_predict(params: Dict[str, str]) -> None:

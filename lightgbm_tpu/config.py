@@ -281,6 +281,26 @@ _PARAMS: List[_Param] = [
     _p("profile_num_iterations", int, -1,
        desc="iterations covered by the profile_dir trace; <0 = until "
             "training ends"),
+    _p("trace_out", str, "", ("trace_output", "trace_file"),
+       desc="path: export a Perfetto/Chrome-trace JSON timeline of the "
+            "training run — one track per rank, spans for the driver "
+            "sections (boosting/histogram_split/tree_materialize/"
+            "score_update), collectives, XLA compiles and health "
+            "checks; loadable in chrome://tracing or ui.perfetto.dev. "
+            "Implies telemetry (synchronous driver); multi-process runs "
+            "merge every rank's spans into rank 0's file"),
+    _p("health_check_period", int, 0, ("health_check_freq",),
+       check=(">=", 0),
+       desc="every N iterations hash the model state (leaf values + "
+            "split params) and allgather per-rank section times, "
+            "emitting rank_divergence events when ranks disagree and "
+            "straggler events when section-time skew exceeds "
+            "health_skew_threshold; 0 = off. Implies telemetry "
+            "(synchronous driver)"),
+    _p("health_skew_threshold", float, 2.0,
+       ("straggler_skew_threshold",), check=(">", 1.0),
+       desc="max/median per-section time ratio across ranks at or above "
+            "which the health auditor emits a straggler event"),
 ]
 
 _BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}

@@ -110,37 +110,52 @@ def train(params: Dict[str, Any], train_set: Dataset,
     evaluation_result_list: List = []
     i = -1
     for i in range(num_boost_round):
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
-        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            # periodic checkpoint (ref: gbdt.cpp:279-283 SaveModelToFile
-            # snapshot_out); the text model is the checkpoint format
-            # snapshots are resume checkpoints: keep the full model
-            booster.save_model(f"{snapshot_base}.snapshot_iter_{i + 1}",
-                               num_iteration=-1)
-
-        evaluation_result_list = []
-        if valid_sets is not None or feval is not None:
-            if train_in_valid or (feval is not None
-                                  and booster._gbdt.training_metrics):
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
         try:
-            for cb in callbacks_after:
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            evaluation_result_list = es.best_score
-            break
-        if finished:
-            break
+                    evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                # periodic checkpoint (ref: gbdt.cpp:279-283
+                # SaveModelToFile snapshot_out); the text model is the
+                # checkpoint format — snapshots are resume checkpoints:
+                # keep the full model
+                booster.save_model(
+                    f"{snapshot_base}.snapshot_iter_{i + 1}",
+                    num_iteration=-1)
+
+            evaluation_result_list = []
+            if valid_sets is not None or feval is not None:
+                if train_in_valid or (feval is not None
+                                      and booster._gbdt.training_metrics):
+                    evaluation_result_list.extend(
+                        booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                evaluation_result_list = es.best_score
+                break
+            if finished:
+                break
+        except callback_mod.EarlyStopException:
+            raise   # control flow, not a crash
+        except BaseException as exc:
+            # crash flight recorder: anything unwinding out of the train
+            # loop — the update itself, a callback, eval, or a snapshot
+            # write — lands the ring buffer + section stack + config in
+            # <telemetry_out>.crash.json before reaching the caller.
+            # BaseException, not Exception: Ctrl-C on a wedged run is
+            # the flight recorder's primary "where was it stuck" case
+            booster._dump_crash(exc)
+            raise
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for name, metric, value, _ in (evaluation_result_list or []):

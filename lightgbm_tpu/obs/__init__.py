@@ -13,15 +13,22 @@ package is the permanent, low-overhead replacement:
 - :class:`JsonlSink` (events.py) — the rank-aware JSONL writer behind
   ``telemetry_out=<path>``;
 - jaxmon.py — ``jax.monitoring`` bridge (XLA compile events) and device
-  memory stats.
+  memory stats;
+- trace.py — Perfetto/Chrome-trace exporter behind ``trace_out=<path>``
+  (one track per rank, spans for sections/collectives/compiles);
+- :class:`HealthAuditor` (health.py) — periodic cross-rank model-hash +
+  straggler auditing behind ``health_check_period``.
 
 Every recording method is a no-op behind a single attribute check while
 the registry is disabled, so instrumentation stays in the hot driver
 paths permanently, like the reference's TIMETAG sections.
 """
 from .events import JsonlSink
+from .health import HealthAuditor, model_state_hash
 from .jaxmon import device_memory_stats
 from .registry import Telemetry, allgather_json
+from .trace import chrome_trace_events, write_trace
 
 __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
-           "allgather_json"]
+           "allgather_json", "HealthAuditor", "model_state_hash",
+           "chrome_trace_events", "write_trace"]

@@ -12,6 +12,12 @@ One JSON object per line.  Schema (docs/Observability.md):
 Multi-process runs write one file per rank: rank 0 owns the configured
 path, rank r writes ``<path>.rank<r>`` (a shared file over NFS would
 interleave partial lines).
+
+Lifecycle: the FIRST open of a path in this process truncates it (a
+fresh run starts a fresh stream); any later re-open — a
+``reset_parameter(telemetry_out=...)`` re-enable after a close, or a
+second booster pointed at the same file — appends, so an established
+stream is never clobbered mid-process.
 """
 from __future__ import annotations
 
@@ -19,6 +25,10 @@ import atexit
 import json
 import threading
 from typing import Any, Dict
+
+# paths this process has already opened: re-opens append (see module
+# docstring) instead of truncating the earlier records
+_OPENED_PATHS = set()
 
 
 def _json_default(o: Any):
@@ -37,11 +47,17 @@ class JsonlSink:
     records are per-iteration scale, not per-op scale)."""
 
     def __init__(self, path: str, rank: int = 0):
+        # the path as configured, BEFORE rank suffixing — Telemetry.enable
+        # compares against it to decide whether a re-enable is the same
+        # sink or a genuine re-target
+        self.requested_path = path
         if rank:
             path = f"{path}.rank{rank}"
         self.path = path
         self._lock = threading.Lock()
-        self._fh = open(path, "w", buffering=1)
+        mode = "a" if path in _OPENED_PATHS else "w"
+        self._fh = open(path, mode, buffering=1)
+        _OPENED_PATHS.add(path)
         atexit.register(self.close)
 
     def write(self, record: Dict[str, Any]) -> None:

@@ -1,0 +1,141 @@
+"""Cross-rank training-health auditing.
+
+An SPMD run that silently diverges (one rank's model drifts from the
+others') or straggles (one rank's sections run far slower, stalling every
+collective) leaves no evidence until the final model is wrong — the
+failure class the multi-chip deployment must survive (ROADMAP north
+star; the reference's socket layer had the same blind spot, SURVEY §2.8).
+Every ``health_check_period`` iterations the auditor:
+
+1. hashes the rank-local model state — leaf values + split parameters of
+   every materialized tree (under the SPMD contract all ranks grow
+   identical trees, so the digests must agree bit-for-bit);
+2. allgathers ``{hash, section times}`` across ranks (one small
+   host-plane collective via :func:`registry.allgather_json` — every
+   rank must reach the check at the same iteration, which the shared
+   config guarantees);
+3. emits a ``health_check`` event, a ``rank_divergence`` event when the
+   hashes differ, and per-section ``straggler`` events + skew gauges
+   when the max/median section-time ratio exceeds
+   ``health_skew_threshold``.
+
+Fault injection for tests: set ``LIGHTGBM_TPU_HEALTH_FAULT_RANK=<r>`` to
+salt rank r's digest — the two-process driver test forces a divergence
+without mistraining anything.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+FAULT_RANK_ENV = "LIGHTGBM_TPU_HEALTH_FAULT_RANK"
+
+
+def model_state_hash(models, rank: int = 0) -> str:
+    """SHA-256 over every tree's leaf values and split parameters
+    (feature, bin + real threshold, decision type) in model order.
+    Deterministic across ranks when — and only when — the ranks hold the
+    same model.
+
+    Deliberately a FULL re-hash per call, not an incremental chain over
+    newly appended trees: boosting modes mutate already-materialized
+    trees in place (DART normalization, RF renewal, rollback pops), and
+    an incremental digest would be blind to exactly the divergence class
+    the auditor exists to catch. The full pass is tobytes over small
+    arrays — milliseconds even at thousands of trees."""
+    h = hashlib.sha256()
+    for t in models:
+        for arr, dt in ((t.leaf_value, np.float64),
+                        (t.split_feature, np.int32),
+                        (t.threshold, np.float64),
+                        (t.threshold_bin, np.int32),
+                        (t.decision_type, np.int32)):
+            h.update(np.ascontiguousarray(
+                np.asarray(arr, dtype=dt)).tobytes())
+    fault = os.environ.get(FAULT_RANK_ENV, "")
+    if fault:
+        try:
+            if int(fault) == int(rank):
+                h.update(b"injected-fault")
+        except ValueError:
+            pass
+    return h.hexdigest()
+
+
+class HealthAuditor:
+    """Periodic cross-rank consistency + straggler checks.
+
+    Owned by the GBDT driver (one per booster, like the Telemetry
+    registry it reports into); ``check`` must be called from the
+    synchronous path on EVERY rank at the same iteration — the driver
+    guarantees that by gating on ``(it + 1) % period`` of the shared
+    config.
+    """
+
+    def __init__(self, telemetry, period: int,
+                 skew_threshold: float = 2.0):
+        self.telemetry = telemetry
+        self.period = max(0, int(period))
+        self.skew_threshold = float(skew_threshold)
+
+    def due(self, it: int) -> bool:
+        return self.period > 0 and (int(it) + 1) % self.period == 0
+
+    def check(self, it: int, models,
+              sections: Optional[Dict[str, float]] = None) -> bool:
+        """Run one audit round; returns True when every rank agrees.
+        SPMD: contains a host-plane allgather — all ranks, same point."""
+        tel = self.telemetry
+        from .registry import allgather_json
+        wall0 = tel.wall_now()
+        t0 = time.perf_counter()
+        # a rank-local failure (hashing, payload building) must NOT skip
+        # the allgather: every rank entered this check, and a rank that
+        # bails early leaves its peers' collective pairing with the next
+        # iteration's host allgather — so degrade to a sentinel payload
+        # that still participates (the hash mismatch then reports it)
+        try:
+            local: Dict[str, Any] = {
+                "rank": tel.rank,
+                "hash": model_state_hash(models, rank=tel.rank),
+                "sections": {k: float(v)
+                             for k, v in (sections or {}).items()},
+            }
+        except Exception as e:
+            local = {"rank": tel.rank,
+                     "hash": f"error:{type(e).__name__}",
+                     "sections": {}}
+        per_rank: List[Dict[str, Any]] = allgather_json(local)
+        dt = time.perf_counter() - t0
+        ok = len({r["hash"] for r in per_rank}) == 1
+        tel.inc("health.checks")
+        tel.event("health_check", iteration=it, ok=ok,
+                  ranks=len(per_rank), models=len(models))
+        tel.span("health_check", wall0, dt, track="health", iteration=it)
+        if not ok:
+            # every rank emits into its own stream (separate JSONL files)
+            # so the evidence survives whichever rank is inspected
+            tel.inc("health.rank_divergence")
+            tel.event("rank_divergence", iteration=it,
+                      hashes={str(r["rank"]): r["hash"][:16]
+                              for r in per_rank})
+        names = sorted({n for r in per_rank for n in r["sections"]})
+        for name in names:
+            times = [float(r["sections"].get(name, 0.0)) for r in per_rank]
+            med = float(np.median(times))
+            if med <= 0.0:
+                continue
+            skew = max(times) / med
+            tel.gauge("health.skew." + name, skew)
+            if len(per_rank) > 1 and skew >= self.skew_threshold:
+                slowest = int(per_rank[int(np.argmax(times))]["rank"])
+                tel.inc("health.straggler")
+                tel.event("straggler", iteration=it, section=name,
+                          skew=round(skew, 3), slowest_rank=slowest,
+                          max_seconds=round(max(times), 9),
+                          median_seconds=round(med, 9))
+        return ok

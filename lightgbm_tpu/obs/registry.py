@@ -11,7 +11,10 @@ One :class:`Telemetry` instance per booster (GBDT driver).  It holds
 - **events** — a bounded ring of structured records, mirrored to the
   JSONL sink when one is attached (``telemetry_out=<path>``);
 - **records** — completed per-iteration records queued for the
-  ``record_telemetry`` callback to drain.
+  ``record_telemetry`` callback to drain;
+- **spans** — wall-clock (start, duration) pairs collected only when the
+  trace exporter is on (``trace_out=<path>``), drained by obs.trace into
+  a Perfetto/Chrome-trace timeline (one track per rank).
 
 Disabled-path contract: every recording method returns after a single
 ``self.enabled`` attribute check — no allocation, no locking, no
@@ -32,6 +35,10 @@ from typing import Any, Dict, List, Optional
 
 _EVENT_RING = 512       # bounded in-memory event history
 _RECORD_RING = 65536    # per-iteration records awaiting a drain
+_SPAN_RING = 16384      # trace spans awaiting export (a few per iteration)
+_FINDING_RING = 1024    # health/guard findings kept for the whole run
+_FINDING_EVENTS = frozenset(
+    {"anomaly", "rank_divergence", "straggler"})
 
 
 class Telemetry:
@@ -44,11 +51,22 @@ class Telemetry:
         self._gauges: Dict[str, float] = {}
         self._timings: Dict[str, Dict[str, float]] = {}
         self._events = collections.deque(maxlen=_EVENT_RING)
+        self._findings = collections.deque(maxlen=_FINDING_RING)
         self._records = collections.deque(maxlen=_RECORD_RING)
+        self._spans = collections.deque(maxlen=_SPAN_RING)
+        self._trace_on = False
+        # trace timebase: wall-clock epoch + monotonic offsets, so span
+        # timestamps stay comparable ACROSS ranks (shared epoch) yet a
+        # mid-run NTP step cannot un-nest spans WITHIN a rank the way
+        # raw time.time() starts + perf_counter durations would
+        self._perf_epoch = time.time() - time.perf_counter()
         self._sink = None
         self._rank: Optional[int] = None
+        # live section nesting (crash flight recorder reads this)
+        self._section_stack: List[str] = []
         # per-iteration scratch (begin_iteration .. end_iteration)
         self._cur_iter: Optional[int] = None
+        self._cur_iter_wall: Optional[float] = None
         self._cur_sections: Dict[str, float] = {}
         self._cur_collectives: Dict[str, Dict[str, int]] = {}
         self._cur_compile: Dict[str, float] = {}
@@ -64,16 +82,43 @@ class Telemetry:
                 self._rank = 0
         return self._rank
 
-    def enable(self, sink_path: Optional[str] = None) -> None:
+    def enable(self, sink_path: Optional[str] = None,
+               trace: Optional[bool] = None) -> bool:
         """Turn recording on; ``sink_path`` additionally streams every
-        event as a JSONL line (rank-suffixed under multi-process)."""
+        event as a JSONL line (rank-suffixed under multi-process) and
+        ``trace`` switches wall-clock span collection for the trace
+        exporter on/off (``None`` leaves it as is, so an enable() from a
+        path that doesn't know about tracing — e.g. record_telemetry —
+        can't silently stop an active collection).  Returns True when a
+        NEW sink was attached by this call (re-enabling with the path
+        already attached is a no-op, so a
+        ``reset_parameter(telemetry_out=...)`` round trip neither
+        clobbers nor duplicates the stream; a *different* path closes
+        the old sink and opens the new one)."""
         from . import jaxmon
         from .events import JsonlSink
+        attached = False
         with self._lock:
-            if sink_path and self._sink is None:
-                self._sink = JsonlSink(sink_path, rank=self.rank)
+            if sink_path:
+                old = self._sink
+                if old is not None and old.requested_path != sink_path:
+                    old.close()
+                    self._sink = None
+                if self._sink is None:
+                    self._sink = JsonlSink(sink_path, rank=self.rank)
+                    attached = True
+            if trace is not None:
+                self._trace_on = bool(trace)
             self.enabled = True
         jaxmon.attach(self)
+        return attached
+
+    @property
+    def sink_path(self) -> Optional[str]:
+        """Path of the attached JSONL sink (rank-suffixed), or None —
+        the public view drivers should use instead of ``_sink``."""
+        sink = self._sink
+        return None if sink is None else sink.path
 
     def disable(self) -> None:
         from . import jaxmon
@@ -133,11 +178,28 @@ class Telemetry:
         rec.update(attrs)
         with self._lock:
             self._events.append(rec)
+            if name in _FINDING_EVENTS:
+                # health/guard findings survive in their own ring: the
+                # general event ring evicts them within ~500 iterations,
+                # but "did anything go wrong" must answer for the whole
+                # run (record_telemetry's anomalies list reads this)
+                self._findings.append(rec)
             key = "events." + name
             self._counters[key] = self._counters.get(key, 0) + 1
             sink = self._sink
         if sink is not None:
             sink.write(rec)
+
+    def anomaly(self, kind: str, iteration: Optional[int] = None,
+                **attrs: Any) -> None:
+        """Numerical-guard finding (non-finite gradients, histogram or
+        tree outputs, degenerate gain distributions): counted under
+        ``anomalies.<kind>`` and emitted as a structured ``anomaly``
+        event — the record IS the alarm, not a log string."""
+        if not self.enabled:
+            return
+        self.inc("anomalies." + kind)
+        self.event("anomaly", iteration=iteration, kind=kind, **attrs)
 
     def degrade(self, reason: str, **attrs: Any) -> None:
         """A requested mode/engine fell back: the reason is the record,
@@ -148,29 +210,113 @@ class Telemetry:
         self.inc("degrade." + reason)
         self.event("degrade", reason=reason, **attrs)
 
+    # ------------------------------------------------------ trace spans
+    def wall_now(self) -> float:
+        """Monotonic 'wall clock' for span starts: the process-start
+        wall epoch plus a perf_counter offset.  Every span producer must
+        use this (not time.time()) so durations and starts share one
+        clock and nesting survives NTP steps."""
+        return self._perf_epoch + time.perf_counter()
+
+    def span(self, name: str, wall_start: float, seconds: float,
+             track: str = "train", iteration: Optional[int] = None,
+             **attrs: Any) -> None:
+        """Wall-clock span for the trace exporter (collected only while
+        ``trace_out`` turned span collection on; ``seconds == 0`` renders
+        as an instant event)."""
+        if not (self.enabled and self._trace_on):
+            return
+        rec: Dict[str, Any] = {"name": name, "ts": float(wall_start),
+                               "dur": float(seconds), "rank": self.rank,
+                               "track": track}
+        if iteration is not None:
+            rec["iter"] = int(iteration)
+        if attrs:
+            rec["args"] = attrs
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                # ring is full: the append below evicts the oldest span,
+                # truncating the front of the exported timeline — count
+                # it so trace_written can say so instead of lying
+                self._counters["trace.spans_dropped"] = \
+                    self._counters.get("trace.spans_dropped", 0) + 1
+            self._spans.append(rec)
+
+    def drain_spans(self) -> List[Dict[str, Any]]:
+        """Collected trace spans since the last drain (the trace
+        exporter's feed; cleared so a second finalize writes nothing)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    # ------------------------------------------------- crash bookkeeping
+    def push_section(self, name: str) -> None:
+        """Driver section entry — the stack is what the crash flight
+        recorder dumps as 'where training was' when an exception
+        unwinds."""
+        if self.enabled:
+            self._section_stack.append(name)
+
+    def pop_section(self) -> None:
+        if self.enabled and self._section_stack:
+            self._section_stack.pop()
+
+    def crash_payload(self) -> Dict[str, Any]:
+        """Flight-recorder view: the full event ring (not the JSONL
+        tail, which may be lost in a crash), the live section stack and
+        the counter/gauge state — everything the registry knows at the
+        moment of an exception."""
+        with self._lock:
+            return {
+                "rank": self.rank,
+                "section_stack": list(self._section_stack),
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "events": [dict(e) for e in self._events],
+                "findings": [dict(e) for e in self._findings],
+            }
+
     # ---------------------------------------------------- per-iteration
     def begin_iteration(self, it: int) -> None:
         if not self.enabled:
             return
         with self._lock:
+            # a caught-and-recovered exception leaves its sections on the
+            # stack (pop is clean-exit only); a fresh iteration starting
+            # means the unwind is over, so the stale entries would only
+            # mislead a later crash dump
+            self._section_stack.clear()
             self._cur_iter = int(it)
+            self._cur_iter_wall = self.wall_now()
             self._cur_sections = {}
             self._cur_collectives = {}
             self._cur_compile = {"count": 0, "secs": 0.0}
 
-    def section(self, name: str, seconds: float) -> None:
+    def section(self, name: str, seconds: float,
+                wall_start: Optional[float] = None) -> None:
         """Accumulate a named section's duration into the current
-        iteration record and the global timing distribution."""
+        iteration record and the global timing distribution (plus a
+        trace span when the caller knows the wall-clock start)."""
         if not self.enabled:
             return
         with self._lock:
             self._cur_sections[name] = (self._cur_sections.get(name, 0.0)
                                         + seconds)
             self._observe_locked("section." + name, seconds)
+            it = self._cur_iter
+        if wall_start is not None:
+            self.span(name, wall_start, seconds, track="train",
+                      iteration=it)
 
-    def collective(self, kind: str, count: int, nbytes: int) -> None:
+    def collective(self, kind: str, count: int, nbytes: int,
+                   seconds: Optional[float] = None,
+                   wall_start: Optional[float] = None) -> None:
         """Record collective traffic (count + payload bytes) against the
-        current iteration (if one is open) and the global counters."""
+        current iteration (if one is open) and the global counters.
+        Real (host-plane) collectives pass their measured ``seconds`` —
+        they feed the timing distribution and render as trace spans;
+        analytic in-jit estimates pass none and render as instants."""
         if not self.enabled:
             return
         with self._lock:
@@ -183,6 +329,14 @@ class Telemetry:
                 self._counters.get("collectives.count", 0) + int(count)
             self._counters["collectives.bytes"] = \
                 self._counters.get("collectives.bytes", 0) + int(nbytes)
+            if seconds is not None:
+                self._observe_locked("collective." + kind, seconds)
+        if self._trace_on:
+            self.span(kind,
+                      wall_start if wall_start is not None
+                      else self.wall_now(),
+                      seconds or 0.0, track="collectives",
+                      count=int(count), bytes=int(nbytes))
 
     def compile_event(self, phase: str, seconds: float) -> None:
         """XLA compile phase (fed by obs.jaxmon); attributed to the open
@@ -196,19 +350,29 @@ class Telemetry:
             if self._cur_iter is not None:
                 self._cur_compile["count"] += 1
                 self._cur_compile["secs"] += seconds
+        if self._trace_on:
+            # the monitoring callback fires at phase END; back-date the
+            # span so it occupies its real window on the compile track
+            now = self.wall_now()
+            self.span("compile:" + phase, now - seconds, seconds,
+                      track="compile")
 
-    def end_iteration(self, it: int, **attrs: Any) -> None:
+    def end_iteration(self, it: int, **attrs: Any) -> Dict[str, Any]:
         """Close the iteration: emit its record (sections, collectives,
-        compile activity + caller attrs) and queue it for draining."""
+        compile activity + caller attrs), queue it for draining and
+        return it (the health auditor reads the section times off the
+        returned record)."""
         if not self.enabled:
-            return
+            return {}
         with self._lock:
             sections = {k: round(v, 9)
                         for k, v in self._cur_sections.items()}
             coll = {k: dict(v) for k, v in self._cur_collectives.items()}
             comp = dict(self._cur_compile)
             comp["secs"] = round(comp.get("secs", 0.0), 9)
+            wall0 = self._cur_iter_wall
             self._cur_iter = None
+            self._cur_iter_wall = None
             self._counters["iterations"] = \
                 self._counters.get("iterations", 0) + 1
             rec: Dict[str, Any] = {"ts": time.time(), "rank": self.rank,
@@ -221,6 +385,12 @@ class Telemetry:
             sink = self._sink
         if sink is not None:
             sink.write(rec)
+        if wall0 is not None:
+            # enclosing span on the same track as the section spans, so
+            # a trace viewer nests boosting/histogram_split/... inside it
+            self.span("iteration", wall0, self.wall_now() - wall0,
+                      track="train", iteration=it)
+        return rec
 
     def drain_records(self) -> List[Dict[str, Any]]:
         """Completed iteration records since the last drain (the
@@ -243,6 +413,7 @@ class Telemetry:
                 "gauges": dict(self._gauges),
                 "timings": {k: dict(v) for k, v in self._timings.items()},
                 "events": [dict(e) for e in self._events],
+                "findings": [dict(e) for e in self._findings],
             }
 
 

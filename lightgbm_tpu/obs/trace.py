@@ -1,0 +1,94 @@
+"""Perfetto/Chrome-trace exporter for the telemetry span stream.
+
+``trace_out=<path>`` turns span collection on in the registry; at
+finalize the driver drains every rank's spans (an ``allgather_json``
+under multi-process — span volume is a handful per iteration, bounded by
+the span ring) and rank 0 writes ONE Chrome-trace JSON:
+
+- one *process* track per rank (``pid == rank``, named ``rank <r>``),
+  the timeline view GPU GBDT systems credit for their per-phase wins
+  (PAPERS.md: "GPU-acceleration for Large-scale Tree Boosting");
+- within a rank, threads (tids) group the span kinds: ``train`` holds
+  the per-iteration span with the driver sections
+  (boosting/histogram_split/tree_materialize/score_update/...) nested
+  inside it, ``collectives`` holds host-allgather spans and in-jit psum
+  estimate instants, ``compile`` holds XLA compile phases, ``health``
+  holds the auditor's check spans;
+- spans are ``ph: "X"`` complete events (ts/dur in microseconds);
+  zero-duration records render as ``ph: "i"`` instants.
+
+The output loads directly in ``chrome://tracing`` or
+https://ui.perfetto.dev (see docs/Observability.md for how to read it).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+# stable tid assignment so every rank's tracks line up in the viewer
+_TRACK_ORDER = ("train", "collectives", "compile", "health")
+
+
+def chrome_trace_events(per_rank_spans: List[List[Dict[str, Any]]]
+                        ) -> List[Dict[str, Any]]:
+    """Span dicts (registry schema: name/ts/dur/rank/track/iter/args) ->
+    Chrome-trace event list, one pid per rank with named thread tracks."""
+    events: List[Dict[str, Any]] = []
+    for spans in per_rank_spans:
+        if not spans:
+            continue
+        rank = int(spans[0].get("rank", 0))
+        pid = rank
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_sort_index",
+                       "args": {"sort_index": rank}})
+        tids: Dict[str, int] = {}
+        for s in spans:
+            track = str(s.get("track", "train"))
+            if track not in tids:
+                tids[track] = (_TRACK_ORDER.index(track)
+                               if track in _TRACK_ORDER
+                               else len(_TRACK_ORDER)
+                               + sum(t not in _TRACK_ORDER for t in tids))
+                events.append({"ph": "M", "pid": pid, "tid": tids[track],
+                               "name": "thread_name",
+                               "args": {"name": track}})
+                events.append({"ph": "M", "pid": pid, "tid": tids[track],
+                               "name": "thread_sort_index",
+                               "args": {"sort_index": tids[track]}})
+        for s in spans:
+            track = str(s.get("track", "train"))
+            dur_us = float(s.get("dur", 0.0)) * 1e6
+            args = dict(s.get("args") or {})
+            if "iter" in s:
+                args["iter"] = s["iter"]
+            ev: Dict[str, Any] = {"name": str(s["name"]), "cat": track,
+                                  "pid": pid, "tid": tids[track],
+                                  "ts": float(s["ts"]) * 1e6}
+            if dur_us > 0:
+                ev["ph"] = "X"
+                ev["dur"] = dur_us
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    return events
+
+
+def write_trace(path: str, per_rank_spans: List[List[Dict[str, Any]]]
+                ) -> str:
+    """Write the Chrome-trace JSON atomically (a crash mid-dump must not
+    leave a half-written file where a loadable trace was promised)."""
+    doc = {"traceEvents": chrome_trace_events(per_rank_spans),
+           "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, separators=(",", ":"))
+    os.replace(tmp, path)
+    return path

@@ -50,6 +50,9 @@ import jax.numpy as jnp
 try:  # pragma: no cover - exotic backends fall back to interpret mode
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    # jax < 0.5 names it TPUCompilerParams (same kwargs)
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     HAS_PALLAS = True
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
@@ -414,7 +417,7 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
             jax.ShapeDtypeStruct((1, R), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(bins_T, leaf_T, gh_T, W, tbl)
@@ -476,7 +479,7 @@ def route_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
         out_specs=pl.BlockSpec((1, C), lambda t: (0, t)),
         out_shape=jax.ShapeDtypeStruct((1, R), jnp.int32),
         scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(bins_T, leaf_T, W, tbl)
@@ -646,7 +649,7 @@ def epilogue_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
             jax.ShapeDtypeStruct((8, R), jnp.bfloat16),
         ],
         scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(bins_T, leaf_T, W, tbl, lvp, score_T, ops_T, bag_T)
@@ -689,7 +692,7 @@ def table_lookup(idx_T: jax.Array, table: jax.Array, *,
         ],
         out_specs=pl.BlockSpec((1, C), lambda t: (0, t)),
         out_shape=jax.ShapeDtypeStruct((1, Rp), table.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(idx_T, tblp)

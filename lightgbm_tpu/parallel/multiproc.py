@@ -24,6 +24,7 @@ Layout contract (rank-blocked padded rows):
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -117,14 +118,21 @@ class MultiProcLayout:
     # ------------------------------------------------------------ host
     def _allgather(self, arr: np.ndarray):
         """process_allgather with telemetry accounting (real payloads,
-        not estimates: count 1, bytes = gathered result size)."""
-        out = self._mh.process_allgather(arr)
+        not estimates: count 1, bytes = gathered result size) — timed,
+        so the trace timeline shows each host-plane collective as a real
+        span on the rank's collectives track."""
         tel = self.telemetry
-        if tel is not None and tel.enabled:
-            a = np.asarray(arr)
-            tel.collective("host_allgather", 1,
-                           int(a.size) * int(a.dtype.itemsize)
-                           * int(self.process_count))
+        if tel is None or not tel.enabled:
+            return self._mh.process_allgather(arr)
+        wall0 = tel.wall_now()
+        t0 = time.perf_counter()
+        out = self._mh.process_allgather(arr)
+        dt = time.perf_counter() - t0
+        a = np.asarray(arr)
+        tel.collective("host_allgather", 1,
+                       int(a.size) * int(a.dtype.itemsize)
+                       * int(self.process_count),
+                       seconds=dt, wall_start=wall0)
         return out
 
     def pad_local(self, arr: np.ndarray) -> np.ndarray:

@@ -1,0 +1,176 @@
+"""Diff the two most recent bench records and flag perf regressions.
+
+``bench.py`` appends every run's record (headline sec/iter + the TIMETAG
+timer's phase totals) to ``BENCH_TRAJECTORY.jsonl``; this script compares
+the latest record against the previous one and flags any phase — or the
+headline — that got more than ``--threshold`` (default 15%) slower.
+Per-phase comparison uses mean seconds per call (``total / count``) so
+runs of different lengths (BENCH_ROWS / BENCH_ITERS smoke runs vs full
+rounds) still diff meaningfully; phases whose per-call cost is under
+``--min-seconds`` are skipped as noise.
+
+Usage:
+    python scripts/bench_compare.py [--trajectory PATH] [--threshold 0.15]
+                                    [--min-seconds 0.005] [--fail-on-regress]
+
+Prints one JSON report line; with ``--fail-on-regress`` exits 1 when any
+regression was flagged (the CI smoke gate). Fewer than two comparable
+records is a clean exit with ``"status": "insufficient_history"`` — the
+first run of a fresh trajectory must not fail CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_TRAJECTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_TRAJECTORY.jsonl")
+
+
+def load_trajectory(path: str) -> List[Dict[str, Any]]:
+    """Parse the JSONL trajectory, skipping corrupt lines (a crashed
+    writer must not make the history unreadable)."""
+    records: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"skipping corrupt trajectory line: {line[:80]}",
+                      file=sys.stderr)
+    # bench.py appends one line per emit and may emit the same run twice
+    # (record, then record + failure tail): keep only each run's LAST
+    # line, preserving first-seen order
+    last: Dict[Any, Dict[str, Any]] = {}
+    for i, r in enumerate(records):
+        last[r.get("run_id", i)] = r
+    return list(last.values())
+
+
+def _per_call(phases: Dict[str, Any], name: str) -> Optional[float]:
+    ent = phases.get(name)
+    if not isinstance(ent, dict):
+        return None
+    total = float(ent.get("total", 0.0))
+    count = int(ent.get("count", 0))
+    if count <= 0:
+        return None
+    return total / count
+
+
+def _ratio_entry(name: str, prev: float, cur: float,
+                 threshold: float) -> Dict[str, Any]:
+    ratio = cur / prev if prev > 0 else float("inf")
+    return {"name": name, "prev": round(prev, 6), "cur": round(cur, 6),
+            "ratio": round(ratio, 4),
+            "regressed": ratio > 1.0 + threshold}
+
+
+def compare(prev: Dict[str, Any], cur: Dict[str, Any],
+            threshold: float = 0.15,
+            min_seconds: float = 0.005) -> Dict[str, Any]:
+    """Build the comparison report: headline sec/iter plus every phase
+    present in BOTH records (a phase that appears or disappears is
+    reported informationally, not flagged — engine degradation changes
+    the phase set legitimately)."""
+    report: Dict[str, Any] = {
+        "status": "ok",
+        "prev_run": prev.get("run_id"),
+        "cur_run": cur.get("run_id"),
+        "threshold": threshold,
+        "phases": [],
+        "regressions": [],
+    }
+    pv, cv = prev.get("value"), cur.get("value")
+    if isinstance(pv, (int, float)) and isinstance(cv, (int, float)) \
+            and pv > 0:
+        head = _ratio_entry(prev.get("metric", "headline"),
+                            float(pv), float(cv), threshold)
+        report["headline"] = head
+        if head["regressed"]:
+            report["regressions"].append(head)
+    else:
+        report["headline"] = None
+
+    prev_ph = prev.get("phase_timings") or {}
+    cur_ph = cur.get("phase_timings") or {}
+    for name in sorted(set(prev_ph) & set(cur_ph)):
+        p, c = _per_call(prev_ph, name), _per_call(cur_ph, name)
+        if p is None or c is None or max(p, c) < min_seconds:
+            continue
+        ent = _ratio_entry(name, p, c, threshold)
+        report["phases"].append(ent)
+        if ent["regressed"]:
+            report["regressions"].append(ent)
+    report["only_prev"] = sorted(set(prev_ph) - set(cur_ph))
+    report["only_cur"] = sorted(set(cur_ph) - set(prev_ph))
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trajectory", default=os.environ.get(
+        "BENCH_TRAJECTORY", _DEFAULT_TRAJECTORY))
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional slowdown that counts as a "
+                         "regression (0.15 = 15%%)")
+    ap.add_argument("--min-seconds", type=float, default=0.005,
+                    help="ignore phases cheaper than this per call")
+    ap.add_argument("--fail-on-regress", action="store_true",
+                    help="exit 1 when a regression is flagged")
+    args = ap.parse_args(argv)
+
+    records = load_trajectory(args.trajectory)
+    # only records that measured the headline are comparable — failure
+    # records (probe failures, watchdog kills) carry value=None, and
+    # their phase_timings cover a truncated run that would diff as
+    # spurious regressions against a complete one
+    measured = [r for r in records
+                if isinstance(r.get("value"), (int, float))]
+    if len(measured) < 2:
+        print(json.dumps({"status": "insufficient_history",
+                          "records": len(records),
+                          "measured": len(measured),
+                          "trajectory": args.trajectory}))
+        return 0
+
+    # diff like-for-like only: the latest record against the most recent
+    # prior record with the SAME bench_config (rows/iters) — a smoke run
+    # next to a full run differs by orders of magnitude in per-phase
+    # cost and would flag fake regressions
+    cur = measured[-1]
+    prev = next((r for r in reversed(measured[:-1])
+                 if r.get("bench_config") == cur.get("bench_config")),
+                None)
+    if prev is None:
+        print(json.dumps({"status": "insufficient_history",
+                          "reason": "no prior record with matching "
+                                    "bench_config",
+                          "cur_config": cur.get("bench_config"),
+                          "measured": len(measured),
+                          "trajectory": args.trajectory}))
+        return 0
+
+    report = compare(prev, cur,
+                     threshold=args.threshold,
+                     min_seconds=args.min_seconds)
+    print(json.dumps(report))
+    for ent in report["regressions"]:
+        print(f"REGRESSION {ent['name']}: {ent['prev']} -> {ent['cur']} "
+              f"({(ent['ratio'] - 1) * 100:.1f}% slower)", file=sys.stderr)
+    if report["regressions"] and args.fail_on_regress:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
