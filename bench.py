@@ -13,6 +13,11 @@ route+histogram Pallas engine) at the REAL 10.5M-row scale by default
 
 Engines are tried in order (fused -> frontier -> xla): a kernel that fails
 to compile on the attached chip must degrade, not zero the round.
+
+``--micro``: deterministic CPU-backend micro-mode (small synthetic data,
+fused engine in interpret mode, dispatch/drain counters from telemetry)
+so BENCH_TRAJECTORY gains comparable points even while the chip tunnel
+is down — see run_micro().
 """
 from __future__ import annotations
 
@@ -297,7 +302,79 @@ def _quality_leg(engine: str, iters: int = 500) -> dict:
     return out
 
 
+def run_micro() -> None:
+    """Deterministic CPU-backend micro benchmark (``--micro``).
+
+    The chip tunnel's availability swings can leave whole rounds with
+    ``value: null, error: tunnel_*`` — this mode gives BENCH_TRAJECTORY
+    real, comparable points regardless: a small synthetic dataset on the
+    CPU backend through the REAL product path (lgb.train -> megastep/
+    pipelined fast path, fused engine in interpret mode), with the
+    dispatch-per-iteration and drain counters pulled from telemetry so
+    bench_compare.py can flag a fast-path eviction (dispatch-count
+    regression) even where wall-clock noise would hide it."""
+    os.environ["JAX_PLATFORMS"] = "cpu"   # before any jax import
+    _RESULT.update(metric="micro_cpu_sec_per_iter", unit="s")
+    _install_guards()
+    from lightgbm_tpu.utils.timer import global_timer
+    global_timer.enable()
+    _phase("micro_start")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_CACHE_DIR",
+                                     "/tmp/lgbm_tpu_jax_cache_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import lightgbm_tpu as lgb
+
+    n_rows = int(os.environ.get("BENCH_MICRO_ROWS", 4000))
+    n_iters = int(os.environ.get("BENCH_MICRO_ITERS", 8))
+    n_feat = 10
+    _RESULT["bench_config"] = {"mode": "micro", "rows": n_rows,
+                               "iters": n_iters}
+    _RESULT["platform"] = "cpu"
+    X, y = _make_data(n_rows, n_feat)
+
+    tel_path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                            f"bench_micro_tel_{os.getpid()}.jsonl")
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
+              "learning_rate": 0.2, "min_data_in_leaf": 5, "verbose": -1,
+              "metric": "None", "tpu_engine": "fused",
+              # explicit: interpret-mode megastep is opt-in (the micro
+              # mode exists precisely to measure its dispatch counters)
+              "tpu_megastep": True, "telemetry_out": tel_path}
+    t0 = time.perf_counter()
+    bst = lgb.train(params, lgb.Dataset(
+        X, label=y, params={"max_bin": 63, "verbose": -1}),
+        num_boost_round=n_iters)
+    wall = time.perf_counter() - t0
+    _phase("micro_train_ok")
+    snap = bst.telemetry()
+    c = snap.get("counters", {})
+    # the KEPT iteration count is the denominator everywhere: a run that
+    # dries up early (no-more-splits) must not understate sec/iter
+    iters = max(1, int(c.get("iterations", n_iters)))
+    _RESULT["value"] = round(wall / iters, 5)
+    _RESULT["iterations_kept"] = iters
+    _RESULT["engine"] = "fused"
+    _RESULT["counters"] = {k: v for k, v in sorted(c.items())
+                           if k.startswith(("train.", "iterations",
+                                            "events."))}
+    _RESULT["dispatches_per_iter"] = round(
+        float(c.get("train.dispatches", 0)) / iters, 4)
+    _RESULT["drains"] = int(c.get("train.drains", 0))
+    _RESULT["fast_path"] = bool(bst._gbdt._fast_path_ok())
+    try:
+        os.remove(tel_path)
+    except OSError:
+        pass
+    _emit()
+
+
 def main() -> None:
+    if "--micro" in sys.argv[1:]:
+        run_micro()
+        return
     _install_guards()
     # the TIMETAG timer collects section times for the failure tail (its
     # sections carry no sync points, so the pipelined hot loop stays hot)

@@ -27,6 +27,7 @@ from ..obs import Telemetry, device_memory_stats
 from ..ops.predict import add_tree_score
 from ..ops.split import SplitParams, calculate_leaf_output
 from ..utils import log
+from ..parallel.mesh import donate_argnums as _donate
 from ..parallel.mesh import shard_map as _shard_map
 from ..utils.timer import global_timer as timer
 from ..utils import random as ref_random
@@ -160,11 +161,26 @@ class GBDT:
         self.average_output = False
         self._last_cat = None  # host cat arrays from the latest _to_host_tree
         # async pipeline state (see _train_one_iter_fast): device trees not
-        # yet materialised as HostTrees, scores checkpoint for stop rollback
+        # yet materialised as HostTrees, scores checkpoint for stop rollback.
+        # Entries are (stacked TreeArrays, [init_scores per iteration],
+        # batch) — batch > 1 for megastep entries ([B, k, ...] arrays).
         self._pending: List[Tuple] = []
+        self._pending_iters = 0
         self._fast_step_fn = None
         self._fast_ok_cache = None
         self._stopped_early = False
+        # multi-iteration megastep state (see _train_one_megastep): armed
+        # only by driver loops that tolerate train_one_iter advancing
+        # more than one iteration per call
+        self._megastep_armed = False
+        self._megastep_fns: Dict[int, object] = {}
+        self._megastep_fm: Dict[int, object] = {}
+        # batch-granularity telemetry window: wall/perf stamps of the
+        # first dispatch since the last drain, and how many of the
+        # pending iterations came from fused megastep chunks
+        self._batch_t0 = None
+        self._batch_w0 = None
+        self._batch_fused = 0
         # fused-epilogue state (see _use_epilogue)
         self._epi_ok_cache = None
         self._epi_fns = None
@@ -181,6 +197,7 @@ class GBDT:
         # record_telemetry enables it
         self.telemetry = Telemetry()
         self._health = None
+        self._tel_gran = "batch"
         self._trace_out = ""
         self._trace_written = False
         self._prof_dir = ""
@@ -195,6 +212,8 @@ class GBDT:
         self.config = config
         self.train_data = train_data
         self.objective = objective
+        from ..utils.platform import apply_compilation_cache
+        apply_compilation_cache(config)   # before the first trace
         self._setup_telemetry(config)
         self.training_metrics = list(training_metrics)
         self.num_data = train_data.num_data
@@ -340,6 +359,22 @@ class GBDT:
         self._prof_start = max(
             0, int(getattr(config, "profile_start_iteration", 0)))
         self._prof_n = int(getattr(config, "profile_num_iterations", -1))
+        gran = str(getattr(config, "telemetry_granularity", "batch")
+                   or "batch")
+        if gran not in ("batch", "iteration", "section"):
+            log.warning("unknown telemetry_granularity=%s; using batch",
+                        gran)
+            gran = "batch"
+        self._tel_gran = gran
+
+    def _tel_granularity(self) -> str:
+        """Effective time-attribution granularity. trace_out (spans come
+        from synced sections) and the health auditor (needs the sync
+        driver's per-iteration records) imply 'section' regardless of the
+        configured value."""
+        if self._trace_out or self._health is not None:
+            return "section"
+        return self._tel_gran
 
     @contextlib.contextmanager
     def _sec(self, name: str):
@@ -1034,9 +1069,12 @@ class GBDT:
                 in_specs = (P(None, axis), P(None, axis), P()) + \
                     ((P(),) if use_nm else ())
                 out_specs = (P(), P(axis))
+            # the packed gh block is rebuilt every call — donate it so
+            # the sharded operand recycles its per-device buffers
             return jax.jit(_shard_map(
                 per_shard, mesh=self.mesh, in_specs=in_specs,
-                out_specs=out_specs, check_vma=False))
+                out_specs=out_specs, check_vma=False),
+                donate_argnums=_donate(1))
 
         if kind == "xla_sync":
             mode = self.parallel_mode
@@ -1080,7 +1118,8 @@ class GBDT:
                         use_mono_bounds=self.use_mono_bounds)
                 return jax.jit(_shard_map(
                     per_shard, mesh=self.mesh, in_specs=(P(), P(), P()),
-                    out_specs=(P(), P()), check_vma=False))
+                    out_specs=(P(), P()), check_vma=False),
+                    donate_argnums=_donate(1))
 
             kw = {"mono_mode": getattr(self, "mono_mode", "basic")}
             if mode == "voting":
@@ -1124,7 +1163,8 @@ class GBDT:
                 + ((P(),) if use_cegb else ())
             return jax.jit(_shard_map(
                 per_shard, mesh=self.mesh, in_specs=in_specs,
-                out_specs=(P(), P(axis)), check_vma=False))
+                out_specs=(P(), P(axis)), check_vma=False),
+                donate_argnums=_donate(1))
         raise KeyError(kind)
 
     def _grow_parallel(self, gh):
@@ -1175,6 +1215,8 @@ class GBDT:
         from ..ops.pallas_histogram import HAS_PALLAS
         self._fast_step_fn = None     # engine/params changed: re-derive
         self._fast_ok_cache = None
+        self._megastep_fns = {}       # megastep closes over params too
+        self._megastep_fm = {}
         self._fast_fm_pads = None
         self._par_fns = {}            # parallel growers close over params
         self._epi_ok_cache = None     # epilogue closes over params too
@@ -1522,7 +1564,8 @@ class GBDT:
                         "and deadlock the collectives")
         self.drain_pending()          # replay below needs the full model
         self._fast_ok_cache = None    # (valid sets ride the fast path now)
-        self._epi_ok_cache = None
+        self._megastep_fns = {}       # valid-set count is baked into the
+        self._epi_ok_cache = None     # megastep signature
         self._epi_carry = None
         self.valid_data.append(valid_data)
         self.valid_bins.append(jnp.asarray(valid_data.bins))
@@ -2133,12 +2176,14 @@ class GBDT:
         folding. Valid sets stay on the fast path since round 3: their
         score updates run in-jit from the device TreeArrays
         (_update_valid_from_trees) and eval pulls scalars, not matrices."""
-        if self.telemetry.enabled:
-            # telemetry attributes per-iteration sections by blocking on
-            # each phase — only the synchronous driver can do that
-            # honestly (same reason the reference's TIMETAG is sync);
-            # checked outside the cache so a callback can enable
-            # telemetry mid-training
+        if self.telemetry.enabled \
+                and self._tel_granularity() == "section":
+            # per-SECTION attribution blocks on each phase — only the
+            # synchronous driver can do that honestly (same reason the
+            # reference's TIMETAG is sync). batch/iteration granularity
+            # attribute at coarser sync points and keep the fast path
+            # (docs/Performance.md). Checked outside the cache so a
+            # callback can enable telemetry mid-training.
             return False
         if self._fast_ok_cache is None:
             obj = self.objective
@@ -2171,6 +2216,37 @@ class GBDT:
                           slot_cap=max_slot_cap(fb, self.fused_nch))
         return len(caps) + 1
 
+    def _make_valid_apply(self, bundle):
+        """Traced valid-score update for one iteration's stacked [k, ...]
+        TreeArrays: the ONE body both the per-iteration fast path
+        (_update_valid_from_trees jits it per valid set) and the megastep
+        scan inline — shared so the two paths cannot drift apart."""
+        k = self.num_tree_per_iteration
+        shrink = jnp.float32(self.shrinkage_rate)
+        steps = self._fast_tree_depth_bound()
+        meta = self.meta
+        has_cat = self.has_cat
+
+        def apply_trees(vscore, vbins, trees):
+            for tid in range(k):
+                new_row = add_tree_score(
+                    vscore[tid], vbins, trees.leaf_value[tid] * shrink,
+                    trees.split_feature[tid], trees.threshold_bin[tid],
+                    trees.default_left[tid], trees.left_child[tid],
+                    trees.right_child[tid], meta.num_bin,
+                    meta.missing_type, meta.default_bin,
+                    max_steps=steps,
+                    cat_flag=trees.cat_flag[tid] if has_cat else None,
+                    cat_mask=trees.cat_mask[tid] if has_cat else None,
+                    bundle=bundle)
+                # dried class: zero contribution (matches the training
+                # score handling)
+                new_row = jnp.where(trees.num_leaves[tid] > 1, new_row,
+                                    vscore[tid])
+                vscore = vscore.at[tid].set(new_row)
+            return vscore
+        return apply_trees
+
     def _update_valid_from_trees(self, trees) -> None:
         """In-jit valid-score updates straight from the stacked device
         TreeArrays — no HostTree materialisation, no per-iteration sync
@@ -2179,53 +2255,33 @@ class GBDT:
             return
         if not getattr(self, "_valid_upd_fns", None):
             self._valid_upd_fns = {}
-
-        def make_upd(bundle):
-            k = self.num_tree_per_iteration
-            shrink = jnp.float32(self.shrinkage_rate)
-            steps = self._fast_tree_depth_bound()
-            meta = self.meta
-
-            @jax.jit
-            def upd(vscore, vbins, trees):
-                for tid in range(k):
-                    new_row = add_tree_score(
-                        vscore[tid], vbins, trees.leaf_value[tid] * shrink,
-                        trees.split_feature[tid], trees.threshold_bin[tid],
-                        trees.default_left[tid], trees.left_child[tid],
-                        trees.right_child[tid], meta.num_bin,
-                        meta.missing_type, meta.default_bin,
-                        max_steps=steps,
-                        cat_flag=(trees.cat_flag[tid] if self.has_cat
-                                  else None),
-                        cat_mask=(trees.cat_mask[tid] if self.has_cat
-                                  else None),
-                        bundle=bundle)
-                    # dried class: zero contribution (matches the training
-                    # score handling)
-                    new_row = jnp.where(trees.num_leaves[tid] > 1, new_row,
-                                        vscore[tid])
-                    vscore = vscore.at[tid].set(new_row)
-                return vscore
-            return upd
-
         for vi in range(len(self.valid_scores)):
             bundled = self.valid_data[vi].prebundled is not None
             if bundled not in self._valid_upd_fns:
-                self._valid_upd_fns[bundled] = make_upd(
-                    self._valid_bundle(vi) if bundled else None)
+                # the old valid-score buffer is dead the moment the
+                # update returns — donate it so XLA writes in place
+                # instead of allocating a fresh [k, n_valid] f32 every
+                # iteration
+                self._valid_upd_fns[bundled] = jax.jit(
+                    self._make_valid_apply(
+                        self._valid_bundle(vi) if bundled else None),
+                    donate_argnums=_donate(0))
+            self.telemetry.inc("train.dispatches")
             self.valid_scores[vi] = self._valid_upd_fns[bundled](
                 self.valid_scores[vi], self.valid_bins[vi], trees)
 
-    def _make_fast_step(self):
-        from ..models.frontier2 import grow_tree_fused
+    def _make_fused_tree_loop(self):
+        """Traced per-iteration tree-growing core: gh pack -> fused
+        growth -> score delta for each of the k class trees, returning
+        the updated scores and the stacked [k, ...] TreeArrays. The ONE
+        body the per-iteration fast step and the megastep scan share, so
+        the megastep stays bit-identical to the fast path by
+        construction."""
+        from ..models.frontier2 import grow_tree_fused, tree_score_delta
         from ..ops.fused_level import pack_gh, table_lookup
         k = self.num_tree_per_iteration
         n = self.num_data
         pad = self.fused_Rp - n
-        obj = self.objective
-        in_jit_grads = (obj is not None
-                        and obj.supports_traced_gradients())
         shrink = jnp.float32(self.shrinkage_rate)
         max_depth = int(self.config.max_depth)
         extra = int(self.config.tpu_extra_levels)
@@ -2264,18 +2320,7 @@ class GBDT:
                 in_specs=(P(None, axis), P(None, axis), P()),
                 out_specs=(P(), P(axis)), check_vma=False)
 
-        # bins_T/gradient operands are ARGUMENTS, not closures: a
-        # closed-over device array of O(rows) size would be embedded in
-        # the lowered program as a constant (bins alone: 336 MB of HLO at
-        # 10.5M rows) and stall remote compilation. Objectives exposing
-        # the gradient_operands protocol compute gradients IN-jit (XLA
-        # fuses them with the gh pack); others compute eagerly outside.
-        @jax.jit
-        def step(bins_T, scores, grad_in, hess_in, bag_weight, fm_pads):
-            if in_jit_grads:
-                grad, hess = obj.gradients_from(scores, grad_in)
-            else:
-                grad, hess = grad_in, hess_in
+        def grow_k_trees(bins_T, scores, grad, hess, bag_weight, fm_pads):
             trees = []
             for tid in range(k):
                 gh_T = pack_gh(
@@ -2285,7 +2330,11 @@ class GBDT:
                 if par:
                     tree, delta = grow_one_sharded(bins_T, gh_T,
                                                    fm_pads[tid])
-                    delta = delta[:n]
+                    # a dried-up class (no split found) contributes
+                    # NOTHING: the sync path appends a zero constant tree
+                    # for it (gbdt.cpp:421-437 beyond the first
+                    # iteration) and keeps boosting the other classes
+                    delta = jnp.where(tree.num_leaves > 1, delta[:n], 0.0)
                 else:
                     tree, row_leaf = grow_tree_fused(
                         bins_T, gh_T, self.fused_meta, fm_pads[tid],
@@ -2299,20 +2348,37 @@ class GBDT:
                         bundle_cfg=self.fused_bundle_cfg,
                         interpret=interp,
                         mono_mode=getattr(self, "mono_mode", "basic"))
-                    delta = table_lookup(row_leaf[None, :],
-                                         tree.leaf_value * shrink,
-                                         interpret=interp)[0, :n]
-                # a dried-up class (no split found) contributes NOTHING:
-                # the sync path appends a zero constant tree for it
-                # (gbdt.cpp:421-437 beyond the first iteration) and keeps
-                # boosting the other classes
-                delta = jnp.where(tree.num_leaves > 1, delta, 0.0)
+                    delta = tree_score_delta(tree, row_leaf, shrink,
+                                             num_rows=n, interpret=interp)
                 scores = scores.at[tid].add(delta)
                 trees.append(tree)
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees)
             return scores, stacked
-        return step
+        return grow_k_trees
+
+    def _make_fast_step(self):
+        obj = self.objective
+        in_jit_grads = (obj is not None
+                        and obj.supports_traced_gradients())
+        grow_k = self._make_fused_tree_loop()
+
+        # bins_T/gradient operands are ARGUMENTS, not closures: a
+        # closed-over device array of O(rows) size would be embedded in
+        # the lowered program as a constant (bins alone: 336 MB of HLO at
+        # 10.5M rows) and stall remote compilation. Objectives exposing
+        # the gradient_operands protocol compute gradients IN-jit (XLA
+        # fuses them with the gh pack); others compute eagerly outside.
+        # The score matrix is donated: the previous buffer dies at the
+        # call, so XLA updates the [k, n] f32 in place instead of
+        # round-tripping a fresh allocation through HBM each iteration.
+        def step(bins_T, scores, grad_in, hess_in, bag_weight, fm_pads):
+            if in_jit_grads:
+                grad, hess = obj.gradients_from(scores, grad_in)
+            else:
+                grad, hess = grad_in, hess_in
+            return grow_k(bins_T, scores, grad, hess, bag_weight, fm_pads)
+        return jax.jit(step, donate_argnums=_donate(1))
 
     # ------------------------------------------------------------------
     # Fused boosting epilogue (ops/fused_level.epilogue_pass): the final
@@ -2386,7 +2452,6 @@ class GBDT:
                 sigmoid=float(sig), interpret=interp)
             return score2[0], hist0, ghT
 
-        @jax.jit
         def prime(bins_T, score_pad, ops_T, bag_cur, bag_next, fm_pad):
             g, h = in_jit_grads(score_pad, ops_T)
             gh_T = pack_gh(g * bag_cur, h * bag_cur, bag_cur, nch)
@@ -2395,14 +2460,18 @@ class GBDT:
                                           score_pad, ops_T, bag_next)
             return score2, hist0, ghT, tree
 
-        @jax.jit
         def cont(bins_T, score_pad, hist0, gh_T, ops_T, bag_next, fm_pad):
             tree, leafT, W_l, tbl_l = grow(bins_T, gh_T, fm_pad, hist0)
             score2, hist0n, ghT_n = epilogue(bins_T, leafT, W_l, tbl_l,
                                              tree, score_pad, ops_T,
                                              bag_next)
             return score2, hist0n, ghT_n, tree
-        return prime, cont
+        # the (score, root-hist, packed-gh) carry buffers die at each
+        # call — donate them so the iteration carry updates in place
+        # (self.scores is a separate sliced buffer, never the donated
+        # operand; _epi_ops persists across iterations and is NOT donated)
+        return (jax.jit(prime, donate_argnums=_donate(1)),
+                jax.jit(cont, donate_argnums=_donate(1, 2, 3)))
 
     def _epi_iter_body(self):
         n = self.num_data
@@ -2431,6 +2500,7 @@ class GBDT:
         else:
             bag_next = jnp.pad(self._bag_weight_for_iter(self.iter + 1),
                                (0, Rp - n))
+        self.telemetry.inc("train.dispatches")
         if self._epi_carry is None:
             score_pad = jnp.pad(self.scores[0], (0, Rp - n))
             bag_cur = jnp.pad(self.bag_weight, (0, Rp - n))
@@ -2447,11 +2517,31 @@ class GBDT:
         return self._finish_fast_iter(trees, init_scores)
 
     def _train_one_iter_fast(self) -> bool:
+        tel = self.telemetry
+        # iteration granularity: the fast path stays (one jit dispatch),
+        # but each iteration is synced and timed whole — no per-section
+        # split, no eviction to the synchronous driver
+        per_iter = tel.enabled and self._tel_granularity() == "iteration"
+        it = self.iter
+        if per_iter:
+            w0 = tel.wall_now()
+            t0 = time.perf_counter()
         with timer.section("GBDT::TrainOneIterFast"):
             if self._use_epilogue():
                 stop = self._epi_iter_body()
             else:
                 stop = self._fast_iter_body()
+        if per_iter:
+            jax.block_until_ready(self.scores)
+            dt = time.perf_counter() - t0
+            nl = []
+            if self._pending:
+                nl = [int(x) for x in
+                      np.asarray(self._pending[-1][0].num_leaves)]
+            tel.begin_iteration(it)
+            tel.section("fast_iteration", dt, wall_start=w0)
+            tel.end_iteration(it, num_leaves=nl, engine="fused",
+                              mode=self.parallel_mode, pipelined=True)
         if stop is None:    # batch full: drain outside the fast section
             self.drain_pending()
             return self._stopped_early
@@ -2483,6 +2573,7 @@ class GBDT:
             fm_pads = jnp.stack([
                 jnp.zeros((F_oh,), bool).at[:self.train_data.num_features]
                 .set(self._feature_mask()) for _ in range(k)])
+        self.telemetry.inc("train.dispatches")
         self.scores, trees = self._fast_step_fn(
             self.fused_bins_T, self.scores, grad_in, hess_in,
             self.bag_weight, fm_pads)
@@ -2496,9 +2587,13 @@ class GBDT:
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         self._update_valid_from_trees(trees)
-        self._pending.append((trees, init_scores))
+        if not self._pending:
+            self._batch_w0 = self.telemetry.wall_now()
+            self._batch_t0 = time.perf_counter()
+        self._pending.append((trees, [init_scores], 1))
+        self._pending_iters += 1
         self.iter += 1
-        if len(self._pending) >= self._FAST_SYNC_EVERY:
+        if self._pending_iters >= self._FAST_SYNC_EVERY:
             return None     # signal the wrapper to drain
         return False
 
@@ -2517,13 +2612,26 @@ class GBDT:
 
     def _drain_body(self) -> None:
         pend, self._pending = self._pending, []
+        self._pending_iters = 0
         k = self.num_tree_per_iteration
-        base_iter = self.iter - len(pend)
-        trees_host = jax.device_get([t for t, _ in pend])
+        self.telemetry.inc("train.drains")
+        trees_host = jax.device_get([t for t, _, _ in pend])
+        # flatten megastep entries ([B, k, ...] stacked trees covering B
+        # iterations) and per-iteration entries ([k, ...], batch == 1)
+        # into one per-iteration sequence of host TreeArrays fields
+        flat: List[Tuple] = []
+        for (_, init_list, batch), trees_h in zip(pend, trees_host):
+            arrays = [np.asarray(a) for a in trees_h]
+            if batch == 1:
+                flat.append((arrays, init_list[0]))
+            else:
+                for b in range(batch):
+                    flat.append(([a[b] for a in arrays], init_list[b]))
+        base_iter = self.iter - len(flat)
+        gain_acc: List[np.ndarray] = []
         stop_i = None
         converted = []   # per drained iteration: [(ht, dt, grew)] * k
-        for i, (trees_h, (_, init_scores)) in enumerate(zip(trees_host,
-                                                            pend)):
+        for i, (trees_h, init_scores) in enumerate(flat):
             iter_models = []
             dried_first = []   # tids of first-k constant trees
             any_grew = False
@@ -2545,6 +2653,10 @@ class GBDT:
                     continue
                 any_grew = True
                 ht, sf_inner = self._to_host_tree(ta, self.shrinkage_rate)
+                # numerical guards stay live on the fast path: the host
+                # tree is already materialised here, so the non-finite
+                # checks cost numpy only (no extra device sync)
+                self._guard_tree(base_iter + i, tid, ht, gain_acc)
                 ht.apply_shrinkage(self.shrinkage_rate)
                 cf, cm = self._last_cat or (None, None)
                 dt = _DeviceTree(ht, sf_inner, cat_flag=cf, cat_mask=cm)
@@ -2599,7 +2711,7 @@ class GBDT:
                 # score, updating the scorer a second time on top of
                 # BoostFromAverage (gbdt.cpp:377,433 — 2x init total;
                 # matched bug-for-bug by the synchronous path)
-                init_scores = pend[stop_i][1]
+                init_scores = flat[stop_i][1]
                 for tid in range(k):
                     ht = HostTree(1)
                     ht.leaf_value[0] = init_scores[tid]
@@ -2617,15 +2729,228 @@ class GBDT:
             self._stopped_early = True
             log.warning("Stopped training because there are no more "
                         "leaves that meet the split requirements")
+            # structured stop record (the sync path emits the same event
+            # inline). `discarded` lets iteration-granularity consumers
+            # reconcile: iteration records numbered >= this event's
+            # `iter` were rolled back and produced no trees
+            self.telemetry.event("stopped_no_splits", iteration=self.iter,
+                                 discarded=len(flat) - stop_i)
+        tel = self.telemetry
+        if tel.enabled and flat and self._tel_granularity() == "batch":
+            # batch-granularity record: one megastep/pipelined batch of
+            # `len(flat)` iterations, wall time measured first-dispatch
+            # -> drain-complete (the one honest sync point the fast path
+            # has). `kept` < iterations means the no-more-splits stop
+            # rewound the tail.
+            secs = {"batch": (time.perf_counter() - self._batch_t0
+                              if self._batch_t0 is not None else 0.0)}
+            tel.megastep(base_iter, iterations=len(flat),
+                         kept=self.iter - base_iter, sections=secs,
+                         wall_start=self._batch_w0, engine="fused",
+                         mode=self.parallel_mode,
+                         fused_iterations=self._batch_fused,
+                         stopped=self._stopped_early)
+            if gain_acc:
+                gains = np.concatenate(gain_acc)
+                if gains.size:
+                    tel.observe("batch.split_gain_mean",
+                                float(gains.mean()))
+        self._batch_t0 = self._batch_w0 = None
+        self._batch_fused = 0
+
+    # ------------------------------------------------------------------
+    # Multi-iteration megastep: up to tpu_megastep_iters boosting
+    # iterations chained inside ONE jit via lax.scan over the fused
+    # tree-growing step — gradients (traced from the objective's
+    # operands), tree growth, training-score and valid-score updates all
+    # stay on device; the scan emits stacked TreeArrays [B, k, ...] that
+    # drain_pending converts like any other pending batch. At ~25 us per
+    # dispatch round trip through the chip tunnel (PROFILE.md), this is
+    # the remaining host-side overhead after the round-2 kernel work:
+    # the per-iteration fast path still pays >= 1 dispatch per iteration
+    # plus per-valid-set updates; the megastep pays ~1 per B iterations.
+    def arm_megastep(self, on: bool = True) -> None:
+        """Permission from a driver loop that (a) treats train_one_iter
+        as 'advance training', not 'advance exactly one iteration', and
+        (b) stops when it returns True. Only such loops (engine.train,
+        the CLI train loop) may consume multi-iteration megasteps; the
+        bare Booster.update contract stays one iteration per call."""
+        self._megastep_armed = bool(on)
+
+    def _megastep_ok(self) -> bool:
+        obj = self.objective
+        return bool(
+            self._megastep_armed
+            and bool(getattr(self.config, "tpu_megastep", True))
+            # interpret-mode fused (off-TPU emulation) has no dispatch
+            # latency to amortize — the scan would only add compile time
+            # — so there the megastep is explicit opt-in (tests, micro
+            # bench); on a real chip the default engages it
+            and (not self.fused_interpret
+                 or self.config.was_set("tpu_megastep"))
+            and self._fast_path_ok()
+            and obj is not None and obj.supports_traced_gradients()
+            # per-iteration observability needs per-iteration steps:
+            # GBDT-level early stopping evaluates metrics after every
+            # iteration, and iteration-granularity telemetry syncs one
+            and self.early_stopping_round <= 0
+            and int(getattr(self.config, "snapshot_freq", -1) or -1) <= 0
+            and not (self.telemetry.enabled
+                     and self._tel_granularity() == "iteration")
+            # a bounded/offset jax.profiler window opens and closes at
+            # iteration edges _profiler_step only sees once per call —
+            # fusing would shift the captured window by up to a chunk
+            # (whole-run profiles, start 0 / no bound, are unaffected)
+            and not (self._prof_dir and not self._prof_done
+                     and (self._prof_start > 0 or self._prof_n >= 0)))
+
+    def _megastep_chunk(self) -> int:
+        """Iterations the next megastep may fuse: bounded by
+        tpu_megastep_iters, the pipeline drain batch, the
+        num_iterations horizon, and the current bagging round's window
+        (the in-bag weight vector must be constant inside one jit —
+        chunks never cross a re-bagging boundary, so the reference-
+        parity LCG draws keep their exact firing order)."""
+        if not self._megastep_ok():
+            return 0
+        chunk = min(int(self.config.tpu_megastep_iters),
+                    self._FAST_SYNC_EVERY,
+                    int(self.config.num_iterations) - self.iter)
+        cfg = self.config
+        if self.is_bagging and cfg.bagging_freq > 0:
+            next_fire = ((self.iter // cfg.bagging_freq) + 1) \
+                * cfg.bagging_freq
+            chunk = min(chunk, next_fire - self.iter)
+        return chunk
+
+    def _train_one_megastep(self, chunk: int) -> bool:
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        with timer.section("GBDT::TrainMegastep"):
+            self._megastep_body(chunk)
+        # dispatch (host enqueue) cost of the fused chunk; the batch's
+        # wall time is attributed by the drain's batch record
+        tel.observe("megastep.dispatch", time.perf_counter() - t0)
+        # batch-granularity attribution syncs once per megastep by
+        # draining immediately (one sync amortized over `chunk`
+        # iterations, which also emits the batch record); without
+        # telemetry the drain keeps its usual pipeline cadence
+        if tel.enabled or self._pending_iters >= self._FAST_SYNC_EVERY:
+            self.drain_pending()
+        return self._stopped_early
+
+    def _megastep_body(self, chunk: int) -> None:
+        k = self.num_tree_per_iteration
+        init0 = [self._boost_from_average(tid, True) for tid in range(k)]
+        operands = self.objective.gradient_operands()
+        self._bagging(self.iter, None, None)   # chunk-aligned: a round
+        # can fire only at the chunk's first iteration
+        fn = self._megastep_fns.get(chunk)
+        if fn is None:
+            fn = self._megastep_fns[chunk] = self._make_megastep(chunk)
+        F_oh = self.fused_f_oh
+        F = self.train_data.num_features
+        if float(self.config.feature_fraction) >= 1.0:
+            fm_pads = self._megastep_fm.get(chunk)
+            if fm_pads is None:
+                fm_pads = self._megastep_fm[chunk] = \
+                    jnp.ones((chunk, k, F_oh), bool) \
+                    .at[:, :, F:].set(False)
+        else:
+            # host LCG draws in exactly the per-iteration order
+            # (iteration-major, then tree) so column sampling stays
+            # reference-parity across the fused chunk
+            masks = np.zeros((chunk, k, F_oh), bool)
+            for b in range(chunk):
+                for tid in range(k):
+                    masks[b, tid, :F] = np.asarray(self._feature_mask())
+            fm_pads = jnp.asarray(masks)
+        self.telemetry.inc("train.dispatches")
+        # profiler users see the fused chunk as one annotated step
+        # (profile_dir / jax.profiler traces); free when no trace is on
+        with jax.profiler.StepTraceAnnotation("megastep",
+                                              step_num=self.iter):
+            scores, vscores, trees_B = fn(
+                self.fused_bins_T, self.scores, tuple(self.valid_bins),
+                tuple(self.valid_scores), operands, self.bag_weight,
+                fm_pads)
+        self.scores = scores
+        self.valid_scores = list(vscores)
+        # the fused-epilogue carry (score_pad, hist0, gh_T) captured
+        # score state from before this chunk; a later epilogue iteration
+        # must re-prime from the advanced scores, not resume stale state
+        self._epi_carry = None
+        for leaf in jax.tree_util.tree_leaves(trees_B):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        init_list = [init0] + [[0.0] * k for _ in range(chunk - 1)]
+        if not self._pending:
+            self._batch_w0 = self.telemetry.wall_now()
+            self._batch_t0 = time.perf_counter()
+        self._pending.append((trees_B, init_list, chunk))
+        self._pending_iters += chunk
+        self._batch_fused += chunk
+        self.iter += chunk
+
+    def _make_megastep(self, chunk: int):
+        obj = self.objective
+        grow_k = self._make_fused_tree_loop()
+        valid_appliers = [
+            self._make_valid_apply(self._valid_bundle(vi)
+                                   if self.valid_data[vi].prebundled
+                                   is not None else None)
+            for vi in range(len(self.valid_scores))]
+
+        def one_iteration(bins_T, scores, vbins, vscores, grad_ops,
+                          bag_weight, fm_pads):
+            """The SAME traced bodies as the per-iteration fast path —
+            _make_fused_tree_loop for growth/score updates and
+            _make_valid_apply per valid set — scanned, so the megastep
+            is bit-identical to the pipelined path by construction."""
+            grad, hess = obj.gradients_from(scores, grad_ops)
+            scores, stacked = grow_k(bins_T, scores, grad, hess,
+                                     bag_weight, fm_pads)
+            vscores = tuple(
+                apply_v(vscore, vb, stacked)
+                for apply_v, vscore, vb in zip(valid_appliers, vscores,
+                                               vbins))
+            return scores, vscores, stacked
+
+        def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
+                 fm_pads_B):
+            def body(carry, fm_pads):
+                scores, vscores = carry
+                scores, vscores, stacked = one_iteration(
+                    bins_T, scores, vbins, vscores, grad_ops, bag_weight,
+                    fm_pads)
+                return (scores, vscores), stacked
+            (scores, vscores), trees_B = jax.lax.scan(
+                body, (scores, vscores), fm_pads_B)
+            return scores, vscores, trees_B
+        # donate the score carry and every valid-score buffer: the scan
+        # rewrites them in place across the whole chunk
+        return jax.jit(step, donate_argnums=_donate(1, 3))
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
-        """One boosting iteration (ref: gbdt.cpp:371 TrainOneIter).
-        Returns True if training should stop."""
+        """One boosting iteration (ref: gbdt.cpp:371 TrainOneIter) — or,
+        when a megastep-armed driver loop permits it, one fused chunk of
+        iterations (see arm_megastep). Returns True if training should
+        stop."""
         self._profiler_step()
-        if (gradients is None and hessians is None
-                and not self._stopped_early and self._fast_path_ok()):
-            return self._train_one_iter_fast()
+        if gradients is None and hessians is None \
+                and not self._stopped_early:
+            if self._megastep_armed \
+                    and self.iter >= int(self.config.num_iterations):
+                # the armed loop counts calls, not iterations: signal
+                # completion once the megastep chunks covered the horizon
+                self.drain_pending()
+                return True
+            chunk = self._megastep_chunk()
+            if chunk >= 2:
+                return self._train_one_megastep(chunk)
+            if self._fast_path_ok():
+                return self._train_one_iter_fast()
         self.drain_pending()
         if self._stopped_early:
             return True
@@ -2672,6 +2997,7 @@ class GBDT:
 
             grad, hess = self._bagging(self.iter, grad, hess)
             s.sync((grad, hess))
+        tel.inc("train.dispatches")   # eager gradient/bagging launch
         self._guard_gradients(it, grad, hess)
 
         should_continue = False
@@ -2686,6 +3012,7 @@ class GBDT:
                 # jitted grower — one section attributes them jointly
                 # (profile_dir splits them at the XLA op level)
                 with self._sec("histogram_split") as s:
+                    tel.inc("train.dispatches")
                     tree, row_leaf = self._grow(gh)
                     s.sync((tree, row_leaf))
                 nl = int(tree.num_leaves)
@@ -2719,6 +3046,8 @@ class GBDT:
                 # shrinkage then score update (ref: gbdt.cpp:414-419)
                 ht.apply_shrinkage(self.shrinkage_rate)
                 with self._sec("score_update") as s:
+                    tel.inc("train.dispatches",
+                            1 + len(self.valid_scores))
                     if bool(self.config.linear_tree) and ht.is_linear \
                             and self.train_data.raw_data is not None:
                         # linear leaves: per-row outputs on host raw data
@@ -3093,6 +3422,23 @@ class GBDT:
         self.finalize_telemetry()
 
     def _train_loop(self) -> None:
+        # this loop satisfies the megastep contract: it checks the
+        # returned `finished` every call and reads iteration counts off
+        # self.iter, so train_one_iter may fuse multiple iterations per
+        # call (_megastep_ok still bars configs needing per-iteration
+        # observation — GBDT-level early stopping, iteration-granularity
+        # telemetry, snapshots). Configured metrics keep per-iteration
+        # steps: this loop's output_metric runs once per call, and the
+        # reference CLI prints every metric_freq iterations — fusing
+        # would silently skip 31 of every 32 metric lines.
+        self.arm_megastep(not self.training_metrics
+                          and not any(self.valid_metrics))
+        try:
+            self._train_loop_body()
+        finally:
+            self.arm_megastep(False)
+
+    def _train_loop_body(self) -> None:
         for it in range(self.iter, int(self.config.num_iterations)):
             finished = self.train_one_iter()
             if not finished:

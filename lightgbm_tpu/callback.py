@@ -65,15 +65,19 @@ def record_telemetry(telemetry_result: Dict[str, Any]):
     registry instead of the eval loop).
 
     Enables the booster's telemetry registry before the first iteration
-    runs (so iteration 0 is covered), then drains completed per-iteration
+    runs (so iteration 0 is covered), then drains completed training
     records into ``telemetry_result["iterations"]`` as training
     progresses; at the end of ``engine.train`` the finalize hook drains
     the tail and stores the registry snapshot (counters, gauges, timing
     distributions, recent events) under ``telemetry_result["summary"]``.
 
-    Note: an enabled registry runs the synchronous per-iteration driver
-    (honest section attribution; see docs/Observability.md), like
-    ``telemetry_out=...`` does.
+    Record shape follows ``telemetry_granularity`` (docs/Observability.md):
+    at the default ``batch`` a fast-path run yields one ``megastep``
+    record per drained batch (covering up to 32 iterations; the
+    synchronous driver — engine ``xla``, DART/GOSS/RF, custom ``fobj``,
+    ... — still yields per-iteration ``iteration`` records); set
+    ``telemetry_granularity=iteration`` or ``section`` for one record
+    per iteration with whole-iteration or per-section times.
     """
     if not isinstance(telemetry_result, dict):
         raise TypeError("telemetry_result should be a dictionary")

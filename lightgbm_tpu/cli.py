@@ -12,13 +12,17 @@ wins), tasks train / predict / refit-free convert paths:
         input_model=model.txt output_result=preds.tsv
 
 Observability flags (docs/Observability.md): ``telemetry_out=<path>``
-streams per-iteration JSONL telemetry, ``trace_out=<path>`` exports a
-Perfetto/Chrome-trace timeline (one track per rank),
-``health_check_period=N`` turns on the cross-rank health auditor, and
-``profile_dir=<dir>`` captures a jax.profiler trace of the training
-loop — all ordinary config keys, so they work from the command line and
-from config files alike. On a crash with ``telemetry_out`` set, the
-flight recorder dumps ``<telemetry_out>.crash.json``.
+streams structured JSONL telemetry (``telemetry_granularity=batch``,
+the default, keeps the pipelined/megastep fast path and attributes time
+per drained batch; ``iteration``/``section`` trade speed for finer
+attribution), ``trace_out=<path>`` exports a Perfetto/Chrome-trace
+timeline (one track per rank), ``health_check_period=N`` turns on the
+cross-rank health auditor, and ``profile_dir=<dir>`` captures a
+jax.profiler trace of the training loop — all ordinary config keys, so
+they work from the command line and from config files alike. On a crash
+with ``telemetry_out`` set, the flight recorder dumps
+``<telemetry_out>.crash.json``. ``compilation_cache_dir=<dir>`` makes
+repeated CLI runs skip XLA recompiles (docs/Performance.md).
 """
 from __future__ import annotations
 

@@ -260,19 +260,49 @@ _PARAMS: List[_Param] = [
        desc="fuse final-level routing + score update + gradients + next "
             "root histogram into one kernel pass on the pipelined fast "
             "path (objectives with a kernel closed form: binary, l2)"),
+    _p("tpu_megastep", bool, True,
+       desc="chain up to tpu_megastep_iters boosting iterations inside "
+            "ONE jit (lax.scan over the fused tree-growing step; "
+            "gradients, bagging weights, tree growth, score and "
+            "valid-score updates all stay on device) when the driver "
+            "loop permits multi-iteration steps (engine.train / CLI "
+            "train); off = one dispatch per iteration on the fast path. "
+            "Off-TPU (interpret-mode fused) the default does not engage "
+            "— set the key explicitly to opt in; there is no dispatch "
+            "latency to amortize there"),
+    _p("tpu_megastep_iters", int, 32, check=(">", 1),
+       desc="max boosting iterations fused into one megastep dispatch "
+            "(capped by the pipeline drain batch, the num_iterations "
+            "horizon and the current bagging round's window)"),
     _p("tpu_rows_per_shard_pad", int, 8,
        desc="pad row count to a multiple of this per mesh shard"),
     _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),
     _p("mesh_axis_feature", str, "feature",
        desc="mesh axis name for feature sharding"),
+    _p("compilation_cache_dir", str, "",
+       ("jax_compilation_cache_dir", "xla_cache_dir"),
+       desc="directory for JAX's persistent XLA compilation cache: "
+            "repeated runs (same shapes/params) skip recompiling the "
+            "fused training step — applied to jax.config at booster "
+            "init, before the first trace"),
     # ---- Observability (docs/Observability.md) ----
     _p("telemetry_out", str, "", ("telemetry_output", "telemetry_file"),
        desc="path: stream structured JSONL telemetry (per-iteration "
             "section times, collective traffic, compile and degradation "
             "events); multi-process ranks write <path>.rank<r>, rank 0 "
-            "the bare path. Enabling telemetry runs the synchronous "
-            "per-iteration driver so section times are honestly "
-            "attributable"),
+            "the bare path. Time attribution follows "
+            "telemetry_granularity — only granularity=section forces "
+            "the synchronous per-iteration driver"),
+    _p("telemetry_granularity", str, "batch",
+       ("telemetry_level",),
+       desc="time-attribution granularity when telemetry is on: 'batch' "
+            "(default — training keeps the pipelined/megastep fast path; "
+            "wall time and dispatch counts attributed per drained batch), "
+            "'iteration' (fast path with one sync per iteration; whole-"
+            "iteration wall times, no per-section split), 'section' "
+            "(synchronous driver with honestly-synced per-section times "
+            "— the pre-round-5 behavior; trace_out and "
+            "health_check_period imply this)"),
     _p("profile_dir", str, "", ("profiler_dir", "profile_log_dir"),
        desc="directory: capture a jax.profiler trace of the training "
             "loop (TensorBoard/Perfetto viewable)"),

@@ -107,9 +107,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     # main loop (ref: engine.py:260-283)
+    # Megastep arming: this loop may consume multi-iteration steps (one
+    # jit fusing up to tpu_megastep_iters iterations) because it breaks
+    # on `finished` and nothing here needs per-iteration observation —
+    # but ONLY when no per-iteration consumer exists: callbacks index
+    # CallbackEnv.iteration (which counts calls), feval/fobj run per
+    # call, and snapshots fire on call numbers. Evaluation still happens
+    # every loop round on the accurate post-chunk scores.
+    if (not callbacks and feval is None and fobj is None
+            and snapshot_freq <= 0):
+        booster._gbdt.arm_megastep(True)
     evaluation_result_list: List = []
     i = -1
-    for i in range(num_boost_round):
+    try:
+      for i in range(num_boost_round):
         try:
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
@@ -156,6 +167,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # the flight recorder's primary "where was it stuck" case
             booster._dump_crash(exc)
             raise
+    finally:
+        # a kept booster must return to the one-iteration-per-update
+        # contract once this loop stops consuming multi-iteration steps
+        booster._gbdt.arm_megastep(False)
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for name, metric, value, _ in (evaluation_result_list or []):

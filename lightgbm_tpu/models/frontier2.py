@@ -742,6 +742,23 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
     return jax.lax.cond(n_sel > 0, dispatch, lambda op: op, op0)
 
 
+def tree_score_delta(tree: TreeArrays, row_leaf: jax.Array, shrinkage,
+                     num_rows: int = 0,
+                     interpret: bool = False) -> jax.Array:
+    """Per-row training-score delta of one freshly grown tree:
+    ``shrinkage * leaf_value[row_leaf]`` through the streaming lookup
+    kernel, with a dried-up tree's (num_leaves <= 1) contribution zeroed
+    — the sync path appends a constant tree for it instead
+    (gbdt.cpp:421-437). Shared by the pipelined fast step and the
+    megastep scan body so both paths stay bit-identical by
+    construction."""
+    vals = table_lookup(row_leaf[None, :], tree.leaf_value * shrinkage,
+                        interpret=interpret)[0]
+    if num_rows:
+        vals = vals[:num_rows]
+    return jnp.where(tree.num_leaves > 1, vals, 0.0)
+
+
 def add_leaf_values_to_score(score: jax.Array, row_leaf: jax.Array,
                              leaf_value: jax.Array, shrinkage,
                              interpret: bool = False) -> jax.Array:

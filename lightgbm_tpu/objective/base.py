@@ -72,12 +72,14 @@ class ObjectiveFunction:
         self.num_data = 0
         self.label: Optional[np.ndarray] = None
         self.weight: Optional[np.ndarray] = None
+        self._traced_ok: Optional[bool] = None
 
     # ------------------------------------------------------------------
     def init(self, metadata, num_data: int) -> None:
         self.num_data = num_data
         self.label = metadata.label
         self.weight = metadata.weight
+        self._traced_ok = None   # operands are rebuilt from the new data
 
     def get_gradients(self, score) -> Tuple:
         raise NotImplementedError
@@ -118,12 +120,18 @@ class ObjectiveFunction:
         get_gradients ALSO provides its own gradients_from — a subclass
         overriding just get_gradients (huber/fair/poisson/... on top of
         L2) must not inherit the base pair, or the traced path would
-        silently train with the base objective's gradients."""
-        for k in type(self).__mro__:
-            if "get_gradients" in k.__dict__:
-                return ("gradients_from" in k.__dict__
+        silently train with the base objective's gradients. Cached per
+        data binding: the fast path and the megastep chunker consult
+        this every iteration."""
+        if self._traced_ok is None:
+            self._traced_ok = False
+            for k in type(self).__mro__:
+                if "get_gradients" in k.__dict__:
+                    self._traced_ok = (
+                        "gradients_from" in k.__dict__
                         and self.gradient_operands() is not None)
-        return False
+                    break
+        return self._traced_ok
 
     def boost_from_score(self, class_id: int) -> float:
         return 0.0

@@ -392,6 +392,44 @@ class Telemetry:
                       track="train", iteration=it)
         return rec
 
+    def megastep(self, it0: int, iterations: int, kept: int,
+                 sections: Dict[str, float],
+                 wall_start: Optional[float] = None,
+                 **attrs: Any) -> Dict[str, Any]:
+        """Batch-granularity training record: one megastep (or drained
+        fast-path batch) covering iterations ``[it0, it0+iterations)``.
+        The fast path cannot attribute per-section times without
+        synchronizing every phase, so at ``telemetry_granularity=batch``
+        wall time is attributed per drained batch instead — ``kept`` is
+        how many of the batch's iterations survived the drain (a
+        no-more-splits stop discards the tail). Counts toward the
+        ``iterations`` counter like ``kept`` end_iteration calls and is
+        queued for the record_telemetry callback."""
+        if not self.enabled:
+            return {}
+        secs = {k: round(float(v), 9) for k, v in (sections or {}).items()}
+        rec: Dict[str, Any] = {"ts": time.time(), "rank": self.rank,
+                               "event": "megastep", "iter": int(it0),
+                               "iterations": int(iterations),
+                               "kept": int(kept), "sections": secs}
+        rec.update(attrs)
+        with self._lock:
+            self._counters["iterations"] = \
+                self._counters.get("iterations", 0) + int(kept)
+            self._counters["events.megastep"] = \
+                self._counters.get("events.megastep", 0) + 1
+            for name, v in secs.items():
+                self._observe_locked("section." + name, v)
+            self._events.append(rec)
+            self._records.append(rec)
+            sink = self._sink
+        if sink is not None:
+            sink.write(rec)
+        if wall_start is not None and secs:
+            self.span("megastep", wall_start,
+                      max(secs.values()), track="train", iteration=it0)
+        return rec
+
     def drain_records(self) -> List[Dict[str, Any]]:
         """Completed iteration records since the last drain (the
         record_telemetry callback's feed)."""

@@ -33,6 +33,27 @@ def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
                       out_specs=out_specs, check_rep=check_vma)
 
 
+def buffer_donation_supported() -> bool:
+    """True where XLA actually honors ``donate_argnums`` (TPU/GPU).
+    The CPU backend copies anyway and warns per lowering, so callers
+    request donation only where it is real. Donation composes with
+    sharded operands too: a row-sharded score matrix under a multi-
+    process layout donates per-shard buffers, so the in-place update
+    holds on every rank."""
+    try:
+        return jax.default_backend() in ("tpu", "gpu")
+    except Exception:
+        return False
+
+
+def donate_argnums(*argnums: int):
+    """``donate_argnums`` tuple for jax.jit, empty off-TPU/GPU — the
+    one-line idiom every driver jit that re-writes its score/gradient
+    carry buffers routes through (boosting/gbdt.py fast path, megastep,
+    epilogue, valid updates, parallel growers)."""
+    return tuple(argnums) if buffer_donation_supported() else ()
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = DATA_AXIS,
               devices: Optional[Sequence] = None) -> Mesh:

@@ -101,6 +101,22 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     else:
         report["headline"] = None
 
+    # dispatch-count regression: deterministic (no wall-clock noise), so
+    # it catches a fast-path eviction — e.g. a change that silently sends
+    # telemetry-on training back to the synchronous driver — even on
+    # runners too noisy for the timing thresholds. Any increase beyond
+    # the threshold flags; micro records (bench.py --micro) carry this.
+    dp, dc = prev.get("dispatches_per_iter"), cur.get("dispatches_per_iter")
+    if isinstance(dp, (int, float)) and isinstance(dc, (int, float)) \
+            and dp > 0:
+        ent = _ratio_entry("dispatches_per_iter", float(dp), float(dc),
+                           threshold)
+        report["dispatches"] = ent
+        if ent["regressed"]:
+            report["regressions"].append(ent)
+    else:
+        report["dispatches"] = None
+
     prev_ph = prev.get("phase_timings") or {}
     cur_ph = cur.get("phase_timings") or {}
     for name in sorted(set(prev_ph) & set(cur_ph)):

@@ -44,6 +44,14 @@ _INTERPRET_HEAVY = {
     ("test_epilogue.py", "test_epilogue_feature_fraction"),
     ("test_epilogue.py", "test_l2_epilogue_identical"),
     ("test_fast_pipeline.py", "test_fast_matches_sync_path"),
+    ("test_megastep.py", "test_megastep_bit_identical_to_fast_path"),
+    ("test_megastep.py", "test_megastep_early_stop_across_boundary"),
+    ("test_megastep.py", "test_megastep_valid_and_bagging"),
+    ("test_megastep.py",
+     "test_telemetry_iteration_granularity_keeps_fast_path"),
+    ("test_megastep.py", "test_telemetry_section_granularity_forces_sync"),
+    ("test_megastep.py", "test_trace_out_implies_section_granularity"),
+    ("test_megastep.py", "test_update_contract_unchanged"),
     ("test_fast_pipeline.py", "test_multiclass_fast_matches_sync"),
     ("test_fast_pipeline.py", "test_multiclass_rare_class_keeps_init_score"),
     ("test_fast_pipeline.py",
