@@ -371,7 +371,131 @@ def run_micro() -> None:
     _emit()
 
 
+def run_serve() -> None:
+    """Prediction-serving bench (``--serve``; add ``--micro`` for the
+    deterministic CPU mode CI gates on).
+
+    Two legs against a live ``lightgbm_tpu.serve.PredictionService``:
+
+    - **closed loop** — sequential mixed-size requests, one at a time:
+      per-request latency (p50 is the headline) plus the two
+      DETERMINISTIC counters the regression gate keys on:
+      ``dispatches_per_request`` (bucketing keeps it at exactly 1.0 —
+      a chunking/bucketing regression moves it) and
+      ``compiles_per_1k_requests`` (0 after warmup — a bucket-shape
+      leak recompiling per request size moves it to ~1000/len(sizes));
+    - **open loop** — all requests submitted concurrently so the
+      micro-batcher coalesces: throughput + observed batching ratio
+      (timing-dependent, recorded informationally, never gated).
+    """
+    micro = "--micro" in sys.argv[1:]
+    if micro:
+        os.environ["JAX_PLATFORMS"] = "cpu"   # before any jax import
+    _RESULT.update(metric="serve_micro_p50_ms" if micro
+                   else "serve_p50_ms", unit="ms", vs_baseline=None)
+    _install_guards()
+    _phase("serve_start")
+    if not micro:
+        from lightgbm_tpu.utils.platform import pin_jax_platforms
+        pin_jax_platforms()   # the axon plugin ignores the env var
+    import jax
+    if micro:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_CACHE_DIR",
+                                     "/tmp/lgbm_tpu_jax_cache_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import PredictionService
+
+    n_models = int(os.environ.get("SERVE_MODELS", 2))
+    n_requests = int(os.environ.get("SERVE_REQUESTS", 200))
+    train_rows = int(os.environ.get("SERVE_TRAIN_ROWS",
+                                    2000 if micro else 200_000))
+    n_feat = 12
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH_ROWS", 1024))
+    _RESULT["bench_config"] = {"mode": "serve_micro" if micro else "serve",
+                               "models": n_models, "requests": n_requests,
+                               "train_rows": train_rows,
+                               "max_batch_rows": max_batch}
+    _RESULT["platform"] = "cpu" if micro else None
+
+    models = {}
+    for m in range(n_models):
+        X, y = _make_data(train_rows, n_feat)
+        rngm = np.random.RandomState(100 + m)
+        y = (X @ rngm.randn(n_feat) > 0).astype(np.float32)
+        models[f"m{m}"] = lgb.train(
+            {"objective": "binary", "num_leaves": 31, "verbose": -1,
+             "metric": "None", "max_bin": 63},
+            lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1}),
+            num_boost_round=int(os.environ.get("SERVE_TREES", 20)))
+    _phase("serve_models_trained")
+
+    svc = PredictionService(models, max_batch_rows=max_batch,
+                            max_delay_ms=1.0, min_bucket_rows=16,
+                            batch_events=False)
+    svc.warmup()
+    _phase("serve_warmup_ok")
+
+    # ---- closed loop: deterministic request stream, one in flight ----
+    rng = np.random.RandomState(7)
+    sizes = rng.randint(1, max_batch + 1, size=n_requests)
+    mids = [f"m{i % n_models}" for i in range(n_requests)]
+    reqs = [rng.rand(int(s), n_feat).astype(np.float32) for s in sizes]
+    s0 = svc.stats()
+    lat = []
+    t0 = time.perf_counter()
+    for mid, Xq in zip(mids, reqs):
+        r0 = time.perf_counter()
+        svc.predict(mid, Xq)
+        lat.append((time.perf_counter() - r0) * 1000.0)
+    closed_wall = time.perf_counter() - t0
+    s1 = svc.stats()
+    lat.sort()
+
+    def q(p):
+        return lat[min(len(lat) - 1, int(p * (len(lat) - 1) + 0.5))]
+
+    _RESULT["value"] = round(q(0.50), 4)
+    _RESULT["p95_ms"] = round(q(0.95), 4)
+    _RESULT["p99_ms"] = round(q(0.99), 4)
+    d_disp = s1["dispatches"] - s0["dispatches"]
+    d_comp = s1["compiles"] - s0["compiles"]
+    _RESULT["dispatches_per_request"] = round(d_disp / n_requests, 6)
+    _RESULT["compiles_per_1k_requests"] = round(
+        d_comp * 1000.0 / n_requests, 6)
+    _RESULT["closed_loop_rows_per_s"] = round(
+        float(sizes.sum()) / closed_wall, 1)
+    _phase("serve_closed_ok")
+    _emit()   # the deterministic gate numbers are on stdout now
+
+    # ---- open loop: concurrent submits exercise the micro-batcher ----
+    t0 = time.perf_counter()
+    futs = [svc.submit(mid, Xq) for mid, Xq in zip(mids, reqs)]
+    for f in futs:
+        f.result(timeout=600)
+    open_wall = time.perf_counter() - t0
+    s2 = svc.stats()
+    _RESULT["open_loop_rows_per_s"] = round(
+        float(sizes.sum()) / open_wall, 1)
+    ob = s2["batches"] - s1["batches"]
+    _RESULT["open_loop_batches"] = ob
+    _RESULT["open_loop_requests_per_batch"] = round(
+        n_requests / max(1, ob), 3)
+    _RESULT["serve_stats"] = {
+        k: s2[k] for k in ("requests", "batches", "dispatches", "compiles",
+                           "evictions", "degradations")}
+    _RESULT["latency_ms"] = s2.get("latency_ms")
+    _phase("serve_open_ok")
+    svc.close()
+    _emit()
+
+
 def main() -> None:
+    if "--serve" in sys.argv[1:]:
+        run_serve()
+        return
     if "--micro" in sys.argv[1:]:
         run_micro()
         return

@@ -13,6 +13,8 @@ from .config import Config
 from .engine import CVBooster, cv, train
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import LightGBMError, register_logger
+from . import serve
+from .serve import PredictionService
 
 try:  # plotting needs matplotlib (optional)
     from .plotting import (create_tree_digraph, plot_importance, plot_metric,
@@ -30,5 +32,5 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "log_evaluation", "record_evaluation",
     "record_telemetry", "reset_parameter", "EarlyStopException",
-    "register_logger", "LightGBMError",
+    "register_logger", "LightGBMError", "serve", "PredictionService",
 ] + _PLOT

@@ -25,6 +25,10 @@ from .utils.log import LightGBMError
 
 __all__ = ["Dataset", "Booster", "Sequence"]
 
+# host-walk sparse fallback densifies in bounded row chunks (a tall CSR
+# predict must be a loop, not a whole-matrix todense)
+_HOST_SPARSE_CHUNK_ROWS = 65_536
+
 
 class Sequence:
     """Generic chunked data-access interface for dataset construction
@@ -59,6 +63,47 @@ def _materialize_sequences(seqs) -> np.ndarray:
     if not chunks:
         raise ValueError("Sequence dataset has 0 rows")
     return np.concatenate(chunks, axis=0)
+
+
+def host_walk_raw(models, X, lo: int, hi: int, k: int) -> np.ndarray:
+    """Exact float64 host tree walk over trees [lo, hi): raw scores
+    [k, n].  The ONE implementation of the host fallback — Booster
+    ``_predict_raw`` and the serving engine's degraded path both route
+    here, so the densify-in-bounded-chunks behavior (a tall sparse
+    predict must be a loop, not a whole-matrix todense) cannot
+    diverge."""
+    n = X.shape[0]
+    raw = np.zeros((k, n), np.float64)
+    if _is_scipy_sparse(X):
+        X = X.tocsr()
+        step = _HOST_SPARSE_CHUNK_ROWS
+        for c0 in range(0, n, step):
+            sl = slice(c0, min(n, c0 + step))
+            Xc = np.asarray(X[sl].todense(), np.float64)
+            for i, t in enumerate(models[lo:hi]):
+                raw[(lo + i) % k, sl] += t.predict_rows(Xc)
+        return raw
+    X = np.asarray(X, np.float64)
+    for i, t in enumerate(models[lo:hi]):
+        raw[(lo + i) % k] += t.predict_rows(X)
+    return raw
+
+
+def finalize_raw_predictions(raw: np.ndarray, k: int, objective,
+                             average_output: bool, num_iteration: int,
+                             raw_score: bool) -> np.ndarray:
+    """Raw [k, n] scores -> the user-facing prediction array: RF score
+    averaging, objective output transform, multiclass transpose.  The
+    ONE implementation of the output contract — ``Booster.predict`` and
+    the serving engine both end here, so serving results cannot drift
+    from the Booster's."""
+    if average_output and num_iteration > 0:
+        raw = raw / num_iteration
+    if not raw_score and objective is not None:
+        if k > 1:
+            return objective.convert_output(raw.T)
+        return np.asarray(objective.convert_output(raw[0]))
+    return raw[0] if k == 1 else raw.T
 
 
 def pred_trees_stale(pred, booster) -> bool:
@@ -603,6 +648,9 @@ class Booster:
                       pred_early_stop_freq,
                       pred_early_stop_margin) -> np.ndarray:
         self._drain()
+        # float32 sources are exactly representable in the raw-value
+        # device predictor's compares; remember before the f64 upcast
+        f32_input = getattr(data, "dtype", None) == np.float32
         if _is_scipy_sparse(data):
             # the batch predictor densifies per chunk; host-walk paths
             # (pred_leaf/contrib/early-stop) densify below as needed
@@ -642,14 +690,10 @@ class Booster:
             raw = self._predict_raw_early_stop(
                 X, lo, hi, pred_early_stop_freq, pred_early_stop_margin)
         else:
-            raw = self._predict_raw(X, lo, hi)
-        if self.average_output and num_iteration > 0:
-            raw /= num_iteration
-        if not raw_score and self.objective is not None:
-            if k > 1:
-                return self.objective.convert_output(raw.T)
-            return np.asarray(self.objective.convert_output(raw[0]))
-        return raw[0] if k == 1 else raw.T
+            raw = self._predict_raw(X, lo, hi, f32_input=f32_input)
+        return finalize_raw_predictions(raw, k, self.objective,
+                                        self.average_output,
+                                        num_iteration, raw_score)
 
     # ------------------------------------------------------------------
     def _predict_raw_early_stop(self, X: np.ndarray, lo: int, hi: int,
@@ -676,34 +720,79 @@ class Booster:
                 active[idx[done]] = False
         return raw
 
-    def _predict_raw(self, X: np.ndarray, lo: int, hi: int) -> np.ndarray:
-        """Raw scores [k, n]: device batch path for big jobs (bin through
-        the training mappers + one jit scan over a stacked tree tensor —
-        ref: predictor.hpp:30 replaced per SURVEY §3.3), host tree walk
-        otherwise (exact float64 accumulation)."""
+    def _pred_device_min_work(self) -> int:
+        """Resolved ``pred_device_min_work`` threshold (rows x trees at
+        or above which predict routes through the device predictor) —
+        from the live training config when one exists, else from the
+        booster params (model-file boosters)."""
+        if self.config is not None:
+            return int(self.config.pred_device_min_work)
+        cached = getattr(self, "_pred_min_work_cache", None)
+        if cached is None:
+            # resolve the ONE key by hand — constructing a full Config
+            # here would re-run its _post_process side effects (global
+            # log level!) on every first predict of a model-file booster
+            cached = 2_000_000
+            for key, value in self.params.items():
+                if Config.resolve_key(str(key)) == "pred_device_min_work" \
+                        and value is not None:
+                    cached = int(float(value))
+            self._pred_min_work_cache = cached
+        return cached
+
+    def _pred_min_work_user_set(self) -> bool:
+        """Did the user explicitly set ``pred_device_min_work``?  An
+        explicit value is the opt-in that lets float64 input take the
+        float32 raw-routing device path."""
+        if self.config is not None:
+            return self.config.was_set("pred_device_min_work")
+        return any(Config.resolve_key(str(key)) == "pred_device_min_work"
+                   for key in self.params)
+
+    def _predict_raw(self, X: np.ndarray, lo: int, hi: int,
+                     f32_input: bool = False) -> np.ndarray:
+        """Raw scores [k, n]: device batch path for big jobs (one jit
+        scan over a stacked tree tensor — ref: predictor.hpp:30 replaced
+        per SURVEY §3.3; binned routing through the training mappers
+        when a training dataset is attached, raw-value-threshold routing
+        otherwise, so model-file boosters get the device path too), host
+        tree walk below ``pred_device_min_work`` rows x trees (exact
+        float64 accumulation).
+
+        The raw-routing variant compares in float32: leaf routing is
+        bit-identical to the host walk only for float32-representable
+        input, so it auto-engages only when the source data was float32
+        — float64 callers keep the exact host walk unless they opted in
+        by setting ``pred_device_min_work`` themselves."""
         n = X.shape[0]
         k = self.num_tree_per_iteration
         n_trees = hi - lo
-        use_device = (self.train_set is not None
-                      and self.train_set._inner is not None
-                      and n * max(n_trees, 1) >= 2_000_000)
-        if use_device:
+        if n * max(n_trees, 1) >= self._pred_device_min_work():
+            has_train = (self.train_set is not None
+                         and self.train_set._inner is not None)
+            if not has_train and not f32_input \
+                    and not self._pred_min_work_user_set():
+                return host_walk_raw(self.models, X, lo, hi, k)
             pred = getattr(self, "_device_predictor", None)
             if pred is None or pred_trees_stale(pred, self):
-                from .models.predictor import DevicePredictor
-                pred = DevicePredictor(self.models, self.train_set._inner,
-                                       k)
-                if pred.ok:
-                    pred.model_version = self._model_version
-                    self._device_predictor = pred
-            if pred is not None and pred.ok:
+                if has_train:
+                    from .models.predictor import DevicePredictor
+                    pred = DevicePredictor(self.models,
+                                           self.train_set._inner, k)
+                else:
+                    from .models.predictor import RawDevicePredictor
+                    pred = RawDevicePredictor(self.models,
+                                              self.max_feature_idx + 1, k)
+                # cache failed packs too: the ineligibility decision
+                # (linear trees, oversized cat vocab) is per model
+                # state, and re-scanning every tree per predict call
+                # would tax exactly the repeated-predict workloads the
+                # device path exists for
+                pred.model_version = self._model_version
+                self._device_predictor = pred
+            if pred.ok:
                 return pred.predict_raw(X, lo, hi)
-        if _is_scipy_sparse(X):
-            X = np.asarray(X.todense(), np.float64)  # host walk needs rows
-        raw = np.zeros((k, n), np.float64)
-        for i, t in enumerate(self.models[lo:hi]):
-            raw[(lo + i) % k] += t.predict_rows(X)
-        return raw
+        return host_walk_raw(self.models, X, lo, hi, k)
 
     # ------------------------------------------------------------------
     def set_network(self, machines: str, local_listen_port: int = 12400,
@@ -727,6 +816,9 @@ class Booster:
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """(ref: basic.py Booster.reset_parameter → gbdt.cpp ResetConfig)"""
         self.params.update(params)
+        # model-file boosters resolve predict-time keys from params —
+        # drop the cached threshold so the new value takes effect
+        self._pred_min_work_cache = None
         if self._gbdt is not None:
             self.config.update(params)
             self._gbdt.reset_config(self.config)

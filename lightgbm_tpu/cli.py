@@ -99,6 +99,9 @@ def run_predict(params: Dict[str, str]) -> None:
                          "input_model=<file>")
     out_path = params.pop("output_result", "LightGBM_predict_result.txt")
     booster = Booster(model_file=model)
+    # predict-time keys (pred_device_min_work, pred_early_stop, ...)
+    # ride the booster params so the path choice is CLI-controllable
+    booster.params.update(params)
     from .io.file_loader import load_text_file
     # a prediction file may or may not carry the label column; default to
     # stripping column 0 only when the width says one extra column is

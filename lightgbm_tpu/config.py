@@ -177,6 +177,15 @@ _PARAMS: List[_Param] = [
     _p("predict_leaf_index", bool, False, ("is_predict_leaf_index", "leaf_index")),
     _p("predict_contrib", bool, False, ("is_predict_contrib", "contrib")),
     _p("predict_disable_shape_check", bool, False),
+    _p("pred_device_min_work", int, 2_000_000,
+       ("predict_device_min_work",), check=(">=", 0),
+       desc="minimum rows x trees before Booster.predict routes a batch "
+            "through the device predictor (stacked trees + jit scan) "
+            "instead of the exact float64 host walk; 0 forces the device "
+            "path, a huge value forces the host walk — the deterministic "
+            "switch the serving/parity tests use. Serving "
+            "(lightgbm_tpu.serve) always uses the device path when the "
+            "model is representable"),
     _p("pred_early_stop", bool, False),
     _p("pred_early_stop_freq", int, 10),
     _p("pred_early_stop_margin", float, 10.0),

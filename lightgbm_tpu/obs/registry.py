@@ -37,6 +37,7 @@ _EVENT_RING = 512       # bounded in-memory event history
 _RECORD_RING = 65536    # per-iteration records awaiting a drain
 _SPAN_RING = 16384      # trace spans awaiting export (a few per iteration)
 _FINDING_RING = 1024    # health/guard findings kept for the whole run
+_DIST_RING = 8192       # recent samples per value distribution
 _FINDING_EVENTS = frozenset(
     {"anomaly", "rank_divergence", "straggler"})
 
@@ -52,6 +53,7 @@ class Telemetry:
         self._timings: Dict[str, Dict[str, float]] = {}
         self._events = collections.deque(maxlen=_EVENT_RING)
         self._findings = collections.deque(maxlen=_FINDING_RING)
+        self._dists: Dict[str, collections.deque] = {}
         self._records = collections.deque(maxlen=_RECORD_RING)
         self._spans = collections.deque(maxlen=_SPAN_RING)
         self._trace_on = False
@@ -165,6 +167,32 @@ class Telemetry:
         t["total"] += seconds
         t["min"] = min(t["min"], seconds)
         t["max"] = max(t["max"], seconds)
+
+    def dist(self, name: str, value: float) -> None:
+        """Value-distribution sample (request latencies, micro-batch
+        sizes): kept in a bounded ring per name so the snapshot can
+        report real p50/p95/p99 quantiles, which the {count,total,
+        min,max} ``observe`` timings cannot.  The ring bounds memory;
+        quantiles cover the most recent ``_DIST_RING`` samples."""
+        if not self.enabled:
+            return
+        with self._lock:
+            d = self._dists.get(name)
+            if d is None:
+                d = self._dists[name] = collections.deque(
+                    maxlen=_DIST_RING)
+            d.append(float(value))
+
+    @staticmethod
+    def _dist_summary(samples) -> Dict[str, float]:
+        vals = sorted(samples)
+        n = len(vals)
+
+        def q(p: float) -> float:
+            return vals[min(n - 1, int(p * (n - 1) + 0.5))]
+
+        return {"count": n, "min": vals[0], "max": vals[-1],
+                "p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
 
     def event(self, name: str, iteration: Optional[int] = None,
               **attrs: Any) -> None:
@@ -450,6 +478,8 @@ class Telemetry:
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "timings": {k: dict(v) for k, v in self._timings.items()},
+                "dists": {k: self._dist_summary(v)
+                          for k, v in self._dists.items() if v},
                 "events": [dict(e) for e in self._events],
                 "findings": [dict(e) for e in self._findings],
             }

@@ -73,6 +73,66 @@ def route_rows_to_leaves(bins: jax.Array, split_feature: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("max_steps",))
+def route_raw_rows_to_leaves(values: jax.Array, split_feature: jax.Array,
+                             threshold: jax.Array, default_left: jax.Array,
+                             missing_type: jax.Array, left_child: jax.Array,
+                             right_child: jax.Array, max_steps: int,
+                             cat_flag: jax.Array = None,
+                             cat_mask: jax.Array = None) -> jax.Array:
+    """Leaf index per row for one tree routed on RAW feature values —
+    the serving-side variant for boosters without training BinMappers
+    (model-file loads).  Mirrors the host walk exactly
+    (ref: tree.h NumericalDecision / CategoricalDecision):
+
+    - ``missing_type`` is PER NODE here (decoded from the model's
+      decision_type bitfield), not per feature;
+    - NaN with missing_type none/zero is treated as 0.0;
+    - ``threshold`` must be pre-rounded to the largest float32 <= the
+      model's float64 threshold (models/predictor.threshold_to_f32), so
+      the float32 compare routes float32-representable inputs
+      bit-identically to the float64 host compare;
+    - ``cat_mask`` ([N, C]) is indexed by the raw integer category value
+      (bounded by the packer); out-of-range/negative goes right.
+    """
+    R = values.shape[0]
+    node = jnp.zeros((R,), jnp.int32)
+
+    def body(_, node):
+        is_internal = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]
+        v = jnp.take_along_axis(values, f[:, None].astype(jnp.int32),
+                                axis=1)[:, 0]
+        mt = missing_type[nd]
+        nan_mask = jnp.isnan(v)
+        zero_mask = jnp.abs(v) <= 1e-35          # kZeroThreshold
+        is_missing = jnp.where(mt == 2, nan_mask,
+                               jnp.where(mt == 1, zero_mask | nan_mask,
+                                         False))
+        v_eff = jnp.where(nan_mask & (mt != 2), jnp.float32(0.0), v)
+        go_left = jnp.where(is_missing, default_left[nd],
+                            v_eff <= threshold[nd])
+        if cat_flag is not None:
+            C = cat_mask.shape[1]
+            # range-check BEFORE the int cast: float->int32 of values
+            # past 2^31 is implementation-defined in XLA (wrap or
+            # saturate), and a wrapped value could land inside [0, C)
+            # and read mask garbage.  The bound is v <= -1, not v < 0:
+            # the host walk truncates toward zero, so (-1, 0) becomes
+            # category 0 there and must here too
+            bad = nan_mask | (v <= -1.0) | (v >= jnp.float32(C))
+            iv = jnp.where(bad, jnp.float32(-1), v).astype(jnp.int32)
+            in_range = iv >= 0
+            cat_left = cat_mask[nd, jnp.clip(iv, 0, C - 1)] & in_range
+            go_left = jnp.where(cat_flag[nd], cat_left, go_left)
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        return jnp.where(is_internal, nxt, node)
+
+    node = jax.lax.fori_loop(0, max_steps, body, node)
+    return jnp.where(node < 0, ~node, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
 def add_tree_score(score: jax.Array, bins: jax.Array, leaf_value: jax.Array,
                    split_feature: jax.Array, threshold_bin: jax.Array,
                    default_left: jax.Array, left_child: jax.Array,

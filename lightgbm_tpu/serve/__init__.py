@@ -1,0 +1,32 @@
+"""Device-resident prediction serving.
+
+The training half of the north star got fast (megastep, donated
+buffers); this package is the serving half: trees packed ONCE into the
+device-resident stacked tensors ``models/predictor.py`` builds, jitted
+traversal with power-of-two row-count bucketing (any request size after
+warmup hits the XLA cache — zero recompiles), request micro-batching
+with deadline coalescing, and multi-model residency under a bytes
+budget.  The shape of the win follows XGBoost's device-resident
+predictor (arxiv 1806.11248): keep the model on the accelerator and
+amortize dispatch over batched requests.
+
+Layers (docs/Serving.md):
+
+- :class:`ServingEngine` (engine.py) — one packed model: bucketed,
+  donated, warmup-compiled device traversal with deterministic
+  compile/dispatch counters and graceful degradation to the host walk;
+- :class:`MicroBatcher` (batcher.py) — thread-safe request queue with
+  ``max_batch_rows`` / ``max_delay_ms`` deadline coalescing, one device
+  call per drained micro-batch, future-based responses;
+- :class:`ResidencyManager` (residency.py) — N models sharing a device
+  under a bytes budget with LRU eviction and pin/unpin;
+- :class:`PredictionService` (service.py) — the public facade:
+  ``PredictionService(boosters_or_paths).predict(model_id, X)``.
+"""
+from .batcher import MicroBatcher
+from .engine import ServingEngine
+from .residency import ResidencyManager
+from .service import PredictionService
+
+__all__ = ["PredictionService", "ServingEngine", "MicroBatcher",
+           "ResidencyManager"]

@@ -1,0 +1,228 @@
+"""Micro-batching request queue.
+
+Per-request dispatch is what makes naive serving slow: every request
+pays a host→device→host round trip.  The batcher coalesces concurrent
+requests for the same model into ONE device call — the serving analog
+of the training megastep's dispatch amortization:
+
+- ``submit()`` enqueues a request and returns a
+  ``concurrent.futures.Future`` immediately (the async form; ``predict``
+  on the service is ``submit().result()``);
+- a single worker thread drains the queue: it takes the oldest request,
+  pulls every queued request for the SAME model, and keeps waiting for
+  more until either ``max_batch_rows`` rows are assembled or
+  ``max_delay_ms`` has passed since the oldest request arrived — the
+  classic deadline-coalescing loop;
+- the assembled batch is one engine call (≤1 host dispatch per
+  micro-batch when the batch fits one bucket), and each requester's
+  slice resolves its future.
+
+Failures resolve the affected futures with the exception — a poisoned
+request cannot wedge the queue.  Telemetry: queue-depth gauge,
+batch-size and latency distributions, ``serve_batch`` events.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("model_id", "X", "rows", "cols", "future", "t_submit",
+                 "sparse")
+
+    def __init__(self, model_id: str, X, rows: int, sparse: bool):
+        self.model_id = model_id
+        self.X = X
+        self.rows = rows
+        self.cols = int(X.shape[1])
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.sparse = sparse
+
+
+def _resolve(future: Future, result=None, exc=None) -> None:
+    """set_result/set_exception tolerant of a client cancel() racing the
+    delivery — an InvalidStateError here would kill the single worker
+    thread and wedge every future request behind it."""
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+    except Exception:
+        pass   # cancelled between the done() check and delivery
+
+
+class MicroBatcher:
+    """Deadline-coalescing request queue in front of a dispatch fn."""
+
+    def __init__(self, dispatch: Callable[[str, Any], np.ndarray],
+                 max_batch_rows: int = 8192, max_delay_ms: float = 2.0,
+                 telemetry=None, batch_events: bool = True):
+        self._dispatch = dispatch
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.tel = telemetry
+        self.batch_events = batch_events
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._worker = threading.Thread(
+            target=self._loop, name="lgbm-serve-batcher", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, model_id: str, X) -> Future:
+        from ..basic import _is_scipy_sparse
+        sparse = _is_scipy_sparse(X)
+        if not sparse:
+            X = np.asarray(X)
+            if X.ndim == 1:
+                X = X.reshape(1, -1)
+            if X.dtype.kind not in "fiub":
+                # coerce non-numeric input HERE, synchronously: a bad
+                # request must raise in its own submit call, not poison
+                # the np.concatenate of a whole coalesced batch
+                X = X.astype(np.float64)
+        req = _Request(model_id, X, int(X.shape[0]), sparse)
+        with self._cv:
+            if self._stop:
+                req.future.set_exception(
+                    RuntimeError("MicroBatcher is closed"))
+                return req.future
+            self._q.append(req)
+            depth = len(self._q)
+            self._cv.notify()
+        if self.tel is not None:
+            self.tel.gauge("serve.queue_depth", depth)
+            self.tel.inc("serve.requests")
+            self.tel.inc("serve.rows", req.rows)
+        return req.future
+
+    # ------------------------------------------------------------------
+    def _pull_same_model(self, model_id: str, cols: int,
+                         budget: int) -> List[_Request]:
+        """Remove queued DENSE requests for ``model_id`` with the SAME
+        column count (a width mismatch must fail only its own request,
+        not its batch neighbors' np.concatenate), up to ``budget`` rows,
+        preserving arrival order.  Caller holds the lock."""
+        got, keep = [], collections.deque()
+        while self._q:
+            r = self._q.popleft()
+            if (r.model_id == model_id and not r.sparse
+                    and r.cols == cols and r.rows <= budget):
+                # strict budget: a batch never exceeds max_batch_rows,
+                # so one micro-batch is one bucketed device dispatch
+                # (an oversized SINGLE request still chunks in the
+                # engine, but never drags neighbors past the cap)
+                got.append(r)
+                budget -= r.rows
+            else:
+                keep.append(r)
+        self._q = keep
+        return got
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait()
+                if not self._q and self._stop:
+                    return
+                first = self._q.popleft()
+            batch = [first]
+            rows = first.rows
+            if not first.sparse:
+                deadline = first.t_submit + self.max_delay_s
+                while rows < self.max_batch_rows:
+                    with self._cv:
+                        more = self._pull_same_model(
+                            first.model_id, first.cols,
+                            self.max_batch_rows - rows)
+                        if not more:
+                            remaining = deadline - time.perf_counter()
+                            if remaining <= 0:
+                                break
+                            self._cv.wait(remaining)
+                            more = self._pull_same_model(
+                                first.model_id, first.cols,
+                                self.max_batch_rows - rows)
+                    if more:
+                        batch.extend(more)
+                        rows += sum(r.rows for r in more)
+                    elif time.perf_counter() >= deadline:
+                        break
+            self._run_batch(first.model_id, batch, rows)
+
+    def _record(self, fn, *args, **kwargs) -> None:
+        """Telemetry from the worker thread must be best-effort: a
+        failing sink (disk full under telemetry_out) would otherwise
+        unwind _loop, kill the only worker and wedge every future
+        request behind a healthy device."""
+        if self.tel is None:
+            return
+        try:
+            fn(*args, **kwargs)
+        except Exception:
+            pass
+
+    def _run_batch(self, model_id: str, batch: List[_Request],
+                   rows: int) -> None:
+        # re-gauge on drain too: submit-only updates would leave an
+        # idle service reporting its last (peak) backlog forever
+        self._record(lambda: self.tel.gauge("serve.queue_depth",
+                                            len(self._q)))
+        t0 = time.perf_counter()
+        wait_ms = (t0 - batch[0].t_submit) * 1000.0
+        try:
+            X = batch[0].X if len(batch) == 1 else np.concatenate(
+                [r.X for r in batch], axis=0)
+            out = self._dispatch(model_id, X)
+            out = np.asarray(out)
+        except Exception as exc:  # resolve, don't wedge
+            for r in batch:
+                _resolve(r.future, exc=exc)
+            self._record(lambda: (
+                self.tel.inc("serve.batch_errors"),
+                self.tel.event("serve_batch_error", model_id=model_id,
+                               rows=rows, error=type(exc).__name__)))
+            return
+        done = time.perf_counter()
+        c0 = 0
+        for r in batch:
+            _resolve(r.future, result=out[c0:c0 + r.rows])
+            c0 += r.rows
+
+        def _batch_telemetry():
+            self.tel.inc("serve.batches")
+            self.tel.dist("serve.batch_rows", rows)
+            for r in batch:
+                self.tel.dist("serve.latency_ms",
+                              (done - r.t_submit) * 1000.0)
+            if self.batch_events:
+                self.tel.event("serve_batch", model_id=model_id,
+                               rows=rows, requests=len(batch),
+                               wait_ms=round(wait_ms, 3),
+                               exec_ms=round((done - t0) * 1000.0, 3))
+
+        self._record(_batch_telemetry)
+
+    # ------------------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker.  ``drain=True`` serves what is already
+        queued first; ``drain=False`` fails queued requests."""
+        with self._cv:
+            self._stop = True
+            if not drain:
+                while self._q:
+                    r = self._q.popleft()
+                    _resolve(r.future,
+                             exc=RuntimeError("MicroBatcher closed"))
+            self._cv.notify_all()
+        self._worker.join(timeout=30)

@@ -1,0 +1,281 @@
+"""Serving engine: one booster packed once, dispatched many times.
+
+Wraps the stacked-tree device predictors (models/predictor.py) with the
+serving-side machinery the training-time batch path never needed:
+
+- **row-count bucketing** — request rows are padded up to a power-of-two
+  bucket in ``[min_bucket_rows, max_batch_rows]`` and the result sliced
+  back, so after :meth:`ServingEngine.warmup` EVERY request size hits
+  the XLA compile cache (zero recompiles on the serving path — the
+  per-chunk-shape recompile of the old ``Booster.predict`` device path
+  is the exact failure this buys out);
+- **deterministic counters** — compiles are counted against a
+  process-wide signature registry (variant + static config + operand
+  shapes, the same key XLA's jit cache uses), dispatches per device
+  call; ``bench.py --serve`` gates on both;
+- **graceful degradation** — a booster the device path cannot represent
+  (linear trees, categorical vocabulary past the raw-variant cap) serves
+  through the host walk instead, with a structured ``serve_degradation``
+  event carrying the packer's reason.
+
+File-loaded boosters (no training BinMappers) pack through
+:class:`RawDevicePredictor` — raw-value thresholds pre-rounded so
+float32-representable inputs route bit-identically to the host walk.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..models.predictor import (DevicePredictor, RawDevicePredictor,
+                                _round_up_pow2)
+
+# process-wide registry of dispatched jit signatures: the deterministic
+# model of XLA's compile cache the serve counters are asserted against.
+# Module scope (not per engine) because the jitted runners are module
+# scope too — a second model with identical packed shapes, or a rebuilt
+# engine after an LRU eviction, reuses the compiled program.
+_COMPILED_SIGS = set()
+_SIG_LOCK = threading.Lock()
+
+
+def _is_sparse(X) -> bool:
+    from ..basic import _is_scipy_sparse
+    return _is_scipy_sparse(X)
+
+
+class ServingEngine:
+    """Device-resident predictor for ONE booster state."""
+
+    def __init__(self, booster, model_id: str = "default",
+                 telemetry=None, max_batch_rows: int = 8192,
+                 min_bucket_rows: int = 64,
+                 start_iteration: int = 0,
+                 num_iteration: Optional[int] = None):
+        self.booster = booster
+        self.model_id = model_id
+        self.tel = telemetry
+        booster._drain()
+        self.k = max(1, booster.num_tree_per_iteration)
+        total_iter = len(booster.models) // self.k
+        if num_iteration is None:
+            num_iteration = (booster.best_iteration
+                             if booster.best_iteration > 0 else -1)
+        if num_iteration <= 0:
+            num_iteration = total_iter - start_iteration
+        num_iteration = max(0, min(num_iteration,
+                                   total_iter - start_iteration))
+        self.lo = start_iteration * self.k
+        self.hi = (start_iteration + num_iteration) * self.k
+        self.num_iteration = num_iteration
+
+        self.max_bucket = _round_up_pow2(max(2, int(max_batch_rows)))
+        self.min_bucket = min(_round_up_pow2(max(2, int(min_bucket_rows))),
+                              self.max_bucket)
+
+        self.dispatches = 0
+        self.compiles = 0
+        self.host_rows = 0
+        self._lock = threading.Lock()
+
+        ts = getattr(booster, "train_set", None)
+        if ts is not None and getattr(ts, "_inner", None) is not None:
+            self.variant = "binned"
+            self.pred = DevicePredictor(booster.models, ts._inner, self.k)
+        else:
+            self.variant = "raw"
+            self.pred = RawDevicePredictor(
+                booster.models, booster.max_feature_idx + 1, self.k)
+        self.device_ok = bool(self.pred.ok) and num_iteration > 0
+        self.degraded_reason = "" if self.device_ok else \
+            (self.pred.reason or "no_trees")
+        if not self.device_ok:
+            self.pred = None
+            self._event("serve_degradation", model_id=model_id,
+                        reason=self.degraded_reason)
+            self._inc("serve.degradations")
+        else:
+            # [lo, hi) is fixed for the engine's lifetime: slice the
+            # packed operands ONCE (per-dispatch re-slicing would be
+            # ~10 eager device ops per micro-batch — the exact overhead
+            # this engine exists to amortize) and derive the signature
+            # base the per-bucket compile-cache key extends
+            self._operands = self.pred.run_args(self.lo, self.hi)
+            self._sig_base = (
+                self.pred.variant, self.k, self.pred.max_steps,
+                # the encoded-rows operand's width/dtype fork compiled
+                # programs too — tree-stack shapes alone are not enough
+                self.pred.enc_width, self.pred.enc_dtype,
+                tuple(None if a is None
+                      else (tuple(a.shape), str(a.dtype))
+                      for a in self._operands))
+        self._event("serve_model_loaded", model_id=model_id,
+                    variant=self.variant, device=self.device_ok,
+                    trees=self.hi - self.lo,
+                    bytes=self.packed_nbytes)
+
+    # ------------------------------------------------------- telemetry
+    def _inc(self, name: str, v: float = 1) -> None:
+        if self.tel is not None:
+            self.tel.inc(name, v)
+
+    def _event(self, name: str, **attrs: Any) -> None:
+        if self.tel is not None:
+            self.tel.event(name, **attrs)
+
+    # ------------------------------------------------------------------
+    @property
+    def packed_nbytes(self) -> int:
+        return 0 if self.pred is None else self.pred.packed_nbytes
+
+    def buckets(self) -> List[int]:
+        """All power-of-two bucket sizes this engine pads into."""
+        out, b = [], self.min_bucket
+        while b < self.max_bucket:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_bucket)
+        return out
+
+    def bucket_for(self, rows: int) -> int:
+        return min(self.max_bucket,
+                   max(self.min_bucket, _round_up_pow2(max(2, rows))))
+
+    def _signature(self, bucket: int):
+        """Cache key of one bucketed dispatch — mirrors what XLA keys its
+        jit cache on: runner identity + static args + operand
+        shapes/dtypes (tree-stack dims, feature width, cat mask)."""
+        return self._sig_base + (bucket,)
+
+    # ------------------------------------------------------------------
+    def warmup(self, buckets: Optional[List[int]] = None) -> Dict[str, Any]:
+        """AOT-compile the bucketed traversal for every ``buckets`` size
+        (default: all of :meth:`buckets`) by dispatching a zero batch
+        and blocking on the result.  After warmup, any request stream
+        whose per-chunk row counts pad into the warmed buckets incurs
+        zero recompiles."""
+        import jax
+        if not self.device_ok:
+            return {"warmed": [], "compiles": 0, "degraded": True}
+        compiles_before, dispatches_before = self.compiles, self.dispatches
+        warmed = []
+        for b in sorted(set(buckets or self.buckets())):
+            b = self.bucket_for(b)
+            if b in warmed:
+                continue
+            enc = self._encode_pad(np.zeros(
+                (1, self.booster.max_feature_idx + 1), np.float32), b)
+            jax.block_until_ready(self._dispatch(enc, b))
+            warmed.append(b)
+        n = self.compiles - compiles_before
+        # warmup activity is accounted separately so steady-state rates
+        # (dispatches_per_request, compiles_per_1k_requests) can be
+        # computed off the lifetime counters without warmup skew
+        self._inc("serve.warmup_compiles", n)
+        self._inc("serve.warmup_dispatches",
+                  self.dispatches - dispatches_before)
+        self._event("serve_warmup", model_id=self.model_id,
+                    buckets=warmed, compiles=n)
+        return {"warmed": warmed, "compiles": n, "degraded": False}
+
+    def _encode_pad(self, Xc: np.ndarray, bucket: int) -> np.ndarray:
+        enc = self.pred.encode(Xc)
+        if enc.shape[0] < bucket:
+            pad = np.zeros((bucket - enc.shape[0], enc.shape[1]),
+                           enc.dtype)
+            enc = np.concatenate([enc, pad], axis=0)
+        return enc
+
+    def _dispatch(self, enc: np.ndarray, bucket: int):
+        import jax.numpy as jnp
+
+        from ..models.predictor import stacked_run_fn
+        sig = self._signature(bucket)
+        with _SIG_LOCK:
+            fresh = sig not in _COMPILED_SIGS
+        out = stacked_run_fn(self.pred.variant)(
+            jnp.asarray(enc), *self._operands, k=self.k,
+            max_steps=self.pred.max_steps)
+        # register only AFTER the call returns: a failed first dispatch
+        # (transient device error) must not mark the signature compiled,
+        # or the successful retry's real compile would count as a cache
+        # hit and the zero-recompile gates would go blind to it
+        if fresh:
+            with _SIG_LOCK:
+                if sig in _COMPILED_SIGS:
+                    fresh = False      # another thread won the compile
+                else:
+                    _COMPILED_SIGS.add(sig)
+            if fresh:
+                with self._lock:
+                    self.compiles += 1
+                self._inc("serve.compiles")
+                self._event("serve_compile", model_id=self.model_id,
+                            bucket=bucket, variant=self.pred.variant)
+        with self._lock:
+            self.dispatches += 1
+        self._inc("serve.dispatches")
+        return out
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X) -> np.ndarray:
+        """Raw scores [k, n] float64 over trees [lo, hi)."""
+        if not self.device_ok:
+            return self._host_predict_raw(X)
+        sparse_in = _is_sparse(X)
+        if sparse_in:
+            X = X.tocsr()
+        n = X.shape[0]
+        out = np.zeros((self.k, n), np.float64)
+        for c0 in range(0, n, self.max_bucket):
+            sl = slice(c0, min(n, c0 + self.max_bucket))
+            Xc = X[sl].toarray() if sparse_in else X[sl]
+            rows = Xc.shape[0]
+            bucket = self.bucket_for(rows)
+            raw = self._dispatch(self._encode_pad(Xc, bucket), bucket)
+            out[:, sl] = np.asarray(raw, np.float64)[:, :rows]
+        return out
+
+    def _host_predict_raw(self, X) -> np.ndarray:
+        """Degraded path: the exact float64 host walk (basic.py
+        host_walk_raw — the one shared implementation, with its bounded
+        per-chunk sparse densify)."""
+        from ..basic import host_walk_raw
+        out = host_walk_raw(self.booster.models, X, self.lo, self.hi,
+                            self.k)
+        n = X.shape[0]
+        with self._lock:
+            self.host_rows += n
+        self._inc("serve.host_rows", n)
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Final predictions, same output contract as
+        ``Booster.predict`` — the tail is basic.finalize_raw_predictions,
+        shared with the Booster so the two cannot drift."""
+        from ..basic import finalize_raw_predictions
+        if not _is_sparse(X) and not isinstance(X, np.ndarray):
+            X = np.asarray(X, np.float64)
+        if getattr(X, "ndim", 2) == 1:
+            X = np.asarray(X).reshape(1, -1)
+        b = self.booster
+        raw = self.predict_raw(X)
+        return finalize_raw_predictions(raw, self.k, b.objective,
+                                        b.average_output,
+                                        self.num_iteration, raw_score)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"model_id": self.model_id, "variant": self.variant,
+                    "device": self.device_ok,
+                    "degraded_reason": self.degraded_reason,
+                    "trees": self.hi - self.lo,
+                    "packed_bytes": self.packed_nbytes,
+                    "compiles": self.compiles,
+                    "dispatches": self.dispatches,
+                    "host_rows": self.host_rows,
+                    "buckets": self.buckets()}

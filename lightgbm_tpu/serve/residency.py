@@ -1,0 +1,155 @@
+"""Multi-model device residency under a bytes budget.
+
+One process serves N boosters off one device.  Packed tree tensors are
+small relative to training state but not free — a fleet of wide
+multiclass models can exceed device memory — so residency is explicit:
+
+- engines build lazily on first use and stay resident;
+- every build charges the engine's ``packed_nbytes`` against
+  ``budget_bytes``; when the budget would overflow, least-recently-used
+  UNPINNED engines are evicted (device tensors dropped; the host
+  booster is retained, so a later request simply re-packs — and because
+  the jitted runners + compile signatures are process-wide
+  (models/predictor.stacked_run_fn, engine._COMPILED_SIGS), a re-pack
+  with unchanged shapes recompiles NOTHING);
+- ``pin()`` exempts hot models from eviction; a pinned set alone
+  exceeding the budget is allowed but flagged with a
+  ``serve_budget_exceeded`` event (the operator's signal to raise the
+  budget or unpin).
+
+Telemetry: ``serve.evictions`` / ``serve.rebuilds`` counters,
+``serve.resident_bytes`` / ``serve.resident_models`` gauges,
+``serve_eviction`` events.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .engine import ServingEngine
+
+
+class ResidencyManager:
+    """LRU cache of :class:`ServingEngine` instances under a budget."""
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 telemetry=None,
+                 engine_factory: Optional[Callable[..., ServingEngine]]
+                 = None, **engine_knobs: Any):
+        self.budget_bytes = None if budget_bytes is None \
+            else int(budget_bytes)
+        self.tel = telemetry
+        self._factory = engine_factory or ServingEngine
+        self._knobs = engine_knobs
+        self._boosters: Dict[str, Any] = {}
+        self._engines: "collections.OrderedDict[str, ServingEngine]" = \
+            collections.OrderedDict()      # LRU: oldest first
+        self._pinned = set()
+        self._builds: Dict[str, int] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def register(self, model_id: str, booster) -> None:
+        with self._lock:
+            self._boosters[model_id] = booster
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._boosters)
+
+    def has(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._boosters
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.packed_nbytes for e in self._engines.values())
+
+    # ------------------------------------------------------------------
+    def get(self, model_id: str) -> ServingEngine:
+        """The engine for ``model_id``, building (or re-building after an
+        eviction) on demand and touching LRU recency."""
+        with self._lock:
+            eng = self._engines.get(model_id)
+            if eng is not None:
+                self._engines.move_to_end(model_id)
+                return eng
+            booster = self._boosters.get(model_id)
+            if booster is None:
+                raise KeyError(f"unknown model_id: {model_id!r}")
+            eng = self._factory(booster, model_id=model_id,
+                                telemetry=self.tel, **self._knobs)
+            self._builds[model_id] = self._builds.get(model_id, 0) + 1
+            if self._builds[model_id] > 1 and self.tel is not None:
+                self.tel.inc("serve.rebuilds")
+            self._engines[model_id] = eng
+            self._evict_to_budget(keep=model_id)
+            self._update_gauges()
+            return eng
+
+    def _evict_to_budget(self, keep: str) -> None:
+        if self.budget_bytes is None:
+            return
+        total = sum(e.packed_nbytes for e in self._engines.values())
+        while total > self.budget_bytes:
+            victim = next((mid for mid in self._engines
+                           if mid != keep and mid not in self._pinned),
+                          None)
+            if victim is None:
+                # nothing evictable left (all pinned / just-built): the
+                # overflow is deliberate, but it must be visible
+                if self.tel is not None:
+                    self.tel.event("serve_budget_exceeded",
+                                   resident_bytes=total,
+                                   budget_bytes=self.budget_bytes)
+                return
+            freed = self._engines.pop(victim).packed_nbytes
+            total -= freed
+            if self.tel is not None:
+                self.tel.inc("serve.evictions")
+                self.tel.event("serve_eviction", model_id=victim,
+                               bytes=freed, resident_bytes=total,
+                               budget_bytes=self.budget_bytes)
+
+    def _update_gauges(self) -> None:
+        if self.tel is not None:
+            self.tel.gauge("serve.resident_models", len(self._engines))
+            self.tel.gauge("serve.resident_bytes", self.resident_bytes)
+
+    # ------------------------------------------------------------------
+    def pin(self, model_id: str) -> None:
+        """Exempt from eviction (and make resident now)."""
+        self.get(model_id)
+        with self._lock:
+            self._pinned.add(model_id)
+
+    def unpin(self, model_id: str) -> None:
+        with self._lock:
+            self._pinned.discard(model_id)
+
+    def evict(self, model_id: str) -> bool:
+        """Explicitly drop a model's device tensors (host booster stays
+        registered; the next request re-packs)."""
+        with self._lock:
+            eng = self._engines.pop(model_id, None)
+            self._update_gauges()
+            return eng is not None
+
+    def resident(self) -> List[str]:
+        with self._lock:
+            return list(self._engines)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "models": list(self._boosters),
+                "resident": list(self._engines),
+                "pinned": sorted(self._pinned),
+                "resident_bytes": self.resident_bytes,
+                "budget_bytes": self.budget_bytes,
+                "builds": dict(self._builds),
+                "engines": {mid: e.stats()
+                            for mid, e in self._engines.items()},
+            }

@@ -1,0 +1,198 @@
+"""PredictionService: the public serving facade.
+
+``PredictionService`` owns the three layers (engine, micro-batcher,
+residency) plus the telemetry registry, and exposes the two-call API the
+north star's "millions of users" half needs::
+
+    import lightgbm_tpu as lgb
+    svc = lgb.serve.PredictionService(
+        {"churn": "churn_model.txt", "rank": rank_booster},
+        max_batch_rows=8192, max_delay_ms=2.0,
+        device_budget_bytes=256 << 20, telemetry_out="serve.jsonl")
+    svc.warmup()                          # AOT-compile every bucket
+    y = svc.predict("churn", X)           # sync (submit + wait)
+    fut = svc.submit("rank", X2)          # future form
+    svc.stats()                           # latency p50/p95/p99, counters
+    svc.close()
+
+Models may be live ``Booster`` objects (binned device routing through
+their training BinMappers) or model-file paths / model strings (raw
+device routing — no training dataset needed).  A model the device path
+cannot represent serves through the host walk with a structured
+``serve_degradation`` event, never an error.
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..obs import Telemetry
+from .batcher import MicroBatcher
+from .residency import ResidencyManager
+
+
+def _as_booster(spec):
+    from ..basic import Booster
+    if isinstance(spec, Booster):
+        return spec
+    if isinstance(spec, (str, os.PathLike)):
+        text = str(spec)
+        if os.path.exists(text):
+            return Booster(model_file=text)
+        if text.startswith("tree\n") or "\ntree\n" in text[:200]:
+            return Booster(model_str=text)
+        raise FileNotFoundError(f"model file not found: {text}")
+    raise TypeError(f"cannot serve {type(spec).__name__}; expected "
+                    "Booster, model-file path or model string")
+
+
+class PredictionService:
+    """Micro-batched, multi-model, device-resident prediction server."""
+
+    def __init__(self,
+                 boosters_or_paths: Union[Dict[str, Any], List[Any], Any],
+                 max_batch_rows: int = 8192,
+                 max_delay_ms: float = 2.0,
+                 min_bucket_rows: int = 64,
+                 device_budget_bytes: Optional[int] = None,
+                 raw_score: bool = False,
+                 num_iteration: Optional[int] = None,
+                 telemetry_out: str = "",
+                 batch_events: bool = True):
+        if isinstance(boosters_or_paths, dict):
+            specs = dict(boosters_or_paths)
+        elif isinstance(boosters_or_paths, (list, tuple)):
+            specs = {str(i): s for i, s in enumerate(boosters_or_paths)}
+        else:
+            specs = {"default": boosters_or_paths}
+        if not specs:
+            raise ValueError("PredictionService needs at least one model")
+
+        self.raw_score = bool(raw_score)
+        self.tel = Telemetry(enabled=True)
+        if telemetry_out:
+            self.tel.enable(telemetry_out)
+        self.residency = ResidencyManager(
+            budget_bytes=device_budget_bytes, telemetry=self.tel,
+            max_batch_rows=max_batch_rows,
+            min_bucket_rows=min_bucket_rows,
+            num_iteration=num_iteration)
+        for mid, spec in specs.items():
+            self.residency.register(str(mid), _as_booster(spec))
+        self.batcher = MicroBatcher(
+            self._dispatch_batch, max_batch_rows=max_batch_rows,
+            max_delay_ms=max_delay_ms, telemetry=self.tel,
+            batch_events=batch_events)
+        self._closed = False
+        self.tel.event("serve_start", models=list(specs),
+                       max_batch_rows=int(max_batch_rows),
+                       max_delay_ms=float(max_delay_ms),
+                       budget_bytes=device_budget_bytes)
+
+    # ------------------------------------------------------------------
+    def _dispatch_batch(self, model_id: str, X) -> np.ndarray:
+        return self.residency.get(model_id).predict(
+            X, raw_score=self.raw_score)
+
+    # ------------------------------------------------------------------
+    def model_ids(self) -> List[str]:
+        return self.residency.model_ids()
+
+    def submit(self, model_id: str, X) -> Future:
+        """Future form: enqueue and return immediately."""
+        if self._closed:
+            raise RuntimeError("PredictionService is closed")
+        model_id = str(model_id)
+        if not self.residency.has(model_id):
+            raise KeyError(f"unknown model_id: {model_id!r}")
+        return self.batcher.submit(model_id, X)
+
+    def predict(self, model_id: str, X,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Sync form: ``submit`` + wait for the micro-batched result."""
+        return self.submit(model_id, X).result(timeout=timeout)
+
+    def warmup(self, buckets: Optional[List[int]] = None,
+               model_ids: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Pack + AOT-compile every model (or ``model_ids``) for every
+        bucket size (or ``buckets``): after this, steady-state serving
+        does zero XLA compiles."""
+        out = {}
+        for mid in (model_ids or self.model_ids()):
+            out[str(mid)] = self.residency.get(str(mid)).warmup(buckets)
+        return out
+
+    def refresh(self, model_id: str) -> None:
+        """Re-pack a model whose underlying (live) booster trained
+        further since its engine was built — engines pack a snapshot;
+        they do not track later updates."""
+        self.residency.evict(str(model_id))
+        self.residency.get(str(model_id))
+
+    def pin(self, model_id: str) -> None:
+        self.residency.pin(str(model_id))
+
+    def unpin(self, model_id: str) -> None:
+        self.residency.unpin(str(model_id))
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Operator view: request/batch/dispatch/compile counters, the
+        latency and batch-size distributions (p50/p95/p99) and residency
+        state.  ``dispatches_per_request`` and
+        ``compiles_per_1k_requests`` are the two deterministic numbers
+        ``bench.py --serve`` gates on."""
+        snap = self.tel.snapshot()
+        c = snap.get("counters", {})
+        requests = int(c.get("serve.requests", 0))
+        out: Dict[str, Any] = {
+            "requests": requests,
+            "rows": int(c.get("serve.rows", 0)),
+            "batches": int(c.get("serve.batches", 0)),
+            "dispatches": int(c.get("serve.dispatches", 0)),
+            "compiles": int(c.get("serve.compiles", 0)),
+            "warmup_dispatches": int(c.get("serve.warmup_dispatches", 0)),
+            "warmup_compiles": int(c.get("serve.warmup_compiles", 0)),
+            "evictions": int(c.get("serve.evictions", 0)),
+            "rebuilds": int(c.get("serve.rebuilds", 0)),
+            "degradations": int(c.get("serve.degradations", 0)),
+            "host_rows": int(c.get("serve.host_rows", 0)),
+            "queue_depth": snap.get("gauges", {}).get(
+                "serve.queue_depth", 0),
+            "latency_ms": snap.get("dists", {}).get(
+                "serve.latency_ms"),
+            "batch_rows": snap.get("dists", {}).get("serve.batch_rows"),
+            "residency": self.residency.stats(),
+        }
+        if requests > 0:
+            # steady-state rates: warmup's deliberate dispatches/compiles
+            # must not read as a bucketing or recompile regression
+            out["dispatches_per_request"] = round(
+                max(0, out["dispatches"] - out["warmup_dispatches"])
+                / requests, 6)
+            out["compiles_per_1k_requests"] = round(
+                max(0, out["compiles"] - out["warmup_compiles"])
+                * 1000.0 / requests, 6)
+        return out
+
+    # ------------------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker (serving queued requests first when
+        ``drain``), emit the final ``serve_stats`` event and flush."""
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close(drain=drain)
+        final = self.stats()
+        final.pop("residency", None)
+        self.tel.event("serve_stats", **final)
+        self.tel.close()
+
+    def __enter__(self) -> "PredictionService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -13,6 +13,12 @@ Usage:
     python scripts/bench_compare.py [--trajectory PATH] [--threshold 0.15]
                                     [--min-seconds 0.005] [--fail-on-regress]
 
+Besides the timing diffs, three DETERMINISTIC counters are gated when
+both records carry them: ``dispatches_per_iter`` (training fast-path
+eviction), ``dispatches_per_request`` and ``compiles_per_1k_requests``
+(serving bucketing/recompile regressions, bench.py --serve) — these
+flag structural losses even on runners too noisy for timing thresholds.
+
 Prints one JSON report line; with ``--fail-on-regress`` exits 1 when any
 regression was flagged (the CI smoke gate). Fewer than two comparable
 records is a clean exit with ``"status": "insufficient_history"`` — the
@@ -77,7 +83,8 @@ def _ratio_entry(name: str, prev: float, cur: float,
 
 def compare(prev: Dict[str, Any], cur: Dict[str, Any],
             threshold: float = 0.15,
-            min_seconds: float = 0.005) -> Dict[str, Any]:
+            min_seconds: float = 0.005,
+            det_threshold: float = 0.25) -> Dict[str, Any]:
     """Build the comparison report: headline sec/iter plus every phase
     present in BOTH records (a phase that appears or disappears is
     reported informationally, not flagged — engine degradation changes
@@ -101,21 +108,46 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     else:
         report["headline"] = None
 
-    # dispatch-count regression: deterministic (no wall-clock noise), so
-    # it catches a fast-path eviction — e.g. a change that silently sends
-    # telemetry-on training back to the synchronous driver — even on
-    # runners too noisy for the timing thresholds. Any increase beyond
-    # the threshold flags; micro records (bench.py --micro) carry this.
-    dp, dc = prev.get("dispatches_per_iter"), cur.get("dispatches_per_iter")
-    if isinstance(dp, (int, float)) and isinstance(dc, (int, float)) \
-            and dp > 0:
-        ent = _ratio_entry("dispatches_per_iter", float(dp), float(dc),
-                           threshold)
-        report["dispatches"] = ent
+    # deterministic-counter regressions (no wall-clock noise), so they
+    # catch structural fast-path losses even on runners too noisy for
+    # the timing thresholds:
+    # - dispatches_per_iter (bench.py --micro): a training fast-path
+    #   eviction — e.g. telemetry silently forcing the sync driver —
+    #   moves it 0.125 -> 3+;
+    # - dispatches_per_request (bench.py --serve): a serving bucketing/
+    #   chunking regression moves it off exactly 1.0;
+    # - compiles_per_1k_requests (bench.py --serve): a bucket-shape leak
+    #   recompiling per request size moves it off 0. Zero-to-zero
+    #   compares clean; zero-to-nonzero always flags (the ratio has no
+    #   finite baseline).
+    # These counters carry NO wall-clock noise, so they get their own
+    # tight ``det_threshold`` (default 25%) instead of the deliberately
+    # huge timing threshold the CI smoke gates pass — a 2x
+    # dispatches_per_request regression must fail even under
+    # --threshold 9.0.
+    report["deterministic"] = {}
+    for name in ("dispatches_per_iter", "dispatches_per_request",
+                 "compiles_per_1k_requests"):
+        p, c = prev.get(name), cur.get(name)
+        if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
+            continue
+        if p <= 0:
+            # ratio has no finite baseline; None keeps the report
+            # strict-JSON (float('inf') would serialize as the
+            # non-standard token Infinity)
+            ent = {"name": name, "prev": round(float(p), 6),
+                   "cur": round(float(c), 6),
+                   "ratio": None if c > 0 else 1.0,
+                   "regressed": c > 0}
+        else:
+            ent = _ratio_entry(name, float(p), float(c),
+                               min(threshold, det_threshold))
+        report["deterministic"][name] = ent
         if ent["regressed"]:
             report["regressions"].append(ent)
-    else:
-        report["dispatches"] = None
+    # back-compat view the perf-smoke CI assertion reads
+    report["dispatches"] = report["deterministic"].get(
+        "dispatches_per_iter")
 
     prev_ph = prev.get("phase_timings") or {}
     cur_ph = cur.get("phase_timings") or {}
@@ -141,6 +173,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "regression (0.15 = 15%%)")
     ap.add_argument("--min-seconds", type=float, default=0.005,
                     help="ignore phases cheaper than this per call")
+    ap.add_argument("--det-threshold", type=float, default=0.25,
+                    help="separate (tight) threshold for the "
+                         "deterministic counters — they carry no "
+                         "wall-clock noise, so the huge timing "
+                         "thresholds the smoke gates use must not "
+                         "loosen them")
     ap.add_argument("--fail-on-regress", action="store_true",
                     help="exit 1 when a regression is flagged")
     args = ap.parse_args(argv)
@@ -178,11 +216,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = compare(prev, cur,
                      threshold=args.threshold,
-                     min_seconds=args.min_seconds)
+                     min_seconds=args.min_seconds,
+                     det_threshold=args.det_threshold)
     print(json.dumps(report))
     for ent in report["regressions"]:
+        pct = "from-zero" if ent.get("ratio") is None \
+            else f"{(ent['ratio'] - 1) * 100:.1f}% slower"
         print(f"REGRESSION {ent['name']}: {ent['prev']} -> {ent['cur']} "
-              f"({(ent['ratio'] - 1) * 100:.1f}% slower)", file=sys.stderr)
+              f"({pct})", file=sys.stderr)
     if report["regressions"] and args.fail_on_regress:
         return 1
     return 0

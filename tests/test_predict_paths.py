@@ -1,0 +1,215 @@
+"""Device-vs-host prediction path parity, forced deterministically.
+
+``pred_device_min_work`` (new config key; replaces the old hardwired
+2M rows x trees literal) forces either path: 0 sends EVERY predict
+through the device predictor (binned via the training BinMappers, or
+raw-value-threshold routing when the booster has no training dataset);
+a huge value forces the exact float64 host walk.  Parity is asserted on
+the shapes the ISSUE names: categorical splits, EFB-bundled (sparse-
+built) datasets, NaN/missing-heavy inputs, single-leaf trees and a
+file-loaded booster.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import basic
+
+FORCE_DEV = {"pred_device_min_work": 0}
+FORCE_HOST = {"pred_device_min_work": 10**15}
+TOL = dict(rtol=1e-5, atol=1e-6)
+
+
+def _paths_agree(bst, Xq, **tol):
+    tol = tol or TOL
+    bst.params.update(FORCE_HOST)
+    if bst.config is not None:
+        bst.config.update(FORCE_HOST)
+    bst._pred_min_work_cache = None
+    host = bst.predict(Xq)
+    if bst.config is not None:
+        bst.config.update(FORCE_DEV)
+    bst.params.update(FORCE_DEV)
+    bst._pred_min_work_cache = None
+    dev = bst.predict(Xq)
+    np.testing.assert_allclose(dev, host, **tol)
+    return host
+
+
+def test_threshold_key_switches_paths():
+    rng = np.random.RandomState(0)
+    X = rng.rand(250, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     **FORCE_DEV},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst.config.pred_device_min_work == 0
+    bst.predict(X[:10])
+    # the device predictor was actually built and cached
+    assert getattr(bst, "_device_predictor", None) is not None
+    bst.config.update(FORCE_HOST)
+    assert bst._pred_device_min_work() == 10**15
+    # with the default threshold a small predict stays on the host walk
+    b2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                    "verbose": -1, "min_data_in_leaf": 5},
+                   lgb.Dataset(X, label=y), num_boost_round=2)
+    assert b2.config.pred_device_min_work == 2_000_000
+    b2.predict(X[:10])
+    assert getattr(b2, "_device_predictor", None) is None
+
+
+def test_parity_categorical_splits(tmp_path):
+    rng = np.random.RandomState(1)
+    n = 600
+    X = np.column_stack([rng.rand(n),
+                         rng.randint(0, 12, n).astype(float),
+                         rng.rand(n),
+                         rng.randint(0, 5, n).astype(float)])
+    y = (((X[:, 1] % 3) == 1) | (X[:, 3] > 2)).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[1, 3]),
+                    num_boost_round=4)
+    # unseen categories (incl. negatives and a huge value past int32)
+    # must route like the host walk
+    Xq = np.column_stack([rng.rand(60),
+                          rng.randint(-1, 15, 60).astype(float),
+                          rng.rand(60),
+                          rng.randint(0, 8, 60).astype(float)])
+    Xq[0, 1] = 4.0e9        # past int32: out-of-vocab, routes right
+    Xq[1, 3] = np.nan
+    Xq[2, 1] = -0.5         # truncates toward zero: category 0
+    Xq[3, 3] = -1.0         # truncates to -1: out-of-vocab
+    Xq[4, 1] = 2.7          # fractional in-vocab: category 2
+    _paths_agree(bst, Xq)
+    # file-loaded: same queries through the raw-value cat masks
+    path = str(tmp_path / "cat.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    host = _paths_agree(loaded, Xq)
+    # bst is still forced onto the (f32-accumulating) device path by
+    # the first _paths_agree — f32 tolerance, not exact
+    np.testing.assert_allclose(host, bst.predict(Xq), **TOL)
+
+
+def test_parity_efb_bundled_sparse_dataset():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(2)
+    Xs = sp.random(800, 40, density=0.04, random_state=2, format="csr")
+    ys = (np.asarray(Xs.sum(axis=1)).ravel() > 0.8).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(Xs, label=ys), num_boost_round=4)
+    Xq = sp.random(80, 40, density=0.04, random_state=3, format="csr")
+    _paths_agree(bst, Xq)
+    # dense queries against the bundle-built mappers agree too
+    _paths_agree(bst, np.asarray(Xq.todense()))
+
+
+def test_parity_nan_heavy_input():
+    rng = np.random.RandomState(4)
+    X = rng.rand(500, 8).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.35] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0.9) \
+        .astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    Xq = rng.rand(90, 8).astype(np.float32)
+    Xq[rng.rand(*Xq.shape) < 0.5] = np.nan
+    Xq[3] = np.nan                      # an all-missing row
+    _paths_agree(bst, Xq)
+
+
+def test_parity_zero_as_missing():
+    rng = np.random.RandomState(5)
+    X = rng.rand(400, 5).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.4] = 0.0
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "zero_as_missing": True},
+                    lgb.Dataset(X, label=y,
+                                params={"zero_as_missing": True}),
+                    num_boost_round=3)
+    Xq = rng.rand(50, 5).astype(np.float32)
+    Xq[rng.rand(*Xq.shape) < 0.4] = 0.0
+    _paths_agree(bst, Xq)
+
+
+def test_parity_single_leaf_trees(tmp_path):
+    rng = np.random.RandomState(6)
+    X, y = rng.rand(100, 3), rng.rand(100)
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbose": -1, "min_data_in_leaf": 10000},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert all(t.num_leaves == 1 for t in bst.models)
+    Xq = rng.rand(7, 3)
+    # live booster: no usable features -> device packer degrades, host
+    # walk answers on both settings
+    _paths_agree(bst, Xq)
+    # file-loaded: the raw variant serves single-leaf trees on device
+    path = str(tmp_path / "sl.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    host = _paths_agree(loaded, Xq)
+    np.testing.assert_allclose(host, bst.predict(Xq), rtol=1e-9)
+
+
+def test_parity_file_loaded_booster(tmp_path):
+    rng = np.random.RandomState(7)
+    X = rng.rand(600, 10).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - np.nan_to_num(X[:, 2]) > 0) \
+        .astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.train_set is None
+    Xq = rng.rand(120, 10).astype(np.float32)
+    Xq[rng.rand(*Xq.shape) < 0.3] = np.nan
+    host = _paths_agree(loaded, Xq)
+    # and the device path agrees with the ORIGINAL booster's predict
+    np.testing.assert_allclose(host, bst.predict(Xq), rtol=1e-9,
+                               atol=1e-12)
+
+
+def test_parity_multiclass_start_num_iteration():
+    rng = np.random.RandomState(8)
+    X = rng.rand(400, 6)
+    y = (X[:, 0] * 3).astype(int) % 3
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    Xq = rng.rand(40, 6)
+    for kw in ({}, {"raw_score": True},
+               {"start_iteration": 1, "num_iteration": 2}):
+        bst.config.update(FORCE_HOST)
+        host = bst.predict(Xq, **kw)
+        bst.config.update(FORCE_DEV)
+        dev = bst.predict(Xq, **kw)
+        np.testing.assert_allclose(dev, host, **TOL)
+
+
+def test_host_sparse_walk_densifies_in_chunks(monkeypatch):
+    """The host fallback must densify tall CSR input in bounded row
+    chunks (the old whole-matrix todense is an OOM at serving scale) —
+    forced here with a tiny chunk size so the loop actually runs."""
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(9)
+    Xs = sp.random(300, 15, density=0.1, random_state=9, format="csr")
+    ys = (np.asarray(Xs.sum(axis=1)).ravel() > 0.7).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(Xs, label=ys), num_boost_round=3)
+    Xq = sp.random(50, 15, density=0.1, random_state=10, format="csr")
+    bst.config.update(FORCE_HOST)
+    expect = bst.predict(np.asarray(Xq.todense()))
+    monkeypatch.setattr(basic, "_HOST_SPARSE_CHUNK_ROWS", 7)
+    got = bst.predict(Xq)
+    np.testing.assert_allclose(got, expect, rtol=1e-12, atol=1e-15)
