@@ -184,8 +184,8 @@ def _probe_chip(timeout_s: float = None):
     return None, last
 
 
-def _make_data(n_rows: int, n_feat: int):
-    rng = np.random.RandomState(0)
+def _make_data(n_rows: int, n_feat: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
     X = rng.rand(n_rows, n_feat).astype(np.float32)
     w = rng.randn(n_feat).astype(np.float32)
     y = (X @ w + 0.5 * rng.randn(n_rows) > 0).astype(np.float32)
@@ -331,7 +331,9 @@ def run_micro() -> None:
     n_iters = int(os.environ.get("BENCH_MICRO_ITERS", 8))
     n_feat = 10
     _RESULT["bench_config"] = {"mode": "micro", "rows": n_rows,
-                               "iters": n_iters}
+                               "iters": n_iters,
+                               "eval_iters": int(os.environ.get(
+                                   "BENCH_MICRO_EVAL_ITERS", 16))}
     _RESULT["platform"] = "cpu"
     X, y = _make_data(n_rows, n_feat)
 
@@ -364,10 +366,51 @@ def run_micro() -> None:
         float(c.get("train.dispatches", 0)) / iters, 4)
     _RESULT["drains"] = int(c.get("train.drains", 0))
     _RESULT["fast_path"] = bool(bst._gbdt._fast_path_ok())
-    try:
-        os.remove(tel_path)
-    except OSError:
-        pass
+    _emit()   # the bare-training counters are on stdout now
+
+    # ---- eval leg: the dominant production config — train() with two
+    # valid sets + early_stopping + log_evaluation + record_evaluation —
+    # must stay on the megastep (on-device eval + drain-replay
+    # callbacks, metric/traced.py). `eval_dispatches_per_iter` is the
+    # deterministic gate: a regression back to the per-iteration sync
+    # driver moves it from ~1/chunk to >= 3.
+    from lightgbm_tpu import callback as lgb_cb
+    tel_eval = tel_path + ".eval"
+    n_eval_iters = int(os.environ.get("BENCH_MICRO_EVAL_ITERS", 16))
+    Xv1, yv1 = _make_data(max(512, n_rows // 4), n_feat, seed=1)
+    Xv2, yv2 = _make_data(max(512, n_rows // 4), n_feat, seed=2)
+    rec = {}
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst2 = lgb.train(
+        dict(params, telemetry_out=tel_eval,
+             metric=["binary_logloss", "auc"], early_stopping_round=25),
+        ds, num_boost_round=n_eval_iters,
+        valid_sets=[lgb.Dataset(Xv1, label=yv1, reference=ds),
+                    lgb.Dataset(Xv2, label=yv2, reference=ds)],
+        callbacks=[lgb_cb.log_evaluation(100),
+                   lgb_cb.record_evaluation(rec)])
+    eval_wall = time.perf_counter() - t0
+    _phase("micro_eval_train_ok")
+    c2 = bst2.telemetry().get("counters", {})
+    eval_iters = max(1, int(c2.get("iterations", n_eval_iters)))
+    _RESULT["eval_sec_per_iter"] = round(eval_wall / eval_iters, 5)
+    _RESULT["eval_dispatches_per_iter"] = round(
+        float(c2.get("train.dispatches", 0)) / eval_iters, 4)
+    _RESULT["eval_iterations_kept"] = eval_iters
+    _RESULT["eval_curve_points"] = len(
+        rec.get("valid_0", {}).get("binary_logloss", []))
+    # the bare-leg `counters`/`fast_path`/`drains` fields above describe
+    # the FIRST training; the eval leg's counters get their own
+    # namespaced copy so the merged record stays unambiguous
+    _RESULT["eval_counters"] = {k: v for k, v in sorted(c2.items())
+                                if k.startswith(("train.", "iterations",
+                                                 "events."))}
+    for p in (tel_path, tel_eval):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
     _emit()
 
 

@@ -163,7 +163,9 @@ class GBDT:
         # async pipeline state (see _train_one_iter_fast): device trees not
         # yet materialised as HostTrees, scores checkpoint for stop rollback.
         # Entries are (stacked TreeArrays, [init_scores per iteration],
-        # batch) — batch > 1 for megastep entries ([B, k, ...] arrays).
+        # batch, metrics) — batch > 1 for megastep entries ([B, k, ...]
+        # arrays); metrics is the scan's [B, n_slots] on-device eval
+        # matrix when a drain-replay consumer is armed, else None.
         self._pending: List[Tuple] = []
         self._pending_iters = 0
         self._fast_step_fn = None
@@ -175,6 +177,22 @@ class GBDT:
         self._megastep_armed = False
         self._megastep_fns: Dict[int, object] = {}
         self._megastep_fm: Dict[int, object] = {}
+        # on-device eval inside the megastep (metric/traced.py): the
+        # drain-replay consumer a driver loop registered via
+        # arm_megastep(eval_consumer=...), the traced eval plan built by
+        # megastep_eval_precheck, its cached operand pytree, the
+        # device-resident early-stop carry (best metric / best round /
+        # stopped flag / stop iteration threaded through the scan), and
+        # the host-side "early stop confirmed at drain" latch
+        self._eval_consumer = None
+        self._traced_plan = None
+        self._plan_ops = None
+        self._es_spec = None
+        self._es_carry = None
+        self._es_finished = False
+        # megastep_evicted dedup: one structured event per distinct
+        # eviction reason, not one per iteration
+        self._evict_reported = set()
         # batch-granularity telemetry window: wall/perf stamps of the
         # first dispatch since the last drain, and how many of the
         # pending iterations came from fused megastep chunks
@@ -1567,6 +1585,13 @@ class GBDT:
         self._megastep_fns = {}       # valid-set count is baked into the
         self._epi_ok_cache = None     # megastep signature
         self._epi_carry = None
+        if self._eval_consumer is not None:
+            # the traced eval plan enumerated the old valid-set list; a
+            # new set mid-run invalidates it (cannot happen through
+            # engine.train, which adds every set before arming)
+            log.warning("valid set added while a drain-replay eval "
+                        "consumer was armed; disabling on-device eval")
+            self.arm_megastep(self._megastep_armed, eval_consumer=None)
         self.valid_data.append(valid_data)
         self.valid_bins.append(jnp.asarray(valid_data.bins))
         k = self.num_tree_per_iteration
@@ -2184,6 +2209,7 @@ class GBDT:
             # attribute at coarser sync points and keep the fast path
             # (docs/Performance.md). Checked outside the cache so a
             # callback can enable telemetry mid-training.
+            self._report_eviction("config:telemetry_granularity=section")
             return False
         if self._fast_ok_cache is None:
             obj = self.objective
@@ -2200,7 +2226,54 @@ class GBDT:
                 and not getattr(self, "n_forced", 0)
                 and not self.use_node_masks
                 and all(self.class_need_train))
+        if not self._fast_ok_cache and self.telemetry.enabled:
+            self._report_eviction(self._fast_path_reason()
+                                  or "fast_path:unknown")
         return self._fast_ok_cache
+
+    def _fast_path_reason(self) -> Optional[str]:
+        """The SPECIFIC feature evicting training off the pipelined fast
+        path, or None when eligible — docs/Performance.md used to tell
+        users to guess; the megastep_evicted event names it instead."""
+        if self.telemetry.enabled \
+                and self._tel_granularity() == "section":
+            return "config:telemetry_granularity=section"
+        if type(self) is not GBDT:
+            return f"boosting:{self.name}"
+        if not bool(self.config.tpu_fast_path):
+            return "config:tpu_fast_path=false"
+        if not self.use_fused:
+            return f"engine:{self.config.tpu_engine}"
+        if getattr(self, "mp", None) is not None:
+            return "multi_process"
+        if self.parallel_mode not in ("serial", "data"):
+            return f"tree_learner:{self.parallel_mode}"
+        obj = self.objective
+        if obj is None:
+            return "fobj"
+        if obj.is_renew_tree_output:
+            return f"objective_leaf_renewal:{obj.name}"
+        if bool(self.config.linear_tree):
+            return "config:linear_tree"
+        if getattr(self, "use_cegb", False):
+            return "config:cegb"
+        if getattr(self, "n_forced", 0):
+            return "config:forcedsplits_filename"
+        if self.use_node_masks:
+            return "config:interaction_constraints/feature_fraction_bynode"
+        if not all(self.class_need_train):
+            return f"objective_class_skip:{obj.name}"
+        return None
+
+    def _report_eviction(self, feature: str, **attrs) -> None:
+        """Structured `megastep_evicted` telemetry event naming the
+        specific evicting feature (callback / feval / fobj / config
+        key), emitted once per distinct reason per run."""
+        if not self.telemetry.enabled or feature in self._evict_reported:
+            return
+        self._evict_reported.add(feature)
+        self.telemetry.event("megastep_evicted", iteration=self.iter,
+                             feature=feature, **attrs)
 
     def _fast_tree_depth_bound(self) -> int:
         """Static routing-step bound for trees grown by the fused engine:
@@ -2590,7 +2663,7 @@ class GBDT:
         if not self._pending:
             self._batch_w0 = self.telemetry.wall_now()
             self._batch_t0 = time.perf_counter()
-        self._pending.append((trees, [init_scores], 1))
+        self._pending.append((trees, [init_scores], 1, None))
         self._pending_iters += 1
         self.iter += 1
         if self._pending_iters >= self._FAST_SYNC_EVERY:
@@ -2615,19 +2688,49 @@ class GBDT:
         self._pending_iters = 0
         k = self.num_tree_per_iteration
         self.telemetry.inc("train.drains")
-        trees_host = jax.device_get([t for t, _, _ in pend])
+        # one batched fetch for trees, metric rows AND the early-stop
+        # latch — the drain is the single host sync point per chunk; a
+        # second device_get would be a second blocking round trip
+        es_state = (None if (self._eval_consumer is None
+                             or self._es_carry is None)
+                    else (self._es_carry[2], self._es_carry[3]))
+        trees_host, metrics_host, es_host = jax.device_get(
+            ([t for t, _, _, _ in pend],
+             [m for _, _, _, m in pend if m is not None],
+             es_state))
         # flatten megastep entries ([B, k, ...] stacked trees covering B
         # iterations) and per-iteration entries ([k, ...], batch == 1)
-        # into one per-iteration sequence of host TreeArrays fields
+        # into one per-iteration sequence of host TreeArrays fields,
+        # with the per-iteration [n_slots] metric row alongside (None
+        # where the entry carried no on-device eval)
         flat: List[Tuple] = []
-        for (_, init_list, batch), trees_h in zip(pend, trees_host):
+        flat_metrics: List = []
+        mi = 0
+        for (_, init_list, batch, mB), trees_h in zip(pend, trees_host):
             arrays = [np.asarray(a) for a in trees_h]
-            if batch == 1:
+            if batch == 1 and mB is None:
+                # pipelined fast-path entry: [k, ...], no batch axis.
+                # A length-1 megastep entry (mB is not None — consumer
+                # horizon/bagging tails run chunk-1 scans) still carries
+                # the leading [B=1, ...] axis and must unstack below.
                 flat.append((arrays, init_list[0]))
             else:
                 for b in range(batch):
                     flat.append(([a[b] for a in arrays], init_list[b]))
+            if mB is None:
+                flat_metrics.extend([None] * batch)
+            else:
+                rows = np.asarray(metrics_host[mi])
+                mi += 1
+                flat_metrics.extend(rows[b] for b in range(batch))
         base_iter = self.iter - len(flat)
+        # scan-native early stop: the device latch decides the
+        # bookkeeping below — iterations past the latch were frozen
+        # in-jit (their score deltas masked to zero), so they must be
+        # neither appended to the model nor score-subtracted
+        es_cut = None
+        if es_host is not None and bool(es_host[0]):
+            es_cut = int(es_host[1]) - base_iter
         gain_acc: List[np.ndarray] = []
         stop_i = None
         converted = []   # per drained iteration: [(ht, dt, grew)] * k
@@ -2667,6 +2770,13 @@ class GBDT:
             converted.append(iter_models)
             if stop_i is not None:
                 continue
+            if es_cut is not None and i > es_cut:
+                # scan-frozen early-stop tail: score deltas were masked
+                # to zero in-jit past the latch, so these trees are
+                # neither appended nor subtracted — the drained model
+                # ends at the latch iteration bit-identically to the
+                # synchronous driver's early-stopped model
+                continue
             if not any_grew:
                 stop_i = i
                 continue
@@ -2692,7 +2802,10 @@ class GBDT:
             # rounding)
             self._epi_carry = None
             scores = self.scores
-            for iter_models in converted[stop_i + 1:]:
+            for conv_i in range(stop_i + 1, len(converted)):
+                if es_cut is not None and conv_i > es_cut:
+                    continue   # frozen tail: contributed nothing
+                iter_models = converted[conv_i]
                 for tid, (_, dt, grew) in enumerate(iter_models):
                     if grew:
                         scores = self._add_tree_to_score(
@@ -2735,6 +2848,8 @@ class GBDT:
             # `iter` were rolled back and produced no trees
             self.telemetry.event("stopped_no_splits", iteration=self.iter,
                                  discarded=len(flat) - stop_i)
+        self._replay_drained_eval(flat_metrics, base_iter, len(flat),
+                                  stop_i, es_cut)
         tel = self.telemetry
         if tel.enabled and flat and self._tel_granularity() == "batch":
             # batch-granularity record: one megastep/pipelined batch of
@@ -2758,6 +2873,82 @@ class GBDT:
         self._batch_t0 = self._batch_w0 = None
         self._batch_fused = 0
 
+    def _replay_drained_eval(self, flat_metrics, base_iter: int,
+                             n_flat: int, stop_i: Optional[int],
+                             es_cut: Optional[int]) -> None:
+        """Drain-time consumer feed: replay the armed loop's callbacks
+        in iteration order against the scan's per-iteration metric rows
+        (callback.DrainEvalReplay), then reconcile the scan-native
+        early-stop latch with the host replay's verdict. No score fetch
+        and no re-predict happen here — only the [B, n_slots] scalars
+        already pulled by the drain."""
+        consumer = self._eval_consumer
+        if consumer is None or n_flat == 0:
+            return
+        limit = n_flat
+        if stop_i is not None:
+            # the stopping (dried) iteration still gets its eval and
+            # callbacks — the sync loop also evaluates after a finished
+            # update; rows past it reflect score contributions the
+            # drain just subtracted, so they must not replay
+            limit = min(limit, stop_i + 1)
+        if es_cut is not None:
+            limit = min(limit, es_cut + 1)
+        es_j = None
+        n_replayed = 0
+        for ii in range(limit):
+            row = flat_metrics[ii]
+            if row is None:
+                log.warning("megastep drain: no metric row for iteration "
+                            "%d; eval replay truncated", base_iter + ii)
+                break
+            n_replayed = ii + 1
+            if consumer.replay(base_iter + ii, row):
+                es_j = ii
+                break
+        tel = self.telemetry
+        if tel.enabled and n_replayed:
+            # per-batch eval record (docs/Observability.md §9): which
+            # slots were evaluated on device, the last replayed row, and
+            # whether a REAL early stop latched inside this batch. The
+            # device latch is the discriminator: the callback's
+            # final-iteration "did not meet early stopping" raise is
+            # normal end-of-training control flow, not a stop.
+            tel.event("eval_batch", iteration=base_iter,
+                      iterations=n_replayed,
+                      slots=[f"{ds}/{name}"
+                             for ds, name, _ in consumer.slots],
+                      last=[float(v)
+                            for v in flat_metrics[n_replayed - 1]],
+                      stopped=es_cut is not None)
+        if es_cut is not None and stop_i is None:
+            if es_j != es_cut:
+                # should be unreachable: the device latch and the host
+                # replay run the same comparisons on the same f32 values
+                log.error("scan early-stop latch (iteration %d) "
+                          "disagrees with the callback replay (%s); "
+                          "model truncated at the device latch",
+                          base_iter + es_cut,
+                          "no stop" if es_j is None
+                          else f"iteration {base_iter + es_j}")
+            # nothing past the latch was appended (frozen tail), so the
+            # early stop needs no score arithmetic — just the counter
+            self.iter = base_iter + es_cut + 1
+            self._es_finished = True
+        elif es_j is not None:
+            # host-side stop without a device latch: the final-iteration
+            # "did not meet early stopping" check, or a stop on the
+            # dried no-splits iteration — model and scores are already
+            # consistent, only the stop signal needs latching
+            self._es_finished = True
+        if es_cut is not None and consumer.stop is not None:
+            # emitted only on a rounds-based stop (the device latch);
+            # the final-iteration EarlyStopException still records
+            # best_iteration through consumer.stop but is a completed
+            # run, not an early-stopped one
+            tel.event("early_stopping", iteration=self.iter,
+                      best_iteration=consumer.stop[0])
+
     # ------------------------------------------------------------------
     # Multi-iteration megastep: up to tpu_megastep_iters boosting
     # iterations chained inside ONE jit via lax.scan over the fused
@@ -2769,40 +2960,138 @@ class GBDT:
     # the remaining host-side overhead after the round-2 kernel work:
     # the per-iteration fast path still pays >= 1 dispatch per iteration
     # plus per-valid-set updates; the megastep pays ~1 per B iterations.
-    def arm_megastep(self, on: bool = True) -> None:
+    def arm_megastep(self, on: bool = True, eval_consumer=None) -> None:
         """Permission from a driver loop that (a) treats train_one_iter
         as 'advance training', not 'advance exactly one iteration', and
         (b) stops when it returns True. Only such loops (engine.train,
         the CLI train loop) may consume multi-iteration megasteps; the
-        bare Booster.update contract stays one iteration per call."""
+        bare Booster.update contract stays one iteration per call.
+
+        ``eval_consumer`` (callback.DrainEvalReplay) additionally opts
+        the loop into ON-DEVICE evaluation: the scan computes every
+        configured metric per iteration, and the drain replays the
+        loop's callbacks against the stacked metric matrix
+        (megastep_eval_precheck must have succeeded first)."""
+        if not on and self._eval_consumer is not None:
+            # replay any still-queued metric rows before unbinding the
+            # consumer — a tail left pending here would drain later with
+            # nobody to feed, silently dropping callback invocations.
+            # Defensive catch: disarm runs in the engine's `finally`, so
+            # a drain failure here must not mask an exception already
+            # unwinding through the train loop.
+            try:
+                self.drain_pending()
+            except Exception as e:
+                log.warning("drain at consumer disarm failed: %s", e)
+        had = self._eval_consumer is not None
         self._megastep_armed = bool(on)
+        self._eval_consumer = eval_consumer if on else None
+        if (self._eval_consumer is not None) != had:
+            # the eval plan is baked into the scan trace; a consumer
+            # change invalidates every cached megastep signature
+            self._megastep_fns = {}
+        if self._eval_consumer is not None:
+            if self._traced_plan is None:
+                log.fatal("arm_megastep(eval_consumer=...) requires a "
+                          "successful megastep_eval_precheck first")
+            self._eval_consumer.bind(self._traced_plan.slots)
+        else:
+            self._traced_plan = None
+            self._plan_ops = None
+            self._es_spec = None
+            self._es_carry = None
+            # the drain-replay stop verdict lives on in the consumer
+            # (engine.train applies best_iteration from it); the GBDT
+            # itself must return to the trainable one-iteration-per-
+            # update contract once disarmed, like the synchronous
+            # early-stop path does
+            self._es_finished = False
+
+    def megastep_eval_precheck(self, include_training: bool,
+                               es_spec=None) -> Tuple[bool, Optional[str]]:
+        """Decide BEFORE the first iteration whether this run's metrics
+        can evaluate on device inside the megastep with callbacks
+        replayed at drain. Returns ``(True, None)`` and stores the
+        traced plan, or ``(False, reason)`` naming the specific blocker
+        (the caller should emit/log it and fall back to the classic
+        per-iteration loop).
+
+        ``es_spec`` is ``(stopping_rounds, first_metric_only)`` when an
+        early-stopping callback is registered — the scan then carries
+        best-metric/rounds-since-best state and freezes training past
+        the stopping point so the drained model stays bit-identical to
+        the synchronous driver's early-stopped model."""
+        if not bool(getattr(self.config, "tpu_traced_eval", True)):
+            return False, "config:tpu_traced_eval=false"
+        if self._tel_gran != "batch":
+            # a replayed record_telemetry can enable the registry
+            # mid-run; a non-batch granularity would then evict training
+            # with the consumer already committed — reject upfront
+            return False, f"config:telemetry_granularity={self._tel_gran}"
+        reason = self._fast_path_reason()
+        if reason is not None:
+            return False, reason
+        reason = self._megastep_static_reason()
+        if reason is not None:
+            return False, reason
+        from ..metric.traced import build_plan
+        plan, err = build_plan(self, include_training)
+        if plan is None:
+            return False, err
+        self._traced_plan = plan
+        self._plan_ops = None
+        self._es_spec = es_spec
+        self._es_carry = None
+        self._es_finished = False
+        return True, None
+
+    def _megastep_static_reason(self) -> Optional[str]:
+        """Megastep blockers beyond fast-path eligibility that are fixed
+        for the run (config keys, objective protocol, profiler window)."""
+        obj = self.objective
+        if not bool(getattr(self.config, "tpu_megastep", True)):
+            return "config:tpu_megastep=false"
+        # interpret-mode fused (off-TPU emulation) has no dispatch
+        # latency to amortize — the scan would only add compile time —
+        # so there the megastep is explicit opt-in (tests, micro bench);
+        # on a real chip the default engages it
+        if self.fused_interpret and not self.config.was_set("tpu_megastep"):
+            return "interpret_mode_without_tpu_megastep_optin"
+        if obj is None or not obj.supports_traced_gradients():
+            return "objective_untraced_gradients:" + \
+                (obj.name if obj is not None else "custom")
+        if self.telemetry.enabled \
+                and self._tel_granularity() == "iteration":
+            return "config:telemetry_granularity=iteration"
+        # a bounded/offset jax.profiler window opens and closes at
+        # iteration edges _profiler_step only sees once per call —
+        # fusing would shift the captured window by up to a chunk
+        # (whole-run profiles, start 0 / no bound, are unaffected)
+        if self._prof_dir and not self._prof_done \
+                and (self._prof_start > 0 or self._prof_n >= 0):
+            return "config:profile_start_iteration/profile_num_iterations"
+        return None
 
     def _megastep_ok(self) -> bool:
-        obj = self.objective
-        return bool(
-            self._megastep_armed
-            and bool(getattr(self.config, "tpu_megastep", True))
-            # interpret-mode fused (off-TPU emulation) has no dispatch
-            # latency to amortize — the scan would only add compile time
-            # — so there the megastep is explicit opt-in (tests, micro
-            # bench); on a real chip the default engages it
-            and (not self.fused_interpret
-                 or self.config.was_set("tpu_megastep"))
-            and self._fast_path_ok()
-            and obj is not None and obj.supports_traced_gradients()
-            # per-iteration observability needs per-iteration steps:
-            # GBDT-level early stopping evaluates metrics after every
-            # iteration, and iteration-granularity telemetry syncs one
-            and self.early_stopping_round <= 0
-            and int(getattr(self.config, "snapshot_freq", -1) or -1) <= 0
-            and not (self.telemetry.enabled
-                     and self._tel_granularity() == "iteration")
-            # a bounded/offset jax.profiler window opens and closes at
-            # iteration edges _profiler_step only sees once per call —
-            # fusing would shift the captured window by up to a chunk
-            # (whole-run profiles, start 0 / no bound, are unaffected)
-            and not (self._prof_dir and not self._prof_done
-                     and (self._prof_start > 0 or self._prof_n >= 0)))
+        if not self._megastep_armed:
+            return False
+        if not self._fast_path_ok():   # reports its own eviction reason
+            return False
+        reason = self._megastep_static_reason()
+        if reason is None and self._eval_consumer is None:
+            # without a drain-replay consumer, per-iteration
+            # observability needs per-iteration steps: GBDT-level early
+            # stopping evaluates metrics after every iteration, and
+            # snapshots fire on iteration numbers. A consumer handles
+            # both at drain time.
+            if self.early_stopping_round > 0:
+                reason = "config:early_stopping_round"
+            elif int(getattr(self.config, "snapshot_freq", -1) or -1) > 0:
+                reason = "config:snapshot_freq"
+        if reason is not None:
+            self._report_eviction(reason, stage="megastep")
+            return False
+        return True
 
     def _megastep_chunk(self) -> int:
         """Iterations the next megastep may fuse: bounded by
@@ -2833,11 +3122,15 @@ class GBDT:
         tel.observe("megastep.dispatch", time.perf_counter() - t0)
         # batch-granularity attribution syncs once per megastep by
         # draining immediately (one sync amortized over `chunk`
-        # iterations, which also emits the batch record); without
-        # telemetry the drain keeps its usual pipeline cadence
-        if tel.enabled or self._pending_iters >= self._FAST_SYNC_EVERY:
+        # iterations, which also emits the batch record); a drain-replay
+        # consumer drains per chunk too — callbacks (logging, early
+        # stopping) replay promptly and a scan-frozen early-stop tail
+        # never spans more than one chunk. Without either, the drain
+        # keeps its usual pipeline cadence.
+        if tel.enabled or self._eval_consumer is not None \
+                or self._pending_iters >= self._FAST_SYNC_EVERY:
             self.drain_pending()
-        return self._stopped_early
+        return self._stopped_early or self._es_finished
 
     def _megastep_body(self, chunk: int) -> None:
         k = self.num_tree_per_iteration
@@ -2866,14 +3159,30 @@ class GBDT:
                     masks[b, tid, :F] = np.asarray(self._feature_mask())
             fm_pads = jnp.asarray(masks)
         self.telemetry.inc("train.dispatches")
+        plan = self._traced_plan if self._eval_consumer is not None \
+            else None
+        metrics_B = None
         # profiler users see the fused chunk as one annotated step
         # (profile_dir / jax.profiler traces); free when no trace is on
         with jax.profiler.StepTraceAnnotation("megastep",
                                               step_num=self.iter):
-            scores, vscores, trees_B = fn(
-                self.fused_bins_T, self.scores, tuple(self.valid_bins),
-                tuple(self.valid_scores), operands, self.bag_weight,
-                fm_pads)
+            if plan is None:
+                scores, vscores, trees_B = fn(
+                    self.fused_bins_T, self.scores,
+                    tuple(self.valid_bins), tuple(self.valid_scores),
+                    operands, self.bag_weight, fm_pads)
+            else:
+                if self._plan_ops is None:
+                    self._plan_ops = plan.operands()
+                if self._es_carry is None:
+                    self._es_carry = self._init_es_carry(plan.n_slots)
+                iters_B = jnp.arange(self.iter, self.iter + chunk,
+                                     dtype=jnp.int32)
+                scores, vscores, self._es_carry, trees_B, metrics_B = fn(
+                    self.fused_bins_T, self.scores,
+                    tuple(self.valid_bins), tuple(self.valid_scores),
+                    operands, self.bag_weight, fm_pads, iters_B,
+                    self._plan_ops, self._es_carry)
         self.scores = scores
         self.valid_scores = list(vscores)
         # the fused-epilogue carry (score_pad, hist0, gh_T) captured
@@ -2887,10 +3196,21 @@ class GBDT:
         if not self._pending:
             self._batch_w0 = self.telemetry.wall_now()
             self._batch_t0 = time.perf_counter()
-        self._pending.append((trees_B, init_list, chunk))
+        self._pending.append((trees_B, init_list, chunk, metrics_B))
         self._pending_iters += chunk
         self._batch_fused += chunk
         self.iter += chunk
+
+    @staticmethod
+    def _init_es_carry(n_slots: int):
+        """Fresh scan-native early-stop carry: per-slot best (signed so
+        higher is always better), per-slot best round (-1 = no eval
+        seen yet, mirroring the callback's best_score_list[i] is None),
+        plus the latched stop flag and the latch iteration."""
+        return (jnp.full((n_slots,), -jnp.inf, jnp.float32),
+                jnp.full((n_slots,), -1, jnp.int32),
+                jnp.zeros((), bool),
+                jnp.full((), -1, jnp.int32))
 
     def _make_megastep(self, chunk: int):
         obj = self.objective
@@ -2916,20 +3236,85 @@ class GBDT:
                                                vbins))
             return scores, vscores, stacked
 
+        plan = self._traced_plan if self._eval_consumer is not None \
+            else None
+        if plan is None:
+            def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
+                     fm_pads_B):
+                def body(carry, fm_pads):
+                    scores, vscores = carry
+                    scores, vscores, stacked = one_iteration(
+                        bins_T, scores, vbins, vscores, grad_ops,
+                        bag_weight, fm_pads)
+                    return (scores, vscores), stacked
+                (scores, vscores), trees_B = jax.lax.scan(
+                    body, (scores, vscores), fm_pads_B)
+                return scores, vscores, trees_B
+            # donate the score carry and every valid-score buffer: the
+            # scan rewrites them in place across the whole chunk
+            return jax.jit(step, donate_argnums=_donate(1, 3))
+
+        # ---- on-device eval variant: the scan additionally computes
+        # every configured metric per iteration (traced reductions over
+        # the score carries it already holds) and threads the early-stop
+        # state; past the stopping point the carries freeze, so the
+        # frozen tail's trees contribute NOTHING and the drain discards
+        # them without any score arithmetic — the drained model is
+        # bit-identical to the synchronous driver's early-stopped one.
+        slots = plan.slots
+        sign = jnp.asarray([1.0 if bigger else -1.0
+                            for (_, _, bigger) in slots], jnp.float32)
+        if self._es_spec is not None and slots:
+            es_rounds, fmo = self._es_spec
+            first_name = slots[0][1]
+            # mirrors callback.early_stopping's stop check: training
+            # slots never stop, first_metric_only tracks only the first
+            # metric's slots (best-state still updates for every slot)
+            mask_np = [ds != "training"
+                       and (not fmo or name == first_name)
+                       for (ds, name, _) in slots]
+        else:
+            es_rounds, mask_np = (1 << 30), [False] * len(slots)
+        es_mask = jnp.asarray(np.asarray(mask_np, bool))
+        es_rounds = jnp.int32(es_rounds)
+
+        def es_update(es, mvals, it, active):
+            best, bround, stopped, stop_it = es
+            signed = mvals * sign
+            # first-ever eval always records (bround < 0), like the
+            # callback's best_score_list[i]-is-None branch; afterwards a
+            # plain signed compare (min_delta != 0 is rejected at
+            # precheck — f32-vs-f64 boundary rounding would break the
+            # bit-identity contract)
+            upd = active & ((bround < 0) | (signed > best))
+            best = jnp.where(upd, signed, best)
+            bround = jnp.where(upd, it, bround)
+            trigger = active & jnp.any(es_mask
+                                       & ((it - bround) >= es_rounds))
+            stop_it = jnp.where(stopped | ~trigger, stop_it, it)
+            return (best, bround, stopped | trigger, stop_it)
+
         def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
-                 fm_pads_B):
-            def body(carry, fm_pads):
-                scores, vscores = carry
-                scores, vscores, stacked = one_iteration(
-                    bins_T, scores, vbins, vscores, grad_ops, bag_weight,
-                    fm_pads)
-                return (scores, vscores), stacked
-            (scores, vscores), trees_B = jax.lax.scan(
-                body, (scores, vscores), fm_pads_B)
-            return scores, vscores, trees_B
-        # donate the score carry and every valid-score buffer: the scan
-        # rewrites them in place across the whole chunk
-        return jax.jit(step, donate_argnums=_donate(1, 3))
+                 fm_pads_B, iters_B, metric_ops, es0):
+            def body(carry, xs):
+                scores, vscores, es = carry
+                fm_pads, it = xs
+                active = ~es[2]
+                new_scores, new_vscores, stacked = one_iteration(
+                    bins_T, scores, vbins, vscores, grad_ops,
+                    bag_weight, fm_pads)
+                # freeze past the stop latch: the tree still comes out
+                # of the scan (static shapes) but contributes nothing
+                scores = jnp.where(active, new_scores, scores)
+                vscores = tuple(jnp.where(active, nv, v)
+                                for nv, v in zip(new_vscores, vscores))
+                mvals = plan.eval_in_scan(scores, vscores, metric_ops)
+                es = es_update(es, mvals, it, active)
+                return (scores, vscores, es), (stacked, mvals)
+            (scores, vscores, es), (trees_B, metrics_B) = jax.lax.scan(
+                body, (scores, vscores, es0), (fm_pads_B, iters_B))
+            return scores, vscores, es, trees_B, metrics_B
+        return jax.jit(step, donate_argnums=_donate(1, 3, 9))
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
@@ -2939,7 +3324,7 @@ class GBDT:
         stop."""
         self._profiler_step()
         if gradients is None and hessians is None \
-                and not self._stopped_early:
+                and not self._stopped_early and not self._es_finished:
             if self._megastep_armed \
                     and self.iter >= int(self.config.num_iterations):
                 # the armed loop counts calls, not iterations: signal
@@ -2947,12 +3332,28 @@ class GBDT:
                 self.drain_pending()
                 return True
             chunk = self._megastep_chunk()
-            if chunk >= 2:
+            # a drain-replay consumer needs EVERY iteration to flow
+            # through the scan (the metrics are computed there), so
+            # horizon/bagging tail chunks of one iteration still run as
+            # a length-1 megastep instead of the bare fast step
+            if chunk >= 2 or (chunk == 1
+                              and self._eval_consumer is not None):
                 return self._train_one_megastep(chunk)
+            if self._eval_consumer is not None:
+                # should be unreachable: megastep_eval_precheck vetted
+                # every blocker before the consumer was armed. Fail safe
+                # by falling back to the classic driver WITHOUT eval
+                # replay (the engine loop detects the dropped consumer
+                # and resumes inline evaluation).
+                log.warning("megastep eval consumer dropped mid-run "
+                            "(megastep no longer eligible); falling back "
+                            "to per-iteration evaluation")
+                self._report_eviction("consumer_dropped_mid_run")
+                self.arm_megastep(self._megastep_armed, eval_consumer=None)
             if self._fast_path_ok():
                 return self._train_one_iter_fast()
         self.drain_pending()
-        if self._stopped_early:
+        if self._stopped_early or self._es_finished:
             return True
         with timer.section("GBDT::TrainOneIter"):
             return self._sync_iter_body(gradients, hessians)
@@ -3257,6 +3658,9 @@ class GBDT:
         self.max_leaves = max(2, int(config.num_leaves))
         self.params = split_params_from_config(config)
         self._stopped_early = False   # a relaxed config may split again
+        self._es_finished = False
+        self._es_carry = None
+        self._evict_reported = set()  # reasons may change with the config
         self._setup_telemetry(config)
         self._setup_cegb(config)
         self._setup_forced_splits(config, self.train_data)
@@ -3351,8 +3755,14 @@ class GBDT:
         batches the host fetch."""
         out = []
         host_score = None
+        # one conversion / one host fetch per (eval set, iteration),
+        # shared across the set's metrics: the per-metric cache threads
+        # through eval_device so e.g. binary_logloss and binary_error
+        # sigmoid the score row once, not once each, and host-form
+        # metrics reuse one pulled matrix
+        dev_cache: Dict = {}
         for m in metrics:
-            vals = m.eval_device(score_dev, self.objective)
+            vals = m.eval_device(score_dev, self.objective, dev_cache)
             if vals is None and getattr(self, "mp", None) is not None:
                 # distributed host form (per-query ranking metrics:
                 # rank-local sums + allreduce)

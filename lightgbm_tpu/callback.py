@@ -2,11 +2,22 @@
 
 Behavioral analog of ref: python-package/lightgbm/callback.py (log_evaluation
 :65, record_evaluation :96, reset_parameter :147, early_stopping :187).
+
+Drain-replay protocol (docs/Observability.md §9): the megastep fuses
+whole boosting iterations into one jit and computes the built-in
+metrics ON DEVICE inside the scan, so per-iteration callbacks cannot
+run inline — instead the drain replays them in iteration order against
+an :class:`EvalResultView` built from the stacked metric matrix.  A
+callback is replayable when the factory marked it with
+``_megastep_replay`` (our own ``log_evaluation``, ``record_evaluation``,
+``early_stopping``, ``record_telemetry`` are); an unmarked callback
+evicts training to the classic per-iteration loop with a structured
+``megastep_evicted`` telemetry event naming it.
 """
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, List, Union
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from .utils import log
 
@@ -36,6 +47,7 @@ def log_evaluation(period: int = 1, show_stdv: bool = True):
                 for name, metric, value, _ in env.evaluation_result_list)
             log.info("[%d]\t%s", env.iteration + 1, result)
     _callback.order = 10
+    _callback._megastep_replay = "log_evaluation"
     return _callback
 
 
@@ -56,6 +68,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]):
         for name, metric, value, _ in env.evaluation_result_list:
             eval_result[name][metric].append(value)
     _callback.order = 20
+    _callback._megastep_replay = "record_evaluation"
     return _callback
 
 
@@ -102,6 +115,9 @@ def record_telemetry(telemetry_result: Dict[str, Any]):
         _drain(tel)
     _callback.before_iteration = True
     _callback.order = 5
+    # replayable: the registry drain is order-insensitive, and enabling
+    # telemetry at the default batch granularity keeps the fast path
+    _callback._megastep_replay = "record_telemetry"
 
     def _finalize(env: CallbackEnv) -> None:
         tel = _registry(env)
@@ -255,4 +271,126 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             _final_iteration_check(env, eval_name_splitted, i)
     _callback.order = 30
+    _callback._megastep_replay = "early_stopping"
+    # the scan-native early-stop tracker mirrors this callback's state
+    # machine on device; it needs the spec the closure was built with
+    _callback._es_spec = (int(stopping_rounds), bool(first_metric_only),
+                          min_delta)
     return _callback
+
+
+# ---------------------------------------------------------------------------
+# Drain-replay protocol (megastep on-device eval; boosting/gbdt.py
+# _drain_body is the producer, engine.train the owner).
+# ---------------------------------------------------------------------------
+class EvalResultView(list):
+    """One iteration's ``evaluation_result_list`` reconstructed from the
+    megastep's device-computed metric vector: a plain list of
+    ``(dataset_name, metric_name, value, is_higher_better)`` tuples in
+    the exact order the synchronous engine loop would have produced —
+    no score fetch, no re-predict; only the per-iteration scalars ever
+    crossed from the device."""
+
+    __slots__ = ()
+
+    @classmethod
+    def from_values(cls, slots, values) -> "EvalResultView":
+        return cls((ds, name, float(v), bigger)
+                   for (ds, name, bigger), v in zip(slots, values))
+
+
+def drain_replay_blocker(callbacks: List) -> Optional[str]:
+    """None when every callback is drain-replayable, else the specific
+    feature that evicts the megastep (named in the ``megastep_evicted``
+    telemetry event)."""
+    n_es = 0
+    for cb in callbacks:
+        kind = getattr(cb, "_megastep_replay", None)
+        if kind is None:
+            name = getattr(cb, "__qualname__",
+                           getattr(cb, "__name__",
+                                   type(cb).__name__))
+            return f"callback:{name}"
+        if kind == "early_stopping":
+            n_es += 1
+            _, _, delta = cb._es_spec
+            deltas = delta if isinstance(delta, list) else [delta]
+            if any(float(d) != 0.0 for d in deltas):
+                # a nonzero min_delta compares best + delta in host f64;
+                # the scan's f32 compare could diverge on the boundary,
+                # breaking the drained model's bit-identity contract
+                return "callback:early_stopping(min_delta)"
+            if n_es > 1:
+                return "callback:early_stopping(duplicate)"
+    return None
+
+
+def find_es_spec(callbacks: List):
+    """(stopping_rounds, first_metric_only) of the early_stopping
+    callback, or None when none is registered."""
+    for cb in callbacks:
+        if getattr(cb, "_megastep_replay", None) == "early_stopping":
+            rounds, fmo, _ = cb._es_spec
+            return (rounds, fmo)
+    return None
+
+
+class DrainEvalReplay:
+    """Drain-time consumer for the megastep's per-iteration metric rows.
+
+    ``boosting.GBDT._drain_body`` calls :meth:`replay` once per kept
+    iteration, in order; this object rebuilds the iteration's
+    evaluation list, runs the registered callbacks against it (and
+    writes the engine-level snapshots on their schedule), and converts
+    an :class:`EarlyStopException` into recorded state the engine loop
+    applies — the exception must not unwind through ``Booster.update``.
+    """
+
+    def __init__(self, booster, params: Dict[str, Any],
+                 callbacks_before: List, callbacks_after: List,
+                 end_iteration: int, snapshot_freq: int = -1,
+                 snapshot_base: str = "", include_training: bool = False):
+        self.booster = booster
+        self.params = params
+        self.callbacks_before = list(callbacks_before)
+        self.callbacks_after = list(callbacks_after)
+        self.end_iteration = int(end_iteration)
+        self.snapshot_freq = int(snapshot_freq)
+        self.snapshot_base = snapshot_base
+        self.include_training = bool(include_training)
+        self.es_spec = find_es_spec(self.callbacks_after)
+        self.slots: List = []          # bound by GBDT.arm_megastep
+        self.stop = None               # (best_iteration, best_score_list)
+        self.last_eval: List = []
+
+    def bind(self, slots) -> None:
+        self.slots = list(slots)
+
+    def _env(self, iteration: int, results) -> CallbackEnv:
+        return CallbackEnv(model=self.booster, params=self.params,
+                           iteration=iteration, begin_iteration=0,
+                           end_iteration=self.end_iteration,
+                           evaluation_result_list=results)
+
+    def replay(self, iteration: int, values) -> bool:
+        """Replay one drained iteration; returns True when an early
+        stop fired (training must rewind to ``iteration`` and stop)."""
+        for cb in self.callbacks_before:
+            cb(self._env(iteration, None))
+        if self.snapshot_freq > 0 \
+                and (iteration + 1) % self.snapshot_freq == 0:
+            # the synchronous loop snapshots with num_iteration=-1 right
+            # after iteration's update; at drain time the model already
+            # holds later trees, so slice to the same content instead
+            self.booster.save_model(
+                f"{self.snapshot_base}.snapshot_iter_{iteration + 1}",
+                num_iteration=iteration + 1)
+        view = EvalResultView.from_values(self.slots, values)
+        try:
+            for cb in self.callbacks_after:
+                cb(self._env(iteration, view))
+        except EarlyStopException as es:
+            self.stop = (es.best_iteration, es.best_score)
+            return True
+        self.last_eval = view
+        return False

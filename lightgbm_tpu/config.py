@@ -283,6 +283,16 @@ _PARAMS: List[_Param] = [
        desc="max boosting iterations fused into one megastep dispatch "
             "(capped by the pipeline drain batch, the num_iterations "
             "horizon and the current bagging round's window)"),
+    _p("tpu_traced_eval", bool, True,
+       desc="evaluate the built-in metrics ON DEVICE inside the "
+            "megastep scan (metric/traced.py) so lgb.train with eval "
+            "sets + early_stopping/log_evaluation/record_evaluation/"
+            "snapshots keeps the dispatch-amortized fast path; the "
+            "drain replays those callbacks against the stacked "
+            "per-iteration metric matrix, and a scan-carried early-stop "
+            "flag keeps the drained model bit-identical to the "
+            "synchronous driver's. Off = built-in callbacks evict to "
+            "the per-iteration loop (pre-round-8 behavior, A/B switch)"),
     _p("tpu_rows_per_shard_pad", int, 8,
        desc="pad row count to a multiple of this per mesh shard"),
     _p("mesh_axis_data", str, "data", desc="mesh axis name for row sharding"),

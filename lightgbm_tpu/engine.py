@@ -109,19 +109,68 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # main loop (ref: engine.py:260-283)
     # Megastep arming: this loop may consume multi-iteration steps (one
     # jit fusing up to tpu_megastep_iters iterations) because it breaks
-    # on `finished` and nothing here needs per-iteration observation —
-    # but ONLY when no per-iteration consumer exists: callbacks index
-    # CallbackEnv.iteration (which counts calls), feval/fobj run per
-    # call, and snapshots fire on call numbers. Evaluation still happens
-    # every loop round on the accurate post-chunk scores.
-    if (not callbacks and feval is None and fobj is None
-            and snapshot_freq <= 0):
-        booster._gbdt.arm_megastep(True)
+    # on `finished` and nothing here needs per-iteration observation.
+    #
+    # Per-iteration consumers no longer force the synchronous path when
+    # they are the BUILT-IN set (early_stopping / log_evaluation /
+    # record_evaluation / record_telemetry, plus snapshot_freq): the
+    # megastep evaluates every configured metric ON DEVICE inside the
+    # scan (metric/traced.py) and the drain replays these callbacks in
+    # iteration order against the stacked metric matrix
+    # (callback.DrainEvalReplay) — no score fetch, no re-predict, and a
+    # scan-carried early-stop flag keeps the drained model bit-identical
+    # to this loop's synchronous early-stopped model. Anything the drain
+    # cannot replay (user callbacks, reset_parameter, feval, fobj, an
+    # untraceable metric) falls back to the classic inline loop below,
+    # with a structured megastep_evicted event naming the blocker.
+    gbdt = booster._gbdt
+    consumer = None
+    want_replay = bool(callbacks) or snapshot_freq > 0
+    if want_replay and feval is None and fobj is None:
+        blocker = callback_mod.drain_replay_blocker(
+            callbacks_before + callbacks_after)
+        if blocker is None:
+            ok, blocker = gbdt.megastep_eval_precheck(
+                include_training=train_in_valid,
+                es_spec=callback_mod.find_es_spec(callbacks_after))
+            if ok:
+                consumer = callback_mod.DrainEvalReplay(
+                    booster=booster, params=params,
+                    callbacks_before=callbacks_before,
+                    callbacks_after=callbacks_after,
+                    end_iteration=num_boost_round,
+                    snapshot_freq=snapshot_freq,
+                    snapshot_base=snapshot_base,
+                    include_training=train_in_valid)
+                gbdt.arm_megastep(True, eval_consumer=consumer)
+        if consumer is None:
+            gbdt._report_eviction(blocker, stage="engine")
+    elif want_replay or feval is not None or fobj is not None:
+        gbdt._report_eviction("feval" if feval is not None else "fobj",
+                              stage="engine")
+    if consumer is None and not callbacks and feval is None \
+            and fobj is None and snapshot_freq <= 0:
+        gbdt.arm_megastep(True)
     evaluation_result_list: List = []
     i = -1
     try:
       for i in range(num_boost_round):
         try:
+            if consumer is not None:
+                finished = booster.update()
+                if gbdt._eval_consumer is None and consumer.stop is None:
+                    # defensive fallback (see GBDT.train_one_iter):
+                    # resume classic inline evaluation from here on
+                    consumer = None
+                    continue
+                if consumer.stop is not None:
+                    booster.best_iteration = consumer.stop[0] + 1
+                    evaluation_result_list = consumer.stop[1]
+                    break
+                evaluation_result_list = list(consumer.last_eval)
+                if finished:
+                    break
+                continue
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
@@ -170,7 +219,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
     finally:
         # a kept booster must return to the one-iteration-per-update
         # contract once this loop stops consuming multi-iteration steps
+        # (disarming with a consumer bound drains + replays the tail
+        # first, so no queued metric rows are dropped)
         booster._gbdt.arm_megastep(False)
+
+    if consumer is not None:
+        # the tail drain above may have replayed the final iterations —
+        # pick up a late early-stop verdict or the last eval list
+        if consumer.stop is not None and booster.best_iteration <= 0:
+            booster.best_iteration = consumer.stop[0] + 1
+            evaluation_result_list = consumer.stop[1]
+        elif consumer.last_eval and not evaluation_result_list:
+            evaluation_result_list = list(consumer.last_eval)
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for name, metric, value, _ in (evaluation_result_list or []):

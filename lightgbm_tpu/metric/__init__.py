@@ -133,10 +133,27 @@ class Metric:
     # note: device accumulation is f32 (vs the host path's f64); the ref
     # GPU learner accepts the same class of drift
     # (docs/GPU-Performance.rst:130-160).
-    def eval_device(self, score_dev, objective):
+    def eval_device(self, score_dev, objective, cache=None):
         """List of 0-d device arrays, or None when this metric has no
-        traced formulation (the host numpy eval is used instead)."""
+        traced formulation (the host numpy eval is used instead).
+
+        ``cache`` is a per-(eval set, iteration) dict shared across the
+        metrics of one eval call: the objective-converted score row is
+        computed once and reused, instead of every metric re-reading
+        (and re-converting) the device valid scores on its own."""
         return None
+
+    def _converted_row(self, score_dev, objective, cache):
+        """Objective-converted [n] score row, shared across the eval
+        set's metrics through ``cache``."""
+        if cache is not None and "converted_row" in cache:
+            return cache["converted_row"]
+        s = score_dev[0]
+        if objective is not None:
+            s = objective.convert_output_jnp(s)
+        if cache is not None and s is not None:
+            cache["converted_row"] = s
+        return s
 
     def _dev_label_weight(self):
         import jax.numpy as jnp
@@ -178,22 +195,30 @@ class _RegressionMetric(Metric):
     def loss_jnp(self, label, score):
         return None
 
-    def eval_device(self, score_dev, objective):
+    def average_jnp(self, sum_loss, sum_weights):
+        """Traced mirror of `average`: keeps the scalar ON DEVICE so the
+        caller's batched fetch stays one round trip (RMSE's host
+        `average` runs np.sqrt, which would pull the scalar per metric
+        mid-eval)."""
+        return sum_loss / sum_weights
+
+    def eval_device(self, score_dev, objective, cache=None):
         import jax.numpy as jnp
-        s = score_dev[0]
-        if self.convert and objective is not None:
-            s = objective.convert_output_jnp(s)
+        if self.convert:
+            s = self._converted_row(score_dev, objective, cache)
             if s is None:
                 return None
+        else:
+            s = score_dev[0]
         label, weight = self._dev_label_weight()
         pt = self.loss_jnp(label, s)
         if pt is None:
             return None
         sum_loss = (jnp.sum(pt * weight) if weight is not None
                     else jnp.sum(pt))
-        # `average` is scalar arithmetic — a host round trip here moves 4
-        # bytes, not the O(n) score matrix
-        return [self.average(sum_loss, self.sum_weights)]
+        # scalar arithmetic only — the 0-d result rides the caller's
+        # batched fetch; nothing crosses to host here
+        return [self.average_jnp(sum_loss, self.sum_weights)]
 
 
 class L2Metric(_RegressionMetric):
@@ -213,6 +238,10 @@ class RMSEMetric(L2Metric):
 
     def average(self, sum_loss, sum_weights):
         return float(np.sqrt(sum_loss / sum_weights))
+
+    def average_jnp(self, sum_loss, sum_weights):
+        import jax.numpy as jnp
+        return jnp.sqrt(sum_loss / sum_weights)
 
 
 class L1Metric(_RegressionMetric):
@@ -309,6 +338,12 @@ class GammaDevianceMetric(_RegressionMetric):
     def average(self, sum_loss, sum_weights):
         return sum_loss * 2.0
 
+    def average_jnp(self, sum_loss, sum_weights):
+        # no loss_jnp yet, so this is unreachable today — kept in sync
+        # with `average` so a future traced loss cannot silently pick up
+        # the default mean
+        return sum_loss * 2.0
+
 
 class TweedieMetric(_RegressionMetric):
     names = ["tweedie"]
@@ -342,13 +377,11 @@ class _BinaryMetric(Metric):
             sum_loss = float(np.sum(pt))
         return [sum_loss / self.sum_weights]
 
-    def eval_device(self, score_dev, objective):
+    def eval_device(self, score_dev, objective, cache=None):
         import jax.numpy as jnp
-        s = score_dev[0]
-        if objective is not None:
-            s = objective.convert_output_jnp(s)
-            if s is None:
-                return None
+        s = self._converted_row(score_dev, objective, cache)
+        if s is None:
+            return None
         label, weight = self._dev_label_weight()
         pt = self.loss_jnp(label, s)
         if pt is None:
@@ -452,7 +485,7 @@ class AUCMetric(Metric):
     def eval(self, score, objective):
         return [_weighted_auc(self.label, score[0], self.weight)]
 
-    def eval_device(self, score_dev, objective):
+    def eval_device(self, score_dev, objective, cache=None):
         label, weight = self._dev_label_weight()
         return [_weighted_auc_jnp(label, score_dev[0], weight)]
 

@@ -114,6 +114,10 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # - dispatches_per_iter (bench.py --micro): a training fast-path
     #   eviction — e.g. telemetry silently forcing the sync driver —
     #   moves it 0.125 -> 3+;
+    # - eval_dispatches_per_iter (bench.py --micro eval leg): the
+    #   eval-enabled config (valid sets + early stopping + logging
+    #   callbacks) regressing off the on-device-eval megastep back to
+    #   per-iteration sync evaluation moves it from ~1/chunk to >= 3;
     # - dispatches_per_request (bench.py --serve): a serving bucketing/
     #   chunking regression moves it off exactly 1.0;
     # - compiles_per_1k_requests (bench.py --serve): a bucket-shape leak
@@ -126,8 +130,8 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # dispatches_per_request regression must fail even under
     # --threshold 9.0.
     report["deterministic"] = {}
-    for name in ("dispatches_per_iter", "dispatches_per_request",
-                 "compiles_per_1k_requests"):
+    for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
+                 "dispatches_per_request", "compiles_per_1k_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue

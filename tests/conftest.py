@@ -52,6 +52,19 @@ _INTERPRET_HEAVY = {
     ("test_megastep.py", "test_telemetry_section_granularity_forces_sync"),
     ("test_megastep.py", "test_trace_out_implies_section_granularity"),
     ("test_megastep.py", "test_update_contract_unchanged"),
+    ("test_traced_eval.py", "test_multiclass_megastep_eval"),
+    ("test_traced_eval.py", "test_first_metric_only_multi_eval_set"),
+    ("test_traced_eval.py", "test_nan_features_megastep_eval"),
+    ("test_traced_eval.py",
+     "test_early_stopped_model_bit_identical_to_sync"),
+    ("test_traced_eval.py",
+     "test_megastep_stays_on_with_builtin_callbacks"),
+    ("test_traced_eval.py", "test_snapshots_written_at_drain"),
+    ("test_traced_eval.py",
+     "test_megastep_evicted_event_names_feature"),
+    ("test_traced_eval.py", "test_chunk_of_one_flows_through_scan"),
+    ("test_traced_eval.py",
+     "test_booster_trainable_after_drain_replay_stop"),
     ("test_fast_pipeline.py", "test_multiclass_fast_matches_sync"),
     ("test_fast_pipeline.py", "test_multiclass_rare_class_keeps_init_score"),
     ("test_fast_pipeline.py",
