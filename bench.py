@@ -406,7 +406,34 @@ def run_micro() -> None:
     _RESULT["eval_counters"] = {k: v for k, v in sorted(c2.items())
                                 if k.startswith(("train.", "iterations",
                                                  "events."))}
-    for p in (tel_path, tel_eval):
+    _emit()   # the eval-leg counters are on stdout now
+
+    # ---- checkpoint leg: the bare training again with async resilience
+    # checkpoints armed. Checkpoints capture at drain boundaries on a
+    # background thread, so they must be dispatch-neutral:
+    # ckpt_dispatches_per_iter == dispatches_per_iter EXACTLY is the
+    # deterministic gate (bench_compare + the perf-smoke absolute
+    # assertion) — any regression that makes checkpointing evict the
+    # fast path or add device round trips moves the counter.
+    import shutil
+    import tempfile
+    ckpt_root = tempfile.mkdtemp(prefix="bench_micro_ckpt_")
+    tel_ckpt = tel_path + ".ckpt"
+    ds3 = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst3 = lgb.train(dict(params, telemetry_out=tel_ckpt,
+                          checkpoint_dir=ckpt_root, checkpoint_period=4),
+                     ds3, num_boost_round=n_iters)
+    ckpt_wall = time.perf_counter() - t0
+    _phase("micro_ckpt_train_ok")
+    c3 = bst3.telemetry().get("counters", {})
+    ckpt_iters = max(1, int(c3.get("iterations", n_iters)))
+    _RESULT["ckpt_sec_per_iter"] = round(ckpt_wall / ckpt_iters, 5)
+    _RESULT["ckpt_dispatches_per_iter"] = round(
+        float(c3.get("train.dispatches", 0)) / ckpt_iters, 4)
+    _RESULT["checkpoints_written"] = int(c3.get("ckpt.written", 0))
+    shutil.rmtree(ckpt_root, ignore_errors=True)
+    for p in (tel_path, tel_eval, tel_ckpt):
         try:
             os.remove(p)
         except OSError:

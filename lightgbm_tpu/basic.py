@@ -841,9 +841,13 @@ class Booster:
     def save_model(self, filename: str, start_iteration: int = 0,
                    num_iteration: Optional[int] = None,
                    importance_type: Union[int, str] = "split") -> "Booster":
-        with open(filename, "w") as fh:
-            fh.write(self.model_to_string(start_iteration, num_iteration,
-                                          importance_type))
+        # serialize first, then atomic write-then-rename: a crash mid-
+        # snapshot (the engine's snapshot_freq files double as resume
+        # checkpoints) can never leave a truncated model file behind
+        from .resilience.atomicio import atomic_write_text
+        text = self.model_to_string(start_iteration, num_iteration,
+                                    importance_type)
+        atomic_write_text(str(filename), text)
         return self
 
     def dump_model(self, start_iteration: int = 0,

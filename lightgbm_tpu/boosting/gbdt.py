@@ -223,6 +223,15 @@ class GBDT:
         self._prof_n = -1
         self._prof_active = False
         self._prof_done = False
+        # resilience (resilience/): async checkpoint manager, cadence
+        # bookkeeping, the engine's extra-state hook (callback closures'
+        # early-stop state rides the checkpoint), fault registry
+        self._ckpt = None
+        self._ckpt_period = 0
+        self._last_ckpt_iter = 0
+        self._ckpt_busy = False
+        self._ckpt_extra = None
+        self._faults = None
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TpuDataset, objective,
@@ -233,6 +242,7 @@ class GBDT:
         from ..utils.platform import apply_compilation_cache
         apply_compilation_cache(config)   # before the first trace
         self._setup_telemetry(config)
+        self._setup_resilience(config)
         self.training_metrics = list(training_metrics)
         self.num_data = train_data.num_data
         self.num_tree_per_iteration = (objective.num_model_per_iteration
@@ -372,7 +382,13 @@ class GBDT:
             from ..obs.health import HealthAuditor
             self._health = HealthAuditor(
                 tel, period,
-                float(getattr(config, "health_skew_threshold", 2.0)))
+                float(getattr(config, "health_skew_threshold", 2.0)),
+                resync_fn=self._health_resync,
+                auto_resync=bool(getattr(config, "health_auto_resync",
+                                         True)),
+                checkpoint_fn=lambda it: self.maybe_checkpoint(force=True),
+                straggler_checkpoint=bool(getattr(
+                    config, "health_checkpoint_on_straggler", False)))
         self._prof_dir = str(getattr(config, "profile_dir", "") or "")
         self._prof_start = max(
             0, int(getattr(config, "profile_start_iteration", 0)))
@@ -467,6 +483,13 @@ class GBDT:
         multi-process — SPMD: every rank calls this at the same point),
         flush the JSONL sink."""
         self._profiler_stop()
+        if self._ckpt is not None:
+            # join the in-flight write: a checkpoint enqueued at the
+            # last drain must commit before the process can exit
+            try:
+                self._ckpt.wait()
+            except Exception as e:
+                log.warning("checkpoint writer drain failed: %s", e)
         tel = self.telemetry
         if not tel.enabled:
             return
@@ -541,9 +564,11 @@ class GBDT:
             return None
         import json as _json
         import traceback as _tb
-        path = out + ".crash.json"
-        if tel.rank:
-            path += f".rank{tel.rank}"
+        # rank-suffixed BEFORE the extension (concurrent multi-rank
+        # crashes each get their own dump; rank 0 keeps the bare path
+        # the single-process tooling watches)
+        path = (out + ".crash.json" if not tel.rank
+                else out + f".crash.rank{tel.rank}.json")
         try:
             payload = {
                 "ts": time.time(),
@@ -557,6 +582,11 @@ class GBDT:
                 },
                 "config": self.config.to_dict(),
                 "telemetry": tel.crash_payload(),
+                # the resume hint: the newest checkpoint THIS rank
+                # committed (None = no checkpointing / nothing written
+                # yet) — the first thing an operator needs from a dump
+                "checkpoint": (self._ckpt.last_written
+                               if self._ckpt is not None else None),
             }
             tel.flush()
             with open(path, "w") as fh:
@@ -567,6 +597,133 @@ class GBDT:
         log.warning("training crashed (%s); flight record written to %s",
                     type(exc).__name__, path)
         return path
+
+    # ------------------------------------------------------------------
+    # Resilience: async checkpoints + resume + auditor auto-recovery
+    # (resilience/; docs/Reliability.md). Checkpoint capture happens at
+    # host consistency boundaries only (drain boundaries on the fast
+    # path, iteration edges on the sync driver) so the 0.125-dispatch
+    # megastep contract is untouched — the bench guard asserts
+    # dispatches_per_iter is identical with checkpointing on.
+    def _setup_resilience(self, config: Config) -> None:
+        from ..resilience import comms
+        from ..resilience.checkpoint import CheckpointManager
+        from ..resilience.faults import registry_from_env
+        comms.set_collective_policy(
+            float(getattr(config, "collective_timeout", 0.0) or 0.0),
+            int(getattr(config, "collective_retries", 2)))
+        self._faults = registry_from_env()
+        self._ckpt_period = int(getattr(config, "checkpoint_period", 0)
+                                or 0)
+        root = str(getattr(config, "checkpoint_dir", "") or "")
+        if not root:
+            if self._ckpt_period > 0:
+                log.warning("checkpoint_period=%d set without "
+                            "checkpoint_dir; checkpointing is off",
+                            self._ckpt_period)
+            if self._ckpt is not None:
+                # reset_parameter dropped checkpoint_dir: drain + stop
+                # the writer instead of orphaning its thread
+                try:
+                    self._ckpt.close()
+                except Exception as e:
+                    log.warning("checkpoint writer shutdown failed: %s",
+                                e)
+            self._ckpt = None
+            return
+        if self._ckpt_period <= 0 and not bool(getattr(
+                config, "health_checkpoint_on_straggler", False)):
+            # dir without period writes nothing on its own (only the
+            # auditor's checkpoint-now would) — say so, mirroring the
+            # inverse misconfiguration's warning above
+            log.warning("checkpoint_dir=%s set without "
+                        "checkpoint_period; no periodic checkpoints "
+                        "will be written", root)
+        if self._ckpt is not None and self._ckpt.root == root:
+            return   # reset_parameter round trip: keep the writer
+        if self._ckpt is not None:
+            # checkpoint_dir changed on a reset: drain + stop the old
+            # writer so its in-flight checkpoint commits and its thread
+            # does not leak (one parked thread per reset otherwise)
+            try:
+                self._ckpt.close()
+            except Exception as e:
+                log.warning("old checkpoint writer shutdown failed: %s", e)
+        tel = self.telemetry
+        self._ckpt = CheckpointManager(
+            root, rank=tel.rank, world=jax.process_count(),
+            keep=int(getattr(config, "checkpoint_keep", 2)),
+            telemetry=tel)
+
+    def set_checkpoint_extra(self, provider) -> None:
+        """Engine hook: a callable returning JSON-able state to ride the
+        checkpoint (callback closures' early-stop lists, the last eval
+        list) so a resumed engine loop continues bit-identically."""
+        self._ckpt_extra = provider
+
+    def maybe_checkpoint(self, force: bool = False) -> bool:
+        """Capture + enqueue a checkpoint when one is due. Called at
+        drain boundaries (_drain_body), after each sync-driver iteration
+        (engine.train / _train_loop_body) and by the auditor's
+        checkpoint-now action (force=True). Collective-free; a capture
+        or write failure degrades to telemetry, never kills training."""
+        if self._ckpt is None or self._ckpt_busy:
+            return False
+        if self._stopped_early or self._es_finished:
+            return False
+        if self.iter <= self._last_ckpt_iter:
+            return False
+        if not force and (self._ckpt_period <= 0
+                          or self.iter - self._last_ckpt_iter
+                          < self._ckpt_period):
+            return False
+        self._ckpt_busy = True
+        try:
+            # no-op when called from inside _drain_body (pending already
+            # taken); drains first otherwise so the snapshot covers a
+            # settled model list + score carries
+            self.drain_pending()
+            from ..resilience import state as rstate
+            payload, arrays = rstate.capture(self)
+            self._ckpt.save(self.iter, payload, arrays)
+            self._last_ckpt_iter = self.iter
+            return True
+        except Exception as e:
+            log.warning("checkpoint capture at iteration %d failed: %s",
+                        self.iter, e)
+            if self.telemetry.enabled:
+                self.telemetry.inc("ckpt.failed")
+                self.telemetry.event("checkpoint_failed",
+                                     iteration=self.iter,
+                                     error=f"{type(e).__name__}: "
+                                           f"{e}"[:500])
+            return False
+        finally:
+            self._ckpt_busy = False
+
+    def _device_tree_for_resume(self, ht: HostTree) -> "_DeviceTree":
+        """Device tree for a checkpoint/resync-restored HostTree: the
+        model-file rebin path, but with the TRAINING-time threshold_bin
+        kept verbatim (the checkpoint stores it) so post-resume replay
+        ops route bit-identically to the original run."""
+        dt = self._device_tree_from_host(ht)
+        tb = np.asarray(ht.threshold_bin)
+        if tb.size == max(0, ht.num_leaves - 1) and tb.size:
+            dt.threshold_bin = jnp.asarray(tb.astype(np.int32))
+        return dt
+
+    def _capture_boosting_extra(self) -> Tuple[Dict, Dict]:
+        """Boosting-mode state beyond the base driver's (payload dict,
+        npz arrays); DART/GOSS override."""
+        return {}, {}
+
+    def _restore_boosting_extra(self, payload: Dict, arrays) -> None:
+        pass
+
+    def _health_resync(self, it: int, per_rank) -> bool:
+        from ..resilience import recovery
+        self.drain_pending()
+        return recovery.resync_from_rank0(self, it, per_rank)
 
     # ------------------------------------------------------------------
     def _setup_bundles(self, config: Config, train_data) -> None:
@@ -2872,6 +3029,12 @@ class GBDT:
                                 float(gains.mean()))
         self._batch_t0 = self._batch_w0 = None
         self._batch_fused = 0
+        # drain boundaries are the fast path's natural consistency
+        # points: the model list is settled, the score carries just
+        # synced, the eval replay ran — checkpoint here captures full
+        # training state without any extra device dispatch
+        if flat and self._ckpt is not None:
+            self.maybe_checkpoint()
 
     def _replay_drained_eval(self, flat_metrics, base_iter: int,
                              n_flat: int, stop_i: Optional[int],
@@ -3322,6 +3485,9 @@ class GBDT:
         when a megastep-armed driver loop permits it, one fused chunk of
         iterations (see arm_megastep). Returns True if training should
         stop."""
+        if self._faults:
+            from ..resilience import faults as _faults
+            _faults.on_training_step(self)   # crash/hang chaos hooks
         self._profiler_step()
         if gradients is None and hessians is None \
                 and not self._stopped_early and not self._es_finished:
@@ -3541,6 +3707,9 @@ class GBDT:
                     self.models.pop()
                     self.device_trees.pop()
             return True
+        if self._faults:
+            from ..resilience import faults as _faults
+            _faults.maybe_diverge(self, it)   # chaos: corrupt this rank
         if tel.enabled:
             rec = self._emit_iteration_record(it, nl_per_class, gain_acc)
             if self._health is not None and self._health.due(it):
@@ -3662,6 +3831,7 @@ class GBDT:
         self._es_carry = None
         self._evict_reported = set()  # reasons may change with the config
         self._setup_telemetry(config)
+        self._setup_resilience(config)
         self._setup_cegb(config)
         self._setup_forced_splits(config, self.train_data)
         # mode-compatibility guards must re-fire: a reset can enable CEGB/
@@ -3868,6 +4038,11 @@ class GBDT:
                         self.models.pop()
                         self.device_trees.pop()
                     self.iter = best
+            if not finished:
+                # sync-driver checkpoint cadence (the megastep path
+                # checkpoints at its drain boundaries; the period gate
+                # makes a second call after a drain a no-op)
+                self.maybe_checkpoint()
             if finished:
                 break
 
@@ -4137,6 +4312,22 @@ class DART(GBDT):
         super().output_metric(it)
         return False
 
+    def _capture_boosting_extra(self):
+        # drop-set stream position + per-tree weights: the whole DART
+        # state beyond the (mutated-in-place, hence checkpointed) models
+        payload = {"drop_rng_x": int(self.drop_rng.x),
+                   "sum_weight": float(self.sum_weight)}
+        return payload, {"dart_tree_weight": np.asarray(self.tree_weight,
+                                                       np.float64)}
+
+    def _restore_boosting_extra(self, payload, arrays):
+        if "drop_rng_x" in payload:
+            self.drop_rng.x = int(payload["drop_rng_x"])
+            self.sum_weight = float(payload.get("sum_weight", 0.0))
+            self.tree_weight = [float(x)
+                                for x in arrays["dart_tree_weight"]]
+            self.drop_index = []
+
 
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (ref: src/boosting/goss.hpp:25)."""
@@ -4153,6 +4344,23 @@ class GOSS(GBDT):
             log.fatal("Cannot use bagging in GOSS")
         log.info("Using GOSS")
         self.is_bagging = False
+
+    def _capture_boosting_extra(self):
+        # GOSS resamples every iteration from scores (recomputed on
+        # resume) + this MT19937 stream — only the stream needs saving
+        kind, keys, pos, has_gauss, cached = self.bag_rng.get_state()
+        payload = {"goss_mt": {"pos": int(pos),
+                               "has_gauss": int(has_gauss),
+                               "cached": float(cached)}}
+        return payload, {"goss_mt_keys": np.asarray(keys, np.uint32)}
+
+    def _restore_boosting_extra(self, payload, arrays):
+        mt = payload.get("goss_mt")
+        if mt:
+            self.bag_rng.set_state(
+                ("MT19937", np.asarray(arrays["goss_mt_keys"], np.uint32),
+                 int(mt["pos"]), int(mt["has_gauss"]),
+                 float(mt["cached"])))
 
     def _bagging(self, it, grad, hess):
         """(ref: goss.hpp:103-159 BaggingHelper/Bagging). Multi-process:

@@ -23,6 +23,13 @@ they work from the command line and from config files alike. On a crash
 with ``telemetry_out`` set, the flight recorder dumps
 ``<telemetry_out>.crash.json``. ``compilation_cache_dir=<dir>`` makes
 repeated CLI runs skip XLA recompiles (docs/Performance.md).
+
+Resilience flags (docs/Reliability.md): ``checkpoint_dir=<dir>
+checkpoint_period=N`` write async resumable checkpoints during
+training, and ``task=train resume=<path>`` restores one (a concrete
+``ckpt_<iteration>`` directory or the checkpoint_dir root — the newest
+complete checkpoint is selected) and continues bit-identically to an
+uninterrupted run.
 """
 from __future__ import annotations
 

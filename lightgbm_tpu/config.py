@@ -350,6 +350,62 @@ _PARAMS: List[_Param] = [
        ("straggler_skew_threshold",), check=(">", 1.0),
        desc="max/median per-section time ratio across ranks at or above "
             "which the health auditor emits a straggler event"),
+    # ---- Resilience (docs/Reliability.md) ----
+    _p("checkpoint_dir", str, "", ("checkpoint_path",),
+       desc="directory for resumable training checkpoints "
+            "(resilience/): per-rank atomic write-then-rename files "
+            "under ckpt_<iteration>/ with a manifest (rank, iteration, "
+            "model-state hash), written by a background thread at "
+            "megastep drain boundaries / every checkpoint_period "
+            "iterations; empty = checkpointing off"),
+    _p("checkpoint_period", int, 0, ("checkpoint_freq",), check=(">=", 0),
+       desc="checkpoint at least every N boosting iterations (0 = off). "
+            "On the fast path the write lands at the next drain "
+            "boundary at or past N, so checkpointing never adds a "
+            "device dispatch; a crashed multi-chip run resumes from the "
+            "newest rank-consistent checkpoint with at most N "
+            "iterations of lost work"),
+    _p("checkpoint_keep", int, 2, check=(">=", 1),
+       desc="complete checkpoints retained per rank (>= 2 keeps the "
+            "previous one valid while the next is being written — the "
+            "double-buffer invariant)"),
+    _p("resume", str, "", ("resume_from",),
+       desc="resume training from a checkpoint: a concrete "
+            "ckpt_<iteration> directory or a checkpoint_dir root (the "
+            "newest complete hash-consistent checkpoint is selected). "
+            "CLI: task=train resume=<path>; API: "
+            "engine.train(resume_from=...). The resumed run's "
+            "serialized model is bit-identical to an uninterrupted run "
+            "with the same params/seed"),
+    _p("health_auto_resync", bool, True,
+       desc="on a rank_divergence health finding, re-sync the diverged "
+            "rank's model state from rank 0's hash-verified "
+            "serialization (score carries fixed up in place) instead of "
+            "only logging; emits a structured 'recovery' event and "
+            "disables itself for the run if a repair fails to converge"),
+    _p("health_checkpoint_on_straggler", bool, False,
+       desc="force an immediate checkpoint when the health auditor "
+            "flags a straggler past health_skew_threshold (a limping "
+            "rank often precedes a dead one; keeps the launcher's "
+            "restart point fresh)"),
+    _p("collective_timeout", float, 0.0, ("collective_timeout_s",),
+       check=(">=", 0.0),
+       desc="seconds before a host-plane collective (multiproc "
+            "allgathers, health audits) degrades a hung peer to a "
+            "structured CollectiveError instead of deadlocking the "
+            "cohort; 0 = off. Set it in the params passed to the "
+            "launcher so a wedged rank turns into a respawn, not a "
+            "hang; size it above the worst-case first-iteration "
+            "compile stall"),
+    _p("collective_retries", int, 2, check=(">=", 0),
+       desc="bounded retries for host collectives that raise transport "
+            "errors (timeouts are never retried — the pairing is lost)"),
+    _p("restart_max_retries", int, 2, check=(">=", 0),
+       desc="launcher (parallel.train_distributed): cohort respawns "
+            "after a rank failure before giving up"),
+    _p("restart_backoff", float, 1.0, check=(">=", 0.0),
+       desc="launcher: base seconds of exponential backoff between "
+            "cohort respawns (base * 2^attempt)"),
 ]
 
 _BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}

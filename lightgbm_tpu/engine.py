@@ -33,9 +33,38 @@ def train(params: Dict[str, Any], train_set: Dataset,
           init_model: Optional[Union[str, Booster]] = None,
           feature_name="auto", categorical_feature="auto",
           keep_training_booster: bool = False,
-          callbacks: Optional[List] = None) -> Booster:
-    """Train a booster (ref: engine.py:25)."""
+          callbacks: Optional[List] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train a booster (ref: engine.py:25).
+
+    ``resume_from`` restores a run from a resilience checkpoint (a
+    ``ckpt_<iteration>`` directory or a ``checkpoint_dir`` root — the
+    newest complete one is selected) and continues it bit-identically
+    to an uninterrupted run with the same params/seed; pass the same
+    dataset, valid sets and callbacks the interrupted run used
+    (docs/Reliability.md). The ``resume`` params key is equivalent."""
     params = dict(params) if params else {}
+    # pop BOTH keys unconditionally: a resume path left in params would
+    # echo into the serialized model's parameters block and break the
+    # bit-identical-serialization contract below
+    _p_resume = params.pop("resume", "") or params.pop("resume_from", "")
+    params.pop("resume_from", None)
+    if resume_from and _p_resume and str(_p_resume) != str(resume_from):
+        log.warning("resume_from=%s overrides params resume=%s",
+                    resume_from, _p_resume)
+    resume_from = resume_from or _p_resume or None
+    if train_set is not None and isinstance(getattr(train_set, "params",
+                                                    None), dict):
+        # the resume path is a per-invocation instruction, not a model
+        # property: scrub it from the dataset params too so the
+        # resumed model's echoed parameters block (and hence its
+        # serialization) stays identical to an uninterrupted run's
+        for key in ("resume", "resume_from"):
+            train_set.params.pop(key, None)
+    if resume_from and init_model is not None:
+        log.warning("resume_from and init_model both given; resume wins "
+                    "(the checkpoint already contains the full model)")
+        init_model = None
     # resolve num_boost_round / early stopping aliases (params win)
     for alias in _ROUND_ALIASES:
         if alias in params:
@@ -152,9 +181,46 @@ def train(params: Dict[str, Any], train_set: Dataset,
             and fobj is None and snapshot_freq <= 0:
         gbdt.arm_megastep(True)
     evaluation_result_list: List = []
+    start_iteration = 0
+    if resume_from:
+        # restore AFTER valid sets were added and the megastep consumer
+        # was armed: the score-carry shapes and the traced eval plan are
+        # settled, so the checkpoint slots can be matched against them
+        from .resilience import state as rstate
+        payload = rstate.restore_into_booster(booster, str(resume_from))
+        start_iteration = gbdt.iter
+        saved_eval = rstate.eval_list_from_payload(payload)
+        env = callback_mod.CallbackEnv(
+            model=booster, params=params,
+            iteration=max(0, start_iteration - 1), begin_iteration=0,
+            end_iteration=num_boost_round,
+            evaluation_result_list=saved_eval)
+        es_state = rstate.restore_callback_states(
+            callbacks_before + callbacks_after,
+            (payload.get("engine_extra") or {}).get("callbacks") or [],
+            env)
+        evaluation_result_list = list(saved_eval)
+        if consumer is not None:
+            consumer.last_eval = list(saved_eval)
+            if es_state is not None:
+                # rebuild the scan's device early-stop carry from the
+                # restored callback state (same f32 values + compares)
+                rstate.synthesize_es_carry(gbdt, es_state)
+    if gbdt._ckpt is not None:
+        # checkpoint extra-state hook: the callback closures' early-stop
+        # lists and the last eval list ride every checkpoint so the
+        # restore above has them on the other side
+        def _engine_ckpt_extra():
+            from .resilience import state as rstate
+            ev = (list(consumer.last_eval) if consumer is not None
+                  else list(evaluation_result_list))
+            return {"callbacks": rstate.callback_states(
+                        callbacks_before + callbacks_after),
+                    "eval_list": [list(t) for t in ev]}
+        gbdt.set_checkpoint_extra(_engine_ckpt_extra)
     i = -1
     try:
-      for i in range(num_boost_round):
+      for i in range(start_iteration, num_boost_round):
         try:
             if consumer is not None:
                 finished = booster.update()
@@ -203,6 +269,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 booster.best_iteration = es.best_iteration + 1
                 evaluation_result_list = es.best_score
                 break
+            # sync-driver checkpoint cadence: the iteration is fully
+            # settled here (update + snapshot + eval + callbacks), so
+            # the captured callback state matches the captured model
+            gbdt.maybe_checkpoint()
             if finished:
                 break
         except callback_mod.EarlyStopException:
@@ -222,6 +292,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # (disarming with a consumer bound drains + replays the tail
         # first, so no queued metric rows are dropped)
         booster._gbdt.arm_megastep(False)
+        booster._gbdt.set_checkpoint_extra(None)
 
     if consumer is not None:
         # the tail drain above may have replayed the final iterations —

@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils import log
+
 FAULT_RANK_ENV = "LIGHTGBM_TPU_HEALTH_FAULT_RANK"
 
 
@@ -77,10 +79,21 @@ class HealthAuditor:
     """
 
     def __init__(self, telemetry, period: int,
-                 skew_threshold: float = 2.0):
+                 skew_threshold: float = 2.0, resync_fn=None,
+                 auto_resync: bool = True, checkpoint_fn=None,
+                 straggler_checkpoint: bool = False):
         self.telemetry = telemetry
         self.period = max(0, int(period))
         self.skew_threshold = float(skew_threshold)
+        # recovery wiring (resilience/recovery.py): on divergence,
+        # re-sync the diverged rank from rank 0 instead of just logging;
+        # on stragglers, optionally force a checkpoint-now so the
+        # launcher's restart point stays fresh while a rank limps
+        self.resync_fn = resync_fn
+        self.auto_resync = bool(auto_resync)
+        self.checkpoint_fn = checkpoint_fn
+        self.straggler_checkpoint = bool(straggler_checkpoint)
+        self._resync_disabled = False
 
     def due(self, it: int) -> bool:
         return self.period > 0 and (int(it) + 1) % self.period == 0
@@ -123,6 +136,25 @@ class HealthAuditor:
             tel.event("rank_divergence", iteration=it,
                       hashes={str(r["rank"]): r["hash"][:16]
                               for r in per_rank})
+            if self.auto_resync and self.resync_fn is not None \
+                    and not self._resync_disabled:
+                # SPMD: the resync contains its own host allgathers and
+                # runs on EVERY rank of this same audit round; any
+                # exception propagates to the driver's health handler
+                # (multi-process re-raises there — a one-sided bail
+                # would desync the collective schedule)
+                repaired = bool(self.resync_fn(it, per_rank))
+                if repaired:
+                    ok = True
+                else:
+                    # a repair that does not converge (persistent
+                    # corruption source, salted digest) must not thrash
+                    # a broadcast + replay every period
+                    self._resync_disabled = True
+                    log.warning("divergence resync did not converge at "
+                                "iteration %d; auto-resync disabled for "
+                                "the rest of the run", it)
+        straggled = False
         names = sorted({n for r in per_rank for n in r["sections"]})
         for name in names:
             times = [float(r["sections"].get(name, 0.0)) for r in per_rank]
@@ -133,9 +165,22 @@ class HealthAuditor:
             tel.gauge("health.skew." + name, skew)
             if len(per_rank) > 1 and skew >= self.skew_threshold:
                 slowest = int(per_rank[int(np.argmax(times))]["rank"])
+                straggled = True
                 tel.inc("health.straggler")
                 tel.event("straggler", iteration=it, section=name,
                           skew=round(skew, 3), slowest_rank=slowest,
                           max_seconds=round(max(times), 9),
                           median_seconds=round(med, 9))
+        if straggled and self.straggler_checkpoint \
+                and self.checkpoint_fn is not None:
+            # a straggling rank often precedes a dead one — refresh the
+            # restart point now so the launcher's lost work stays small.
+            # Checkpoint capture is collective-free, so the SPMD
+            # schedule is unaffected (every rank straggles or none: the
+            # verdict comes from the shared allgathered payload)
+            tel.event("recovery", action="checkpoint_now", iteration=it)
+            try:
+                self.checkpoint_fn(it)
+            except Exception as e:
+                log.warning("straggler checkpoint-now failed: %s", e)
         return ok

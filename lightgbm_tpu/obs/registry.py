@@ -458,6 +458,20 @@ class Telemetry:
                       max(secs.values()), track="train", iteration=it0)
         return rec
 
+    def restore_counters(self, counters: Dict[str, float]) -> None:
+        """Seed the counter map from a checkpoint snapshot so a resumed
+        run's dashboards continue instead of resetting (resilience/
+        state.py). Saved values REPLACE current ones — restore happens
+        before training resumes, when the registry is fresh."""
+        if not counters:
+            return
+        with self._lock:
+            for key, v in counters.items():
+                try:
+                    self._counters[str(key)] = float(v)
+                except (TypeError, ValueError):
+                    continue
+
     def drain_records(self) -> List[Dict[str, Any]]:
         """Completed iteration records since the last drain (the
         record_telemetry callback's feed)."""
@@ -498,13 +512,21 @@ def allgather_json(obj: Any) -> List[Any]:
     if jax.process_count() <= 1:
         return [obj]
     from jax.experimental import multihost_utils
+
+    from ..resilience.comms import guarded_call
     payload = np.frombuffer(_json.dumps(obj).encode("utf-8"), np.uint8)
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.asarray([payload.size], np.int64))).reshape(-1)
+    # guarded: with collective_timeout configured, a hung peer degrades
+    # to a structured CollectiveError here instead of wedging this rank
+    # inside the native allgather forever
+    sizes = np.asarray(guarded_call(
+        lambda: multihost_utils.process_allgather(
+            np.asarray([payload.size], np.int64)),
+        what="allgather_json/sizes")).reshape(-1)
     width = int(sizes.max())
     buf = np.zeros(width, np.uint8)
     buf[:payload.size] = payload
-    gathered = np.asarray(multihost_utils.process_allgather(buf)) \
-        .reshape(sizes.size, width)
+    gathered = np.asarray(guarded_call(
+        lambda: multihost_utils.process_allgather(buf),
+        what="allgather_json/payload")).reshape(sizes.size, width)
     return [_json.loads(bytes(gathered[r, :int(sizes[r])]).decode("utf-8"))
             for r in range(sizes.size)]

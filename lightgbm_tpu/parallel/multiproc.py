@@ -120,13 +120,19 @@ class MultiProcLayout:
         """process_allgather with telemetry accounting (real payloads,
         not estimates: count 1, bytes = gathered result size) — timed,
         so the trace timeline shows each host-plane collective as a real
-        span on the rank's collectives track."""
+        span on the rank's collectives track. Guarded: with
+        ``collective_timeout`` configured, a hung peer raises a
+        structured CollectiveError instead of deadlocking the layout
+        (resilience/comms.py)."""
+        from ..resilience.comms import guarded_call
         tel = self.telemetry
         if tel is None or not tel.enabled:
-            return self._mh.process_allgather(arr)
+            return guarded_call(lambda: self._mh.process_allgather(arr),
+                                what="mp_allgather")
         wall0 = tel.wall_now()
         t0 = time.perf_counter()
-        out = self._mh.process_allgather(arr)
+        out = guarded_call(lambda: self._mh.process_allgather(arr),
+                           what="mp_allgather", telemetry=tel)
         dt = time.perf_counter() - t0
         a = np.asarray(arr)
         tel.collective("host_allgather", 1,

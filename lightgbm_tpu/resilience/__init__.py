@@ -1,0 +1,47 @@
+"""Elastic fault tolerance for long multi-chip runs (ROADMAP item 5).
+
+The XLA-collective training path makes a single rank crash fatal to the
+whole cohort — unlike the reference's socket layer, there is no
+per-message retry to hide behind. This package turns that hard failure
+mode into bounded lost work:
+
+- :mod:`atomicio` — write-then-rename file helpers; a crash mid-write
+  can never leave a truncated model/checkpoint on disk;
+- :mod:`checkpoint` — double-buffered async checkpoints: the driver
+  captures the complete resumable state at megastep drain boundaries
+  (and every ``checkpoint_period`` iterations on the sync driver), a
+  background thread serializes + commits it with a per-rank manifest
+  (rank, iteration, model-state hash);
+- :mod:`state` — capture/restore of the GBDT driver's training state
+  (models, score carries, bagging/feature RNG stream positions,
+  early-stop state, telemetry counters) with bit-identical resume;
+- :mod:`recovery` — auto-recovery from health-auditor findings: a
+  diverged rank re-syncs model state from rank 0's hash-verified
+  serialization through the host collective layer;
+- :mod:`faults` — deterministic fault injection registry
+  (crash/hang/diverge/torn-checkpoint at a fixed iteration+rank) for
+  the chaos tests;
+- :mod:`comms` — timeout + bounded-retry guards around the host-plane
+  collectives so a hung peer degrades to a structured failure instead
+  of a deadlock.
+
+Launcher-level supervised respawn lives in
+:func:`lightgbm_tpu.parallel.launcher.train_distributed`; resume enters
+through ``engine.train(resume_from=...)`` / CLI ``task=train
+resume=<path>``. See docs/Reliability.md.
+"""
+from __future__ import annotations
+
+from .atomicio import atomic_write_bytes, atomic_write_json, atomic_write_text
+from .checkpoint import (CheckpointManager, list_checkpoints, load_rank,
+                         select_checkpoint)
+from .comms import CollectiveError, guarded_call, set_collective_policy
+from .faults import FaultRegistry, registry_from_env
+
+__all__ = [
+    "atomic_write_bytes", "atomic_write_json", "atomic_write_text",
+    "CheckpointManager", "list_checkpoints", "load_rank",
+    "select_checkpoint",
+    "CollectiveError", "guarded_call", "set_collective_policy",
+    "FaultRegistry", "registry_from_env",
+]

@@ -1,0 +1,48 @@
+"""Atomic file writes: write a temp sibling, fsync, rename into place.
+
+POSIX ``rename`` within one filesystem is atomic, so any reader (a
+resuming launcher, a model loader, the checkpoint selector) sees either
+the previous complete file or the new complete file — never a
+truncation. Every durable artifact this package produces (checkpoint
+arrays, manifests) and the engine's ``snapshot_freq`` model snapshots
+route through these helpers.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def _tmp_path(path: str) -> str:
+    d, base = os.path.split(path)
+    return os.path.join(d, f".{base}.tmp.{os.getpid()}")
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically (temp file + rename)."""
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        # a failed write must not litter temp files next to checkpoints:
+        # the selector treats unknown files as noise, but disk fills are
+        # a real long-run failure mode
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+def atomic_write_json(path: str, obj: Any, fsync: bool = True) -> None:
+    atomic_write_text(path, json.dumps(obj, default=str), fsync=fsync)

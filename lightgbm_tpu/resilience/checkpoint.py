@@ -1,0 +1,286 @@
+"""Async double-buffered training checkpoints.
+
+Layout (one directory per checkpoint, per-rank files inside)::
+
+    <checkpoint_dir>/
+      ckpt_0000000008/
+        rank0.npz     — arrays (trees, score carries, RNG streams)
+        rank0.json    — manifest: schema, rank, world, iteration,
+                        model-state hash, npz byte size, JSON payload
+      ckpt_0000000016/
+        ...
+
+Commit protocol per rank: the ``.npz`` is written tmp→fsync→rename,
+then the manifest tmp→fsync→rename — the manifest's existence commits
+the rank's participation, so a crash at ANY point mid-write leaves the
+previous checkpoint untouched and the new one simply incomplete
+(:func:`select_checkpoint` skips it). Retention keeps the newest
+``keep`` complete checkpoints per rank (double buffering: the previous
+checkpoint is pruned only after the next one commits).
+
+Writing happens on a background thread: the training loop hands over an
+already-captured host snapshot (numpy arrays + JSON payload) and keeps
+going; serialization + fsync + rename + pruning never block an
+iteration. ``wait()`` joins the in-flight write (tests, end of
+training, checkpoint-now recovery actions).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import queue
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .atomicio import atomic_write_bytes, atomic_write_json
+
+SCHEMA_VERSION = 1
+_CKPT_RE = re.compile(r"^ckpt_(\d{10})$")
+
+
+def _ckpt_dirname(iteration: int) -> str:
+    return f"ckpt_{int(iteration):010d}"
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """(iteration, path) of every checkpoint directory under ``root``,
+    newest first. Existence only — completeness is the selector's job."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def _read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            man = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(man, dict) or man.get("schema") != SCHEMA_VERSION:
+        return None
+    return man
+
+
+def checkpoint_manifests(path: str, world: int) -> Optional[List[Dict]]:
+    """All ``world`` rank manifests of one checkpoint directory when the
+    checkpoint is complete AND consistent (every rank present, same
+    iteration, same model-state hash, npz readable at the recorded
+    size); None otherwise — a torn write fails one of these checks."""
+    mans = []
+    for r in range(world):
+        man = _read_manifest(os.path.join(path, f"rank{r}.json"))
+        if man is None or int(man.get("rank", -1)) != r \
+                or int(man.get("world", 0)) != world:
+            return None
+        npz = os.path.join(path, man.get("npz", ""))
+        try:
+            if os.path.getsize(npz) != int(man.get("npz_bytes", -1)):
+                return None
+        except OSError:
+            return None
+        mans.append(man)
+    iters = {int(m["iteration"]) for m in mans}
+    hashes = {m.get("model_hash") for m in mans}
+    if len(iters) != 1 or len(hashes) != 1:
+        return None
+    return mans
+
+
+def select_checkpoint(root: str, world: int) -> Optional[str]:
+    """Newest checkpoint directory complete + hash-consistent across all
+    ``world`` ranks — the launcher's restart point."""
+    for _, path in list_checkpoints(root):
+        if checkpoint_manifests(path, world) is not None:
+            return path
+    return None
+
+
+def load_rank(path: str, rank: int):
+    """(payload dict, npz mapping) for one rank of a checkpoint dir.
+    Raises with a pointed message on a missing/torn checkpoint — resume
+    must fail loudly, not train silently from nothing."""
+    man = _read_manifest(os.path.join(path, f"rank{rank}.json"))
+    if man is None:
+        raise FileNotFoundError(
+            f"no valid rank{rank} manifest in checkpoint {path!r} "
+            "(incomplete or torn write — pick a checkpoint "
+            "select_checkpoint accepts)")
+    npz_path = os.path.join(path, man["npz"])
+    arrays = np.load(npz_path, allow_pickle=False)
+    return man["payload"], arrays
+
+
+def encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+class CheckpointManager:
+    """Per-rank async checkpoint writer over one checkpoint root."""
+
+    def __init__(self, root: str, rank: int, world: int, keep: int = 2,
+                 telemetry=None, async_io: bool = True):
+        self.root = str(root)
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self.keep = max(1, int(keep))
+        self.telemetry = telemetry
+        self.last_error: Optional[str] = None
+        # (iteration, path, model_hash) of the newest committed write —
+        # the crash flight recorder records this as the resume hint
+        self.last_written: Optional[Dict[str, Any]] = None
+        os.makedirs(self.root, exist_ok=True)
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._async = bool(async_io)
+        self._worker: Optional[threading.Thread] = None
+        if self._async:
+            self._worker = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"ckpt-writer-rank{self.rank}")
+            self._worker.start()
+
+    # ------------------------------------------------------------ write
+    def save(self, iteration: int, payload: Dict[str, Any],
+             arrays: Dict[str, np.ndarray]) -> None:
+        """Enqueue one checkpoint. The snapshot is already host-resident
+        and owned by the writer from here on. Blocks only when a prior
+        write is still in flight (bounded queue of one: checkpoints are
+        ordered, and back-to-back saves faster than the disk is a
+        configuration problem surfaced as backpressure, not unbounded
+        memory)."""
+        job = (int(iteration), payload, arrays)
+        if not self._async:
+            self._write(*job)
+            return
+        self._q.put(job)
+
+    def wait(self, timeout: float = 120.0) -> None:
+        """Block until every enqueued write has committed.
+        ``unfinished_tasks`` (incremented at put(), decremented only
+        after the write completes via task_done) covers the window
+        between the worker's get() and the write — an emptiness check
+        would not."""
+        if self._async:
+            deadline = time.time() + timeout
+            while self._q.unfinished_tasks:
+                if time.time() > deadline:
+                    raise TimeoutError("checkpoint writer did not drain")
+                time.sleep(0.01)
+
+    def close(self, timeout: float = 120.0) -> None:
+        """Drain the queue and stop the worker thread (manager is dead
+        afterwards — reset_config replaces, never reuses)."""
+        if not self._async or self._worker is None:
+            return
+        self.wait(timeout)
+        self._q.put(None)           # worker exit sentinel
+        self._worker.join(timeout=5.0)
+        self._worker = None
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                self._write(*job)
+            finally:
+                self._q.task_done()
+
+    def _write(self, iteration: int, payload: Dict[str, Any],
+               arrays: Dict[str, np.ndarray]) -> None:
+        t0 = time.perf_counter()
+        tel = self.telemetry
+        try:
+            cdir = os.path.join(self.root, _ckpt_dirname(iteration))
+            os.makedirs(cdir, exist_ok=True)
+            blob = encode_npz(arrays)
+            npz_name = f"rank{self.rank}.npz"
+            from . import faults
+            if faults.torn_checkpoint_due(iteration, self.rank):
+                # chaos hook: simulate a crash mid-write — half the npz
+                # bytes, no manifest. Deliberately NOT routed through
+                # atomic_write (the torn artifact must be visible), and
+                # the selector must skip this checkpoint.
+                log.warning("fault injection: torn checkpoint write at "
+                            "iteration %d", iteration)
+                with open(os.path.join(cdir, npz_name), "wb") as fh:
+                    fh.write(blob[:max(1, len(blob) // 2)])
+                if tel is not None:
+                    tel.event("fault_injected", kind="torn_ckpt",
+                              iteration=iteration)
+                return
+            atomic_write_bytes(os.path.join(cdir, npz_name), blob)
+            manifest = {
+                "schema": SCHEMA_VERSION,
+                "rank": self.rank,
+                "world": self.world,
+                "iteration": int(iteration),
+                "model_hash": payload.get("model_hash", ""),
+                "npz": npz_name,
+                "npz_bytes": len(blob),
+                "ts": time.time(),
+                "payload": payload,
+            }
+            # the manifest commits this rank's participation — LAST
+            atomic_write_json(os.path.join(cdir, f"rank{self.rank}.json"),
+                              manifest)
+            self.last_written = {"iteration": int(iteration),
+                                 "path": cdir,
+                                 "model_hash": payload.get("model_hash",
+                                                           "")}
+            self.last_error = None
+            dt = time.perf_counter() - t0
+            if tel is not None and tel.enabled:
+                tel.inc("ckpt.written")
+                tel.event("checkpoint_written", iteration=iteration,
+                          path=cdir, bytes=len(blob),
+                          seconds=round(dt, 4))
+            self._prune()
+        except Exception as e:
+            # a checkpoint failure must never kill training — the run is
+            # healthy, only its insurance lapsed; say so loudly
+            self.last_error = f"{type(e).__name__}: {e}"
+            log.warning("checkpoint write at iteration %d failed: %s",
+                        iteration, self.last_error)
+            if tel is not None and tel.enabled:
+                tel.inc("ckpt.failed")
+                tel.event("checkpoint_failed", iteration=iteration,
+                          error=self.last_error[:500])
+
+    # ------------------------------------------------------------ prune
+    def _prune(self) -> None:
+        """Remove THIS rank's files from checkpoints older than the
+        newest ``keep`` ones that carry this rank's manifest, then
+        rmdir best-effort (succeeds once the last rank pruned). Pruning
+        only ever runs after a newer checkpoint committed, so the
+        double-buffer invariant holds: at any instant at least one
+        complete checkpoint survives any crash."""
+        mine = [(it, path) for it, path in list_checkpoints(self.root)
+                if os.path.exists(os.path.join(path,
+                                               f"rank{self.rank}.json"))]
+        for it, path in mine[self.keep:]:
+            for name in (f"rank{self.rank}.json", f"rank{self.rank}.npz"):
+                try:
+                    os.remove(os.path.join(path, name))
+                except OSError:
+                    pass
+            try:
+                os.rmdir(path)
+            except OSError:
+                pass  # other ranks' files still inside

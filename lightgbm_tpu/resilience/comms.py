@@ -1,0 +1,109 @@
+"""Timeout + bounded-retry guards for host-plane collectives.
+
+The multiproc layout's ``process_allgather`` calls and the telemetry
+``allgather_json`` helper block in native code (gloo / the TPU runtime):
+a peer that hangs mid-iteration leaves every other rank wedged inside
+the collective forever — the launcher's only recourse would be its
+whole-run timeout. With a collective timeout configured
+(``collective_timeout`` config key, seconds; 0 = off, the default), the
+blocking call runs on a watchdog thread and a hung peer degrades to a
+structured :class:`CollectiveError` on the waiting ranks, which unwinds
+through the crash flight recorder and lets the launcher respawn the
+cohort from the newest consistent checkpoint.
+
+Transient *errors* raised by the collective itself (transport hiccups)
+are retried a bounded number of times; a timeout is never retried —
+the peers' collective pairing is already lost at that point, and a
+retry would pair with the wrong round.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ..utils import log
+
+# process-wide policy: set once from the driver's config
+# (_setup_resilience); the launcher sets the config key in every worker
+_TIMEOUT_S = 0.0
+_RETRIES = 2
+
+
+class CollectiveError(RuntimeError):
+    """A host-plane collective timed out or kept failing; carries the
+    collective's name and the configured timeout for the flight
+    recorder."""
+
+
+def set_collective_policy(timeout_s: float, retries: int = 2) -> None:
+    global _TIMEOUT_S, _RETRIES
+    _TIMEOUT_S = max(0.0, float(timeout_s or 0.0))
+    _RETRIES = max(0, int(retries))
+
+
+def get_timeout() -> float:
+    return _TIMEOUT_S
+
+
+def _run_with_timeout(fn: Callable, what: str, timeout_s: float):
+    box = {}
+    done = threading.Event()
+
+    def worker():
+        try:
+            box["result"] = fn()
+        except BaseException as e:     # noqa: BLE001 — re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"collective-{what}")
+    t.start()
+    if not done.wait(timeout_s):
+        # the worker thread is abandoned (the native call cannot be
+        # interrupted); the caller is expected to crash out through the
+        # flight recorder, so the leak is bounded by process lifetime
+        raise CollectiveError(
+            f"host collective '{what}' timed out after {timeout_s:.1f}s "
+            "(hung or dead peer); resume from the newest checkpoint")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def guarded_call(fn: Callable, what: str = "allgather", telemetry=None):
+    """Run a blocking host collective under the configured policy.
+
+    With no timeout configured this is a direct call — zero overhead,
+    zero behavior change (the tier-1 default). Errors retry up to the
+    configured count with a short backoff; timeouts raise immediately.
+    """
+    timeout_s = _TIMEOUT_S
+    if timeout_s <= 0.0:
+        return fn()
+    last = None
+    for attempt in range(_RETRIES + 1):
+        try:
+            return _run_with_timeout(fn, what, timeout_s)
+        except CollectiveError:
+            if telemetry is not None and getattr(telemetry, "enabled",
+                                                 False):
+                telemetry.inc("comms.timeout")
+                telemetry.event("collective_timeout", what=what,
+                                timeout_s=timeout_s)
+            raise
+        except Exception as e:          # transport error: bounded retry
+            last = e
+            if telemetry is not None and getattr(telemetry, "enabled",
+                                                 False):
+                telemetry.inc("comms.retry")
+            if attempt < _RETRIES:
+                log.warning("host collective '%s' failed (%s: %s); "
+                            "retry %d/%d", what, type(e).__name__,
+                            str(e)[:200], attempt + 1, _RETRIES)
+                time.sleep(0.5 * (attempt + 1))
+    raise CollectiveError(
+        f"host collective '{what}' failed after {_RETRIES + 1} attempts: "
+        f"{type(last).__name__}: {str(last)[:300]}") from last

@@ -1,0 +1,177 @@
+"""Auto-recovery actions for health-auditor findings.
+
+``rank_divergence`` used to be evidence-only; here it becomes a repair:
+every rank participates (the collective schedule must stay SPMD —
+identical host-plane allgathers on all ranks), rank 0 serializes its
+model state, diverged ranks rebuild from it after hash verification,
+and the per-rank score carries are fixed up by subtracting the rank's
+OWN old trees' contributions and adding the repaired trees' — the score
+rows a rank owns were trained with its own (possibly diverged) routing,
+so the rank-local old tree is exactly what must come back out.
+
+The fix-up dispatches are collective-free elementwise replays (the same
+ops rollback_one_iter uses), and the replayed index set is the
+allgathered UNION of per-rank diffs, so every rank issues the same
+number of dispatches regardless of which rank diverged.
+
+Post-repair the hashes are re-allgathered: equal means repaired; a
+persistent mismatch (e.g. the ``LIGHTGBM_TPU_HEALTH_FAULT_RANK`` salt,
+which taints the digest, not the model) reports ``repaired: false`` and
+the auditor disables further resync attempts for the run instead of
+thrashing.
+"""
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Dict, List
+
+import numpy as np
+
+from ..obs.health import model_state_hash
+from ..utils import log
+from .state import trees_from_arrays, trees_to_arrays
+
+
+def serialize_models_blob(models) -> str:
+    """Model list -> ascii blob (npz arrays + JSON meta, base64) small
+    enough to ride the JSON host-plane allgather."""
+    meta, arrays = trees_to_arrays(models)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return json.dumps({"meta": meta,
+                       "npz": base64.b64encode(buf.getvalue())
+                       .decode("ascii")})
+
+
+def deserialize_models_blob(blob: str):
+    obj = json.loads(blob)
+    arrays = np.load(io.BytesIO(base64.b64decode(obj["npz"])),
+                     allow_pickle=False)
+    return trees_from_arrays(obj["meta"], arrays)
+
+
+def _trees_differ(a, b) -> bool:
+    """Same fields the health hash covers — a digest mismatch must map
+    to at least one differing tree."""
+    for field, dt in (("leaf_value", np.float64),
+                      ("split_feature", np.int32),
+                      ("threshold", np.float64),
+                      ("threshold_bin", np.int32),
+                      ("decision_type", np.int32)):
+        av = np.asarray(getattr(a, field), dtype=dt)
+        bv = np.asarray(getattr(b, field), dtype=dt)
+        if av.shape != bv.shape or not np.array_equal(av, bv):
+            return True
+    return False
+
+
+def _replay_tree(gbdt, idx: int, dt, scale: float) -> None:
+    tid = idx % gbdt.num_tree_per_iteration
+    gbdt.scores = gbdt._add_tree_to_score(
+        gbdt.scores, gbdt._train_bins_replay(), dt, tid, scale=scale,
+        bundle=gbdt._train_bundle())
+    for vi in range(len(gbdt.valid_scores)):
+        gbdt.valid_scores[vi] = gbdt._add_tree_to_score(
+            gbdt.valid_scores[vi], gbdt.valid_bins[vi], dt, tid,
+            scale=scale, bundle=gbdt._valid_bundle(vi))
+
+
+def resync_from_rank0(gbdt, it: int, per_rank: List[Dict]) -> bool:
+    """Repair a detected divergence by re-syncing from rank 0's
+    hash-verified model state. SPMD: every rank calls this from the same
+    audit round. Returns True when the post-repair hashes agree."""
+    from ..obs.registry import allgather_json
+    tel = gbdt.telemetry
+    if len(per_rank) <= 1:
+        return True
+    rank = tel.rank
+    ref_hash = next((r["hash"] for r in per_rank
+                     if int(r["rank"]) == 0), None)
+    # rank 0 ships its serialization; everyone else ships a placeholder
+    # (the allgather itself is the broadcast — same one collective the
+    # auditor already rides)
+    blob = serialize_models_blob(gbdt.models) if rank == 0 else None
+    payloads = allgather_json({"blob": blob})
+    src = payloads[0].get("blob") if payloads else None
+    ok = False
+    replaced = 0
+    if src is None:
+        log.warning("divergence resync aborted: rank 0 sent no model")
+        union: List[int] = []
+    else:
+        new_models = deserialize_models_blob(src)
+        verified = model_state_hash(new_models, rank=-1) == ref_hash
+        if not verified:
+            log.warning("divergence resync: rank 0's serialization does "
+                        "not reproduce its reported hash; model left "
+                        "untouched")
+        if len(new_models) != len(gbdt.models) or not verified:
+            local_diff: List[int] = []
+        else:
+            local_diff = [i for i in range(len(new_models))
+                          if _trees_differ(gbdt.models[i], new_models[i])]
+        # the union keeps the dispatch count identical on every rank —
+        # healthy ranks replay (subtract + re-add) their own identical
+        # tree, diverged ranks swap in the repaired one
+        gathered = allgather_json({"diff": local_diff,
+                                   "usable": bool(verified
+                                                  and len(new_models)
+                                                  == len(gbdt.models))})
+        if all(g.get("usable") for g in gathered):
+            union = sorted({i for g in gathered for i in g["diff"]})
+        else:
+            union = []
+        for idx in union:
+            _replay_tree(gbdt, idx, gbdt.device_trees[idx], -1.0)
+            if idx in local_diff:
+                gbdt.models[idx] = new_models[idx]
+                gbdt.device_trees[idx] = \
+                    gbdt._device_tree_for_resume(new_models[idx])
+                replaced += 1
+            _replay_tree(gbdt, idx, gbdt.device_trees[idx], 1.0)
+    # post-repair verification: the salted fault keeps mismatching here
+    # by design — that is the "repair did not converge" signal
+    post = allgather_json(
+        {"hash": model_state_hash(gbdt.models, rank=rank)})
+    ok = len({p["hash"] for p in post}) == 1
+    tel.inc("health.resync")
+    tel.event("recovery", action="resync", iteration=it, repaired=ok,
+              replaced_trees=replaced,
+              union=len(union),
+              hashes={str(i): p["hash"][:16]
+                      for i, p in enumerate(post)})
+    if ok:
+        log.warning("rank divergence at iteration %d repaired from "
+                    "rank 0 (%d trees replaced on rank %d)", it,
+                    replaced, rank)
+    return ok
+
+
+def inject_divergence(gbdt, it: int) -> None:
+    """Chaos hook (faults.py ``diverge``): perturb the newest grown tree
+    on this rank — model AND this rank's score rows together, keeping
+    the rank-internal invariant a real silent-corruption event would
+    (the rank's scores reflect its own model), which is exactly the
+    state resync_from_rank0 knows how to repair."""
+    import jax.numpy as jnp
+    target = None
+    for idx in range(len(gbdt.models) - 1, -1, -1):
+        if gbdt.models[idx].num_leaves > 1:
+            target = idx
+            break
+    if target is None:
+        log.warning("diverge fault: no grown tree to corrupt yet")
+        return
+    ht = gbdt.models[target]
+    dt = gbdt.device_trees[target]
+    _replay_tree(gbdt, target, dt, -1.0)
+    ht.leaf_value = np.asarray(ht.leaf_value, np.float64) + 1e-3
+    dt.leaf_value = jnp.asarray(ht.leaf_value, jnp.float32)
+    _replay_tree(gbdt, target, dt, 1.0)
+    log.warning("fault injection: diverged rank %d at iteration %d "
+                "(tree %d leaf values perturbed)", gbdt.telemetry.rank,
+                it, target)
+    gbdt.telemetry.event("fault_injected", kind="diverge", iteration=it,
+                         tree=target)

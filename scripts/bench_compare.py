@@ -129,8 +129,14 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # huge timing threshold the CI smoke gates pass — a 2x
     # dispatches_per_request regression must fail even under
     # --threshold 9.0.
+    # - ckpt_dispatches_per_iter (bench.py --micro checkpoint leg): the
+    #   same training with async checkpointing armed — resilience
+    #   checkpoints capture at drain boundaries off the dispatch path,
+    #   so this must EQUAL dispatches_per_iter; drift means
+    #   checkpointing started evicting the fast path.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
+                 "ckpt_dispatches_per_iter",
                  "dispatches_per_request", "compiles_per_1k_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):

@@ -94,6 +94,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: interpret-mode fused-engine tests costing "
         "minutes on the CPU backend (run with -m slow)")
+    config.addinivalue_line(
+        "markers", "chaos: multi-process fault-injection acceptance "
+        "tests (the CI chaos-acceptance job runs -m chaos; also part "
+        "of the weekly slow pass via the paired slow marker)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,373 @@
+"""Fault-tolerance subsystem (lightgbm_tpu/resilience/): atomic IO,
+checkpoint manager commit/selection/pruning semantics, fault-injection
+registry, guarded collectives, and the checkpoint/resume bit-identity
+matrix on the synchronous driver (gbdt with bagging + feature fraction
++ early stopping, GOSS, DART, CLI resume). The megastep-driver variant
+and the multi-process chaos acceptance live in
+test_resilience_chaos.py (chaos/slow marked)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.resilience import atomicio, checkpoint as ckpt_mod
+from lightgbm_tpu.resilience import comms, faults, recovery
+from lightgbm_tpu.resilience import state as rstate
+
+
+# ---------------------------------------------------------- atomic IO
+def test_atomic_write_roundtrip_and_no_temp_litter(tmp_path):
+    p = tmp_path / "out.txt"
+    atomicio.atomic_write_text(str(p), "hello")
+    assert p.read_text() == "hello"
+    atomicio.atomic_write_json(str(tmp_path / "out.json"), {"a": 1})
+    assert json.loads((tmp_path / "out.json").read_text()) == {"a": 1}
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert leftovers == []
+
+
+def test_save_model_never_leaves_partial_file(tmp_path, monkeypatch):
+    X = np.random.RandomState(0).rand(200, 4)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, params={"verbose": -1}),
+                    num_boost_round=2)
+    out = tmp_path / "model.txt"
+    bst.save_model(str(out))
+    good = out.read_text()
+    assert "tree" in good
+    # a serialization failure must leave the existing file untouched
+    monkeypatch.setattr(bst, "model_to_string",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    with pytest.raises(RuntimeError):
+        bst.save_model(str(out))
+    assert out.read_text() == good
+
+
+# --------------------------------------------------- checkpoint manager
+def _mk_manager(tmp_path, keep=2):
+    return ckpt_mod.CheckpointManager(str(tmp_path / "ck"), rank=0,
+                                      world=1, keep=keep, async_io=False)
+
+
+def _save(mgr, iteration, h="abc"):
+    mgr.save(iteration, {"model_hash": h, "iteration": iteration},
+             {"a": np.arange(iteration + 1)})
+
+
+def test_checkpoint_commit_select_load(tmp_path):
+    mgr = _mk_manager(tmp_path)
+    _save(mgr, 4)
+    _save(mgr, 8)
+    root = str(tmp_path / "ck")
+    assert [it for it, _ in ckpt_mod.list_checkpoints(root)] == [8, 4]
+    sel = ckpt_mod.select_checkpoint(root, world=1)
+    assert sel and sel.endswith("ckpt_0000000008")
+    payload, arrays = ckpt_mod.load_rank(sel, 0)
+    assert payload["iteration"] == 8
+    assert np.array_equal(arrays["a"], np.arange(9))
+    assert mgr.last_written["iteration"] == 8
+
+
+def test_torn_npz_and_torn_manifest_are_skipped(tmp_path):
+    mgr = _mk_manager(tmp_path, keep=4)
+    _save(mgr, 4)
+    _save(mgr, 8)
+    _save(mgr, 12)
+    root = str(tmp_path / "ck")
+    # torn npz: truncated mid-write (size no longer matches manifest)
+    npz12 = os.path.join(root, "ckpt_0000000012", "rank0.npz")
+    with open(npz12, "r+b") as fh:
+        fh.truncate(10)
+    # torn manifest: half a JSON object
+    man8 = os.path.join(root, "ckpt_0000000008", "rank0.json")
+    with open(man8, "w") as fh:
+        fh.write('{"schema": 1, "rank"')
+    sel = ckpt_mod.select_checkpoint(root, world=1)
+    assert sel and sel.endswith("ckpt_0000000004")
+    with pytest.raises(FileNotFoundError):
+        ckpt_mod.load_rank(os.path.join(root, "ckpt_0000000008"), 0)
+
+
+def test_checkpoint_pruning_keeps_newest_two(tmp_path):
+    mgr = _mk_manager(tmp_path, keep=2)
+    for it in (2, 4, 6, 8):
+        _save(mgr, it)
+    root = str(tmp_path / "ck")
+    assert [it for it, _ in ckpt_mod.list_checkpoints(root)] == [8, 6]
+
+
+def test_incomplete_world_checkpoint_not_selected(tmp_path):
+    # rank 0 of a 2-rank run committed; rank 1 didn't (crashed first):
+    # the launcher must not resume a half-cohort checkpoint
+    mgr = _mk_manager(tmp_path)
+    _save(mgr, 4)
+    root = str(tmp_path / "ck")
+    assert ckpt_mod.select_checkpoint(root, world=1) is not None
+    assert ckpt_mod.select_checkpoint(root, world=2) is None
+
+
+# ------------------------------------------------------ fault registry
+def test_fault_spec_parse_and_at_most_once(tmp_path):
+    fl = faults.parse_faults("crash@5:rank=1, diverge@3 ,junk,hang@2")
+    assert [(f.kind, f.iteration, f.rank) for f in fl] == \
+        [("crash", 5, 1), ("diverge", 3, -1), ("hang", 2, -1)]
+    reg = faults.FaultRegistry(fl, state_dir=str(tmp_path / "fs"))
+    assert reg.due("crash", 5, rank=1) is not None
+    assert reg.due("crash", 5, rank=1) is None          # fired
+    assert reg.due("crash", 5, rank=0) is None          # wrong rank
+    # a fresh registry (respawned process) sees the marker file
+    reg2 = faults.FaultRegistry(faults.parse_faults("crash@5:rank=1"),
+                                state_dir=str(tmp_path / "fs"))
+    assert reg2.due("crash", 5, rank=1) is None
+    # at_or_after: a megastep that jumped past the iteration still fires
+    reg3 = faults.FaultRegistry(faults.parse_faults("crash@5:rank=1"))
+    assert reg3.due("crash", 7, rank=1) is None
+    assert reg3.due("crash", 7, rank=1, at_or_after=True) is not None
+
+
+def test_torn_ckpt_fault_produces_unselectable_checkpoint(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV, "torn_ckpt@8")
+    monkeypatch.setenv(faults.FAULT_STATE_ENV, str(tmp_path / "fs"))
+    mgr = _mk_manager(tmp_path, keep=4)
+    _save(mgr, 4)
+    _save(mgr, 8)        # torn: half npz, no manifest
+    root = str(tmp_path / "ck")
+    assert mgr.last_written["iteration"] == 4
+    sel = ckpt_mod.select_checkpoint(root, world=1)
+    assert sel and sel.endswith("ckpt_0000000004")
+    _save(mgr, 12)       # the fault fired once; later writes commit
+    assert ckpt_mod.select_checkpoint(root, world=1) \
+        .endswith("ckpt_0000000012")
+
+
+# --------------------------------------------------- guarded collectives
+def test_guarded_call_timeout_and_retry():
+    comms.set_collective_policy(0.2, retries=1)
+    try:
+        with pytest.raises(comms.CollectiveError,
+                           match="timed out"):
+            comms.guarded_call(lambda: time.sleep(3), what="unit")
+        # transient errors retry; success on the second attempt
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transport hiccup")
+            return 42
+
+        assert comms.guarded_call(flaky, what="unit") == 42
+        # persistent errors exhaust the retry budget
+        with pytest.raises(comms.CollectiveError, match="failed after"):
+            comms.guarded_call(
+                lambda: (_ for _ in ()).throw(OSError("down")),
+                what="unit")
+    finally:
+        comms.set_collective_policy(0.0)
+    # with no timeout configured, guarded_call is a plain passthrough
+    assert comms.guarded_call(lambda: "direct") == "direct"
+
+
+# --------------------------------------------- recovery building blocks
+def test_models_blob_roundtrip_and_diff():
+    X = np.random.RandomState(1).rand(300, 5)
+    y = (X[:, 0] + X[:, 1] > 1).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, params={"verbose": -1}),
+                    num_boost_round=3)
+    models = bst._gbdt.models
+    blob = recovery.serialize_models_blob(models)
+    back = recovery.deserialize_models_blob(blob)
+    assert len(back) == len(models)
+    from lightgbm_tpu.obs.health import model_state_hash
+    assert model_state_hash(back, rank=-1) == \
+        model_state_hash(models, rank=-1)
+    assert not any(recovery._trees_differ(a, b)
+                   for a, b in zip(models, back))
+    back[1].leaf_value = back[1].leaf_value + 1e-3
+    assert recovery._trees_differ(models[1], back[1])
+
+
+# -------------------------------------- resume bit-identity (sync driver)
+def _data(seed=0, n=400, f=8):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1).astype(np.float32)
+    return X, y
+
+
+def _train(params, X, y, n_rounds, valid=None, cbs=None, resume=None):
+    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
+    vs = None
+    if valid is not None:
+        vs = [lgb.Dataset(valid[0], label=valid[1], reference=ds)]
+    return lgb.train(dict(params), ds, num_boost_round=n_rounds,
+                     valid_sets=vs, callbacks=list(cbs or []),
+                     resume_from=resume)
+
+
+def _assert_resume_identity(tmp_path, params, n1, n2, valid=None,
+                            cbs_factory=lambda: []):
+    """Core matrix assertion: train n2 rounds straight through vs train
+    n1 + resume to n2 — byte-identical serialized models. All runs use
+    the SAME params (incl. checkpoint_dir, which is echoed into the
+    model's parameters block), with the directory wiped in between."""
+    import shutil
+    ck = tmp_path / "ck"
+    params = dict(params, checkpoint_dir=str(ck), checkpoint_period=3)
+    X, y = _data()
+    ref = _train(params, X, y, n2, valid=valid, cbs=cbs_factory())
+    ref_str = ref.model_to_string(num_iteration=-1)
+    shutil.rmtree(ck)
+    _train(params, X, y, n1, valid=valid, cbs=cbs_factory())
+    resumed = _train(params, X, y, n2, valid=valid, cbs=cbs_factory(),
+                     resume=str(ck))
+    assert resumed.model_to_string(num_iteration=-1) == ref_str
+    assert resumed.num_trees() == ref.num_trees()
+    return ref, resumed
+
+
+def test_resume_identity_gbdt_bagging_ff_early_stop(tmp_path):
+    Xv, yv = _data(seed=7, n=150)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "bagging_fraction": 0.7, "bagging_freq": 2,
+              "feature_fraction": 0.8}
+    ref, resumed = _assert_resume_identity(
+        tmp_path, params, n1=8, n2=14, valid=(Xv, yv),
+        cbs_factory=lambda: [lgb.early_stopping(8, verbose=False)])
+    assert resumed.best_iteration == ref.best_iteration
+
+
+def test_resume_identity_goss(tmp_path):
+    # learning_rate 0.2 -> GOSS sampling (and its MT19937 stream)
+    # engages from iteration 5, straddling the n1=8 resume point
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 7,
+              "learning_rate": 0.2, "verbose": -1}
+    _assert_resume_identity(tmp_path, params, n1=8, n2=12)
+
+
+def test_resume_identity_dart(tmp_path):
+    # DART mutates already-materialized trees (normalization) and keeps
+    # a drop stream + per-tree weights — all of it must ride the
+    # checkpoint for the resumed run to reproduce the drop schedule
+    params = {"objective": "regression", "boosting": "dart",
+              "num_leaves": 7, "drop_rate": 0.5, "verbose": -1}
+    _assert_resume_identity(tmp_path, params, n1=6, n2=10)
+
+
+def test_resume_records_eval_history(tmp_path):
+    import shutil
+    ck = tmp_path / "ck"
+    Xv, yv = _data(seed=3, n=150)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "checkpoint_dir": str(ck), "checkpoint_period": 3}
+    X, y = _data()
+    rec_ref = {}
+    _train(params, X, y, 10, valid=(Xv, yv),
+           cbs=[lgb.record_evaluation(rec_ref)])
+    shutil.rmtree(ck)
+    rec_a = {}
+    _train(params, X, y, 6, valid=(Xv, yv),
+           cbs=[lgb.record_evaluation(rec_a)])
+    rec_b = {}
+    _train(params, X, y, 10, valid=(Xv, yv),
+           cbs=[lgb.record_evaluation(rec_b)], resume=str(ck))
+    # the recorded curve continues across the resume: full history, not
+    # just the post-resume tail (checkpoint was written at iteration 6)
+    assert rec_b == rec_ref
+    assert len(rec_b["valid_0"]["binary_logloss"]) == 10
+
+
+def test_cli_train_resume_path(tmp_path):
+    import shutil
+
+    from lightgbm_tpu import cli
+    X, y = _data(n=300, f=5)
+    train_csv = tmp_path / "train.csv"
+    np.savetxt(train_csv, np.column_stack([y, X]), delimiter=",",
+               fmt="%.6f")
+    ck = tmp_path / "ck"
+    # one shared output path: the configured output_model is echoed in
+    # the model's parameters block, so byte-identity needs it equal
+    out = tmp_path / "model.txt"
+    base = ["task=train", f"data={train_csv}", "objective=binary",
+            "num_leaves=7", "verbose=-1", "label_column=0",
+            f"checkpoint_dir={ck}", "checkpoint_period=3",
+            f"output_model={out}"]
+    cli.main(base + ["num_iterations=10"])
+    ref_text = out.read_text()
+    shutil.rmtree(ck)
+    cli.main(base + ["num_iterations=6"])
+    cli.main(base + ["num_iterations=10", f"resume={ck}"])
+    assert out.read_text() == ref_text
+
+
+def test_resume_refuses_wrong_boosting(tmp_path):
+    from lightgbm_tpu.utils.log import LightGBMError
+    ck = tmp_path / "ck"
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "checkpoint_dir": str(ck), "checkpoint_period": 2}
+    X, y = _data()
+    _train(params, X, y, 4)
+    with pytest.raises((LightGBMError, SystemExit, Exception)):
+        _train(dict(params, boosting="dart"), X, y, 8, resume=str(ck))
+
+
+def test_crash_dump_records_checkpoint_manifest(tmp_path):
+    ck = tmp_path / "ck"
+    tel = tmp_path / "tel.jsonl"
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "telemetry_out": str(tel),
+              "checkpoint_dir": str(ck), "checkpoint_period": 2}
+    X, y = _data()
+    bst = _train(params, X, y, 6)
+    path = bst._gbdt.dump_crash(RuntimeError("synthetic"))
+    assert path == str(tel) + ".crash.json"
+    dump = json.loads(open(path).read())
+    # the dump names the rank's newest committed checkpoint — the first
+    # thing an operator needs to restart with bounded lost work
+    assert dump["checkpoint"] is not None
+    assert dump["checkpoint"]["iteration"] >= 2
+    assert os.path.isdir(dump["checkpoint"]["path"])
+    assert dump["checkpoint"]["model_hash"]
+
+
+def test_engine_snapshots_are_atomic_and_resumable(tmp_path):
+    snap_base = tmp_path / "model.txt"
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "snapshot_freq": 2, "output_model": str(snap_base)}
+    X, y = _data()
+    _train(params, X, y, 5)
+    snaps = sorted(p.name for p in tmp_path.glob("*.snapshot_iter_*"))
+    assert snaps == ["model.txt.snapshot_iter_2",
+                     "model.txt.snapshot_iter_4"]
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    # every snapshot parses as a complete model
+    for s in snaps:
+        b = lgb.Booster(model_file=str(tmp_path / s))
+        assert b.num_trees() > 0
+
+
+def test_checkpoint_counters_survive_resume(tmp_path):
+    import shutil
+    ck = tmp_path / "ck"
+    tel = tmp_path / "tel.jsonl"
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "telemetry_out": str(tel),
+              "checkpoint_dir": str(ck), "checkpoint_period": 3}
+    X, y = _data()
+    a = _train(params, X, y, 6)
+    iters_a = a.telemetry()["counters"]["iterations"]
+    assert a.telemetry()["counters"].get("ckpt.written", 0) >= 1
+    b = _train(params, X, y, 10, resume=str(ck))
+    # resumed counters continue from the checkpoint instead of resetting
+    assert b.telemetry()["counters"]["iterations"] > iters_a
+    shutil.rmtree(ck)
