@@ -184,6 +184,14 @@ def _probe_chip(timeout_s: float = None):
     return None, last
 
 
+def _free_port() -> int:
+    # the launcher's probe (SO_REUSEADDR narrows the rebind race);
+    # imported lazily — by the time a bench leg needs a port,
+    # lightgbm_tpu is imported anyway
+    from lightgbm_tpu.parallel.launcher import _free_port as probe
+    return probe()
+
+
 def _make_data(n_rows: int, n_feat: int, seed: int = 0):
     rng = np.random.RandomState(seed)
     X = rng.rand(n_rows, n_feat).astype(np.float32)
@@ -433,7 +441,48 @@ def run_micro() -> None:
         float(c3.get("train.dispatches", 0)) / ckpt_iters, 4)
     _RESULT["checkpoints_written"] = int(c3.get("ckpt.written", 0))
     shutil.rmtree(ckpt_root, ignore_errors=True)
-    for p in (tel_path, tel_eval, tel_ckpt):
+
+    # ---- observability leg: the bare training again with the LIVE
+    # OpenMetrics exporter serving scrapes. The observability plane may
+    # not touch the fast path: obs_dispatches_per_iter must equal
+    # dispatches_per_iter EXACTLY (bench_compare deterministic counter +
+    # the perf-smoke absolute assertion), and a mid-process scrape of
+    # the endpoint must return parseable OpenMetrics whose dispatch
+    # counter agrees with the registry snapshot.
+    obs_port = _free_port()
+    tel_obs = tel_path + ".obs"
+    ds4 = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst4 = lgb.train(dict(params, telemetry_out=tel_obs,
+                          metrics_port=obs_port),
+                     ds4, num_boost_round=n_iters)
+    obs_wall = time.perf_counter() - t0
+    _phase("micro_obs_train_ok")
+    c4 = bst4.telemetry().get("counters", {})
+    obs_iters = max(1, int(c4.get("iterations", n_iters)))
+    _RESULT["obs_sec_per_iter"] = round(obs_wall / obs_iters, 5)
+    _RESULT["obs_dispatches_per_iter"] = round(
+        float(c4.get("train.dispatches", 0)) / obs_iters, 4)
+    # the exporter outlives finalize by design — the endpoint must
+    # answer while the process holds the booster. Scrape its ACTUAL
+    # url: a TCP race on the probed port degrades the exporter to an
+    # ephemeral bind (its own resilience contract), not a CI failure.
+    mx = getattr(bst4._gbdt, "_metrics", None)
+    try:
+        from lightgbm_tpu.obs.export import scrape
+        _, body = scrape(mx.url, timeout=10)
+        line = next(l for l in body.splitlines()
+                    if l.startswith("lgbm_train_dispatches_total"))
+        _RESULT["exporter_scrape_ok"] = (
+            float(line.rsplit(" ", 1)[1])
+            == float(c4.get("train.dispatches", 0)))
+    except Exception as e:
+        print(f"exporter scrape failed: {e}", file=sys.stderr)
+        _RESULT["exporter_scrape_ok"] = False
+    finally:
+        if mx is not None:
+            mx.stop()
+    for p in (tel_path, tel_eval, tel_ckpt, tel_obs):
         try:
             os.remove(p)
         except OSError:
@@ -502,9 +551,16 @@ def run_serve() -> None:
             num_boost_round=int(os.environ.get("SERVE_TREES", 20)))
     _phase("serve_models_trained")
 
+    # live exporter ON for the whole bench: the deterministic counters
+    # below (dispatches_per_request == 1.0, compiles_per_1k == 0) are
+    # measured WITH the observability plane active, so the CI absolute
+    # gate doubles as the exporter-on/off equality check — the off
+    # values are the contract itself
+    serve_metrics_port = _free_port()
     svc = PredictionService(models, max_batch_rows=max_batch,
                             max_delay_ms=1.0, min_bucket_rows=16,
-                            batch_events=False)
+                            batch_events=False,
+                            metrics_port=serve_metrics_port)
     svc.warmup()
     _phase("serve_warmup_ok")
 
@@ -537,6 +593,19 @@ def run_serve() -> None:
         d_comp * 1000.0 / n_requests, 6)
     _RESULT["closed_loop_rows_per_s"] = round(
         float(sizes.sum()) / closed_wall, 1)
+    # mid-run scrape: the service is still live (open loop follows) —
+    # the exporter must answer NOW with the requests already counted
+    # (the serve-smoke CI job asserts exporter_requests_total > 0)
+    try:
+        from lightgbm_tpu.obs.export import scrape
+        _, body = scrape(svc.metrics_url, timeout=10)
+        line = next(l for l in body.splitlines()
+                    if l.startswith("lgbm_serve_requests_total"))
+        _RESULT["exporter_requests_total"] = int(
+            float(line.rsplit(" ", 1)[1]))
+    except Exception as e:
+        print(f"serve exporter scrape failed: {e}", file=sys.stderr)
+        _RESULT["exporter_requests_total"] = 0
     _phase("serve_closed_ok")
     _emit()   # the deterministic gate numbers are on stdout now
 

@@ -23,7 +23,7 @@ from ..config import Config
 from ..dataset import TpuDataset
 from ..models.learner import FeatureMeta, grow_tree_depthwise, grow_tree_leafwise
 from ..models.tree import HostTree, TreeArrays
-from ..obs import Telemetry, device_memory_stats
+from ..obs import Telemetry
 from ..ops.predict import add_tree_score
 from ..ops.split import SplitParams, calculate_leaf_output
 from ..utils import log
@@ -215,6 +215,8 @@ class GBDT:
         # record_telemetry enables it
         self.telemetry = Telemetry()
         self._health = None
+        self._metrics = None           # live OpenMetrics exporter
+        self._mem_watermarks = True
         self._tel_gran = "batch"
         self._trace_out = ""
         self._trace_written = False
@@ -360,7 +362,10 @@ class GBDT:
         out = str(getattr(config, "telemetry_out", "") or "")
         self._trace_out = str(getattr(config, "trace_out", "") or "")
         period = int(getattr(config, "health_check_period", 0) or 0)
-        if out or self._trace_out or period > 0:
+        metrics_port = int(getattr(config, "metrics_port", 0) or 0)
+        self._mem_watermarks = bool(getattr(config, "memory_watermarks",
+                                            True))
+        if out or self._trace_out or period > 0 or metrics_port > 0:
             # enable() attaches the sink even when the registry is
             # already on sink-less (record_telemetry first, then
             # reset_parameter(telemetry_out=...) must still get a file);
@@ -377,6 +382,27 @@ class GBDT:
             # must stop too, or each section keeps paying the append
             # with no exporter left to drain it
             tel.enable(trace=False)
+        # live OpenMetrics endpoint (obs/export.py): one exporter per
+        # booster at metrics_port + rank; a config reset that keeps the
+        # same port keeps the running server (re-binding would drop a
+        # scraper mid-run), any other change stops the old one first.
+        # The exporter outlives finalize_telemetry deliberately — "live"
+        # means scrapeable for as long as the process holds the booster.
+        want_port = metrics_port + tel.rank if metrics_port > 0 else 0
+        if self._metrics is not None and (
+                want_port <= 0
+                or self._metrics.requested_port != want_port):
+            self._metrics.stop()
+            self._metrics = None
+        if want_port > 0 and self._metrics is None:
+            from ..obs.export import MetricsExporter
+            self._metrics = MetricsExporter(tel, want_port)
+            if self._metrics.start() < 0:
+                # total bind failure (not the in-use fallback): drop
+                # the dead exporter so a later reset_parameter round
+                # trip RETRIES the bind instead of matching
+                # requested_port against a server that never existed
+                self._metrics = None
         self._health = None
         if period > 0:
             from ..obs.health import HealthAuditor
@@ -3027,6 +3053,12 @@ class GBDT:
                 if gains.size:
                     tel.observe("batch.split_gain_mean",
                                 float(gains.mean()))
+        if tel.enabled and flat and self._mem_watermarks:
+            # the drain is the fast path's one honest sync point — the
+            # allocator's peak over the whole drained batch is settled
+            # here, so this is where the HBM watermarks move
+            from ..obs.jaxmon import memory_watermarks
+            memory_watermarks(tel, where="drain")
         self._batch_t0 = self._batch_w0 = None
         self._batch_fused = 0
         # drain boundaries are the fast path's natural consistency
@@ -3302,7 +3334,8 @@ class GBDT:
         self._bagging(self.iter, None, None)   # chunk-aligned: a round
         # can fire only at the chunk's first iteration
         fn = self._megastep_fns.get(chunk)
-        if fn is None:
+        fresh_fn = fn is None
+        if fresh_fn:
             fn = self._megastep_fns[chunk] = self._make_megastep(chunk)
         F_oh = self.fused_f_oh
         F = self.train_data.num_features
@@ -3327,6 +3360,7 @@ class GBDT:
         metrics_B = None
         # profiler users see the fused chunk as one annotated step
         # (profile_dir / jax.profiler traces); free when no trace is on
+        t_call0 = time.perf_counter() if fresh_fn else 0.0
         with jax.profiler.StepTraceAnnotation("megastep",
                                               step_num=self.iter):
             if plan is None:
@@ -3346,6 +3380,20 @@ class GBDT:
                     tuple(self.valid_bins), tuple(self.valid_scores),
                     operands, self.bag_weight, fm_pads, iters_B,
                     self._plan_ops, self._es_carry)
+        if fresh_fn and self.telemetry.enabled:
+            # the first call of a new chunk signature traces + compiles
+            # synchronously before the async dispatch returns, so its
+            # wall time IS the compile cost; operand bytes estimated
+            # from the arrays actually passed (the exporter's
+            # recompile-rate / headroom record, obs/export.py)
+            op_bytes = sum(
+                int(getattr(a, "nbytes", 0)) for a in
+                [self.fused_bins_T, self.scores, self.bag_weight,
+                 fm_pads, *self.valid_bins, *self.valid_scores])
+            self.telemetry.compile_executable(
+                f"megastep[chunk={chunk},k={k},eval={plan is not None}]",
+                (time.perf_counter() - t_call0) * 1000.0, op_bytes,
+                iteration=self.iter)
         self.scores = scores
         self.valid_scores = list(vscores)
         # the fused-epilogue carry (score_pad, hist0, gh_T) captured
@@ -3811,10 +3859,16 @@ class GBDT:
                 sg.update(min=float(gains.min()), max=float(gains.max()),
                           mean=float(gains.mean()))
             extra["split_gain"] = sg
-        mem = device_memory_stats()
-        if mem:
-            extra["memory"] = mem
-            tel.gauge("device.bytes_in_use", mem.get("bytes_in_use", 0))
+        if self._mem_watermarks:
+            from ..obs.jaxmon import memory_watermarks
+            mem = memory_watermarks(tel)   # per-device gauges; None=CPU
+            if mem:
+                extra["memory"] = {f"d{d}": st for d, st in mem.items()}
+                # back-compat headline gauge: the first device's live
+                # bytes (docs ≤ §2 schema; dashboards keyed on it keep
+                # working while the per-device series ramp up)
+                tel.gauge("device.bytes_in_use",
+                          mem[min(mem)].get("bytes_in_use", 0))
         return tel.end_iteration(it, **extra)
 
     # ------------------------------------------------------------------

@@ -17,10 +17,14 @@ the default, keeps the pipelined/megastep fast path and attributes time
 per drained batch; ``iteration``/``section`` trade speed for finer
 attribution), ``trace_out=<path>`` exports a Perfetto/Chrome-trace
 timeline (one track per rank), ``health_check_period=N`` turns on the
-cross-rank health auditor, and ``profile_dir=<dir>`` captures a
-jax.profiler trace of the training loop — all ordinary config keys, so
-they work from the command line and from config files alike. On a crash
-with ``telemetry_out`` set, the flight recorder dumps
+cross-rank health auditor, ``profile_dir=<dir>`` captures a
+jax.profiler trace of the training loop, and ``metrics_port=<p>``
+serves the LIVE telemetry registry as an OpenMetrics/Prometheus
+endpoint on ``http://127.0.0.1:<p>/metrics`` while the run is going
+(rank r binds ``<p>+r`` under the multiproc launcher; rank 0 appends
+the fleet counter view) — all ordinary config keys, so they work from
+the command line and from config files alike. On a crash with
+``telemetry_out`` set, the flight recorder dumps
 ``<telemetry_out>.crash.json``. ``compilation_cache_dir=<dir>`` makes
 repeated CLI runs skip XLA recompiles (docs/Performance.md).
 
@@ -96,6 +100,10 @@ def run_train(params: Dict[str, str]) -> None:
     if trace_out:
         log.info("Load %s in chrome://tracing or ui.perfetto.dev",
                  trace_out)
+    mp = getattr(getattr(booster, "_gbdt", None), "_metrics", None)
+    if mp is not None and mp.url:
+        log.info("OpenMetrics endpoint still live at %s (until this "
+                 "process exits)", mp.url)
 
 
 def run_predict(params: Dict[str, str]) -> None:

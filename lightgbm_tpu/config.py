@@ -350,6 +350,25 @@ _PARAMS: List[_Param] = [
        ("straggler_skew_threshold",), check=(">", 1.0),
        desc="max/median per-section time ratio across ranks at or above "
             "which the health auditor emits a straggler event"),
+    _p("metrics_port", int, 0, ("prometheus_port", "openmetrics_port"),
+       check=(">=", 0),
+       desc="serve the LIVE telemetry registry as an OpenMetrics/"
+            "Prometheus endpoint on http://127.0.0.1:<port>/metrics "
+            "(stdlib http.server on a daemon thread; counters, gauges, "
+            "timing summaries and dist quantiles with rank/run_id "
+            "labels). Multi-process ranks bind <port>+<rank>; rank 0 "
+            "additionally appends the fleet counter series fed by the "
+            "health auditor's existing allgather. A port in use falls "
+            "back to an ephemeral port with a structured "
+            "metrics_exporter event. 0 = off. Implies telemetry "
+            "(batch granularity — the fast path is kept)"),
+    _p("memory_watermarks", bool, True,
+       ("memory_watermark", "mem_watermarks"),
+       desc="when telemetry is enabled, gauge every local device's "
+            "bytes_in_use / peak_bytes_in_use / bytes_limit "
+            "(mem.d<id>.* gauges, the exporter's HBM-headroom series) "
+            "at megastep drain and serving dispatch boundaries; "
+            "backends without allocator stats (CPU) degrade to a no-op"),
     # ---- Resilience (docs/Reliability.md) ----
     _p("checkpoint_dir", str, "", ("checkpoint_path",),
        desc="directory for resumable training checkpoints "

@@ -17,18 +17,27 @@ package is the permanent, low-overhead replacement:
 - trace.py — Perfetto/Chrome-trace exporter behind ``trace_out=<path>``
   (one track per rank, spans for sections/collectives/compiles);
 - :class:`HealthAuditor` (health.py) — periodic cross-rank model-hash +
-  straggler auditing behind ``health_check_period``.
+  straggler auditing behind ``health_check_period``;
+- :class:`MetricsExporter` (export.py) — live OpenMetrics/Prometheus
+  HTTP endpoint over the registry behind ``metrics_port=<p>`` (per-rank
+  ports under multi-process; rank 0 appends the fleet counter view);
+- reqtrace.py — request-scoped serving traces: a ``trace_id`` minted at
+  ``PredictionService.submit()`` rides through the micro-batcher and
+  engine dispatch into one ``serve_access`` JSONL record and one
+  Perfetto span per request.
 
 Every recording method is a no-op behind a single attribute check while
 the registry is disabled, so instrumentation stays in the hot driver
 paths permanently, like the reference's TIMETAG sections.
 """
 from .events import JsonlSink
+from .export import MetricsExporter, render_openmetrics
 from .health import HealthAuditor, model_state_hash
-from .jaxmon import device_memory_stats
+from .jaxmon import device_memory_stats, memory_watermarks
 from .registry import Telemetry, allgather_json
 from .trace import chrome_trace_events, write_trace
 
 __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
-           "allgather_json", "HealthAuditor", "model_state_hash",
-           "chrome_trace_events", "write_trace"]
+           "memory_watermarks", "allgather_json", "HealthAuditor",
+           "model_state_hash", "chrome_trace_events", "write_trace",
+           "MetricsExporter", "render_openmetrics"]

@@ -1,0 +1,268 @@
+"""OpenMetrics/Prometheus HTTP exporter for the live Telemetry registry.
+
+Everything the registry records — counters, gauges, timing
+distributions, ``dist()`` quantile rings — becomes scrapeable from the
+RUNNING process the moment ``metrics_port=<p>`` is set (config/CLI key
+for training, constructor knob for ``PredictionService``): a stdlib
+``http.server`` on a daemon thread renders a fresh snapshot per GET, so
+dashboards watch a multi-chip train job or a serving fleet live instead
+of post-hoc JSONL archaeology.
+
+Exposition (docs/Observability.md §10):
+
+- counters  → ``lgbm_<name>_total``   (OpenMetrics ``counter``)
+- gauges    → ``lgbm_<name>``         (``gauge``; per-device memory
+  lands here as ``lgbm_mem_d<id>_bytes_in_use`` etc.)
+- timings   → ``lgbm_<name>_seconds`` (``summary``: ``_count``/``_sum``
+  plus ``_min``/``_max`` gauges)
+- dists     → ``lgbm_<name>``         (``summary`` with ``quantile``
+  labels 0.5/0.95/0.99 off the bounded sample ring)
+
+Every series carries ``rank`` and ``run_id`` labels.  Endpoints:
+``/metrics`` (the local registry; on rank 0 the fleet counter series —
+fed by the health auditor's existing allgather, zero new collectives —
+are appended with their origin rank's label), ``/healthz`` (liveness).
+
+Port discipline: under the multiproc launcher each rank binds
+``metrics_port + rank``.  A port already in use degrades to an
+ephemeral port with a structured ``metrics_exporter`` event (never an
+exception into training), so two boosters in one process — or a test
+runner racing itself — cannot crash a run over a TCP bind.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import log
+
+CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def _metric_name(name: str, prefix: str = "lgbm_") -> str:
+    out = prefix + _NAME_RE.sub("_", str(name))
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r'\"') \
+            .replace("\n", r"\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _num(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_openmetrics(snapshot: Dict[str, Any],
+                       labels: Optional[Dict[str, Any]] = None,
+                       fleet: Optional[List[Dict[str, Any]]] = None
+                       ) -> str:
+    """Registry snapshot (Telemetry.snapshot schema) → OpenMetrics
+    exposition text.  ``fleet`` entries (``{"rank": r, "counters":
+    {...}}``) add per-rank counter series under the same families —
+    the aggregated view rank 0 serves for the whole cohort."""
+    labels = dict(labels or {})
+    lines: List[str] = []
+    local_rank = labels.get("rank")
+
+    counters = snapshot.get("counters", {})
+    fleet = [e for e in (fleet or [])
+             if isinstance(e.get("counters"), dict)
+             and e.get("rank") != local_rank]
+    fleet_names = {n for e in fleet for n in e["counters"]}
+    for name in sorted(set(counters) | fleet_names):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        if name in counters:
+            lines.append(f"{m}_total{_fmt_labels(labels)} "
+                         f"{_num(counters[name])}")
+        for e in fleet:
+            if name in e["counters"]:
+                lab = dict(labels, rank=e.get("rank"))
+                lab.pop("run_id", None)   # peers' run ids aren't ours
+                lines.append(f"{m}_total{_fmt_labels(lab)} "
+                             f"{_num(e['counters'][name])}")
+
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{_fmt_labels(labels)} {_num(v)}")
+
+    for name, t in sorted(snapshot.get("timings", {}).items()):
+        m = _metric_name(name) + "_seconds"
+        lines.append(f"# TYPE {m} summary")
+        lines.append(f"{m}_count{_fmt_labels(labels)} "
+                     f"{_num(t.get('count', 0))}")
+        lines.append(f"{m}_sum{_fmt_labels(labels)} "
+                     f"{_num(t.get('total', 0.0))}")
+        for stat in ("min", "max"):
+            if stat in t and t[stat] not in (float("inf"),):
+                g = m + "_" + stat
+                lines.append(f"# TYPE {g} gauge")
+                lines.append(f"{g}{_fmt_labels(labels)} {_num(t[stat])}")
+
+    for name, d in sorted(snapshot.get("dists", {}).items()):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} summary")
+        for qlabel, key in _QUANTILES:
+            if key in d:
+                lab = dict(labels, quantile=qlabel)
+                lines.append(f"{m}{_fmt_labels(lab)} {_num(d[key])}")
+        lines.append(f"{m}_count{_fmt_labels(labels)} "
+                     f"{_num(d.get('count', 0))}")
+        if "sum" in d:
+            lines.append(f"{m}_sum{_fmt_labels(labels)} "
+                         f"{_num(d['sum'])}")
+
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the exporter must never block a scrape behind a slow peer
+    timeout = 10
+    exporter: "MetricsExporter" = None   # class attr set per server
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/", "/metrics/"):
+            try:
+                body = self.exporter.render().encode("utf-8")
+            except Exception as e:   # a scrape bug must not kill serving
+                self.send_error(500, str(e)[:200])
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args) -> None:   # silence per-scrape spam
+        pass
+
+
+class MetricsExporter:
+    """Daemon-thread OpenMetrics endpoint over one Telemetry registry."""
+
+    def __init__(self, telemetry, port: int, host: str = "127.0.0.1",
+                 extra_labels: Optional[Dict[str, Any]] = None):
+        self.telemetry = telemetry
+        self.requested_port = int(port)
+        self.host = host
+        self.extra_labels = dict(extra_labels or {})
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def render(self) -> str:
+        tel = self.telemetry
+        labels = {"rank": tel.rank, "run_id": tel.run_id}
+        labels.update(self.extra_labels)
+        fleet = tel.fleet_counters() if tel.rank == 0 else None
+        # the events-free view: a scrape must not deep-copy the event
+        # rings under the registry lock (metrics_snapshot docstring)
+        return render_openmetrics(tel.metrics_snapshot(), labels, fleet)
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.port is None:
+            return None
+        return f"http://{self.host}:{self.port}/metrics"
+
+    # ------------------------------------------------------------------
+    def start(self) -> int:
+        """Bind and serve; returns the ACTUAL port.  A port in use
+        degrades to an ephemeral bind with a structured
+        ``metrics_exporter`` event — observability must never be the
+        reason a training run dies on a TCP race."""
+        if self._httpd is not None:
+            return self.port
+        fallback = False
+        try:
+            httpd = ThreadingHTTPServer((self.host, self.requested_port),
+                                        self._handler_class())
+        except OSError as e:
+            fallback = True
+            reason = f"{type(e).__name__}: {e}"
+            try:
+                httpd = ThreadingHTTPServer((self.host, 0),
+                                            self._handler_class())
+            except OSError as e2:   # no bindable port at all: degrade off
+                log.warning("metrics exporter could not bind %s:%d (%s) "
+                            "nor an ephemeral port (%s); exporter off",
+                            self.host, self.requested_port, e, e2)
+                self.telemetry.event(
+                    "metrics_exporter", port=None,
+                    requested_port=self.requested_port,
+                    fallback=True, error=f"{type(e2).__name__}: {e2}")
+                return -1
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = int(httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="lgbm-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        ev = {"port": self.port, "requested_port": self.requested_port,
+              "fallback": fallback}
+        if fallback:
+            ev["error"] = reason
+            log.warning("metrics port %d in use; exporter fell back to "
+                        "%s:%d", self.requested_port, self.host,
+                        self.port)
+        self.telemetry.event("metrics_exporter", **ev)
+        log.info("OpenMetrics endpoint: %s", self.url)
+        return self.port
+
+    def _handler_class(self):
+        # one handler subclass per exporter so concurrent exporters
+        # (training + serving in one process) don't share state
+        return type("_BoundHandler", (_Handler,), {"exporter": self})
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def scrape(url: str, timeout: float = 5.0) -> Tuple[str, str]:
+    """Convenience GET (tests, bench, obs_tail --scrape): returns
+    ``(content_type, body)``."""
+    from urllib.request import urlopen
+    with urlopen(url, timeout=timeout) as resp:
+        return (resp.headers.get("Content-Type", ""),
+                resp.read().decode("utf-8"))

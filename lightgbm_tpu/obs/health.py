@@ -117,13 +117,25 @@ class HealthAuditor:
                 "hash": model_state_hash(models, rank=tel.rank),
                 "sections": {k: float(v)
                              for k, v in (sections or {}).items()},
+                # piggybacked counter snapshot: rank 0's OpenMetrics
+                # exporter (obs/export.py) serves the fleet view off
+                # this payload, so live cross-rank metrics cost ZERO
+                # collectives beyond the audit that already runs
+                "counters": tel.counters_snapshot(),
             }
         except Exception as e:
             local = {"rank": tel.rank,
                      "hash": f"error:{type(e).__name__}",
-                     "sections": {}}
+                     "sections": {}, "counters": {}}
         per_rank: List[Dict[str, Any]] = allgather_json(local)
         dt = time.perf_counter() - t0
+        if tel.rank == 0:
+            # only rank 0's exporter serves the fleet view — storing
+            # the copies on every rank would be pure lock contention
+            tel.set_fleet_counters(
+                [{"rank": r.get("rank"),
+                  "counters": r.get("counters", {})}
+                 for r in per_rank])
         ok = len({r["hash"] for r in per_rank}) == 1
         tel.inc("health.checks")
         tel.event("health_check", iteration=it, ok=ok,

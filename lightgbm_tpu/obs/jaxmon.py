@@ -1,4 +1,4 @@
-"""jax.monitoring bridge + device memory stats.
+"""jax.monitoring bridge + per-device memory accounting.
 
 JAX reports compile phases through ``jax.monitoring`` duration events
 (``/jax/core/compile/jaxpr_trace_duration``,
@@ -7,19 +7,34 @@ A single process-wide listener is installed on first attach and fans the
 events out to every live, enabled :class:`Telemetry` — so per-booster
 registries see the compiles their iterations trigger (a recompile
 mid-training is exactly the kind of cliff PROFILE.md says one-off timing
-scripts keep missing).
+scripts keep missing).  Whatever identity kwargs the monitoring API
+passes (``fun_name`` on newer jax) ride along on the compile record.
+
+Memory accounting covers EVERY local device, not just device 0: a
+multi-chip host where one device's allocator is near its limit while
+device 0 idles is precisely the failure per-device gauges exist to
+show.  ``memory_watermarks`` snapshots ``bytes_in_use`` /
+``peak_bytes_in_use`` / ``bytes_limit`` into per-device registry gauges
+at the driver's natural sync points (megastep drain, serving dispatch)
+so the OpenMetrics exporter can expose live HBM headroom.
 """
 from __future__ import annotations
 
 import threading
 import weakref
-from typing import Optional
+from typing import Dict, Optional
 
 _COMPILE_PREFIX = "/jax/core/compile"
 
 _lock = threading.Lock()
 _installed = False
 _active: "weakref.WeakSet" = weakref.WeakSet()
+
+# backends whose devices report no allocator stats (CPU, interpret)
+# answer None once and are never re-queried: the watermark hook sits on
+# the serving dispatch path, where a per-batch jax.local_devices() walk
+# that can only ever return None is pure overhead
+_mem_unsupported = False
 
 
 def attach(tel) -> None:
@@ -42,29 +57,85 @@ def detach(tel) -> None:
         _active.discard(tel)
 
 
+# parameter names of compile_event / span that a monitoring kwarg must
+# never shadow — a colliding key would raise TypeError INSIDE jax's
+# compile path and kill the jit that triggered the listener
+_RESERVED_ATTRS = frozenset(
+    {"phase", "seconds", "name", "track", "iteration", "wall_start",
+     "event", "duration"})
+
+
 def _on_duration(event: str, duration: float, **kwargs) -> None:
     if not event.startswith(_COMPILE_PREFIX):
         return
     # short phase name: "backend_compile_duration" etc.
     phase = event.rsplit("/", 1)[-1]
+    # only plain scalar identity attrs survive — the record must stay
+    # JSON- and trace-serializable whatever jax adds to the callback
+    attrs = {k: v for k, v in kwargs.items()
+             if isinstance(v, (str, int, float, bool))
+             and k not in _RESERVED_ATTRS}
     for tel in list(_active):
         if tel.enabled:
-            tel.compile_event(phase, float(duration))
+            try:
+                tel.compile_event(phase, float(duration), **attrs)
+            except Exception:
+                # a telemetry bug must never propagate out of the
+                # monitoring listener into the XLA compile it observes
+                pass
 
 
-def device_memory_stats() -> Optional[dict]:
-    """Allocator stats of the first local device ({} keys vary by
-    backend; TPU/GPU report bytes_in_use etc., CPU returns None)."""
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_alloc_size")
+
+
+def device_memory_stats() -> Optional[Dict[int, dict]]:
+    """Allocator stats of EVERY local device, keyed by device id
+    (``{0: {"bytes_in_use": ..., ...}, 1: {...}}``).  Backends whose
+    devices report nothing (CPU, interpret) return None — cleanly, and
+    cached so repeated polling costs one attribute check."""
+    global _mem_unsupported
+    if _mem_unsupported:
+        return None
     try:
         import jax
-        ms = jax.local_devices()[0].memory_stats()
+        devices = jax.local_devices()
     except Exception:
         return None
-    if not ms:
+    out: Dict[int, dict] = {}
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        ent = {key: int(ms[key]) for key in _STAT_KEYS if key in ms}
+        if ent:
+            out[int(getattr(d, "id", len(out)))] = ent
+    if not out:
+        _mem_unsupported = True
         return None
-    out = {}
-    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-                "largest_alloc_size"):
-        if key in ms:
-            out[key] = int(ms[key])
-    return out or None
+    return out
+
+
+def memory_watermarks(tel, where: str = "") -> Optional[Dict[int, dict]]:
+    """Gauge every local device's live and peak allocator bytes into the
+    registry (``mem.d<id>.bytes_in_use`` / ``.peak_bytes_in_use`` /
+    ``.bytes_limit``) and count the observation under
+    ``mem.watermarks.<where>``.  Called at megastep drain and serving
+    dispatch boundaries — the two places the allocator's peak actually
+    moves — so the exporter's HBM-headroom gauges track the run live.
+    Returns the per-device stats (None where unsupported)."""
+    if tel is None or not tel.enabled:
+        return None
+    stats = device_memory_stats()
+    if not stats:
+        return None
+    for did, ent in stats.items():
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in ent:
+                tel.gauge(f"mem.d{did}.{key}", ent[key])
+    if where:
+        tel.inc("mem.watermarks." + where)
+    return stats

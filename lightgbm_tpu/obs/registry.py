@@ -29,6 +29,7 @@ summary.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -47,13 +48,29 @@ class Telemetry:
 
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
+        # stable per-registry run identity: the exporter stamps it on
+        # every metric series so scrapes from successive runs on the
+        # same port are distinguishable in a time-series store (the
+        # entropy tail keeps two registries born in the same second of
+        # the same process distinct)
+        self.run_id = (f"{int(time.time()):x}-{os.getpid():x}-"
+                       f"{os.urandom(2).hex()}")
         self._lock = threading.RLock()
+        # latest per-rank counter snapshots (fed by the health auditor's
+        # existing allgather — obs/export.py renders rank 0's fleet view
+        # from this, adding zero new collectives)
+        self._fleet: List[Dict[str, Any]] = []
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._timings: Dict[str, Dict[str, float]] = {}
         self._events = collections.deque(maxlen=_EVENT_RING)
         self._findings = collections.deque(maxlen=_FINDING_RING)
         self._dists: Dict[str, collections.deque] = {}
+        # cumulative [count, sum] per dist name: the ring bounds what
+        # the QUANTILES cover, but OpenMetrics summary _count/_sum must
+        # be monotone or Prometheus rate()/increase() breaks the moment
+        # the ring wraps (count pins at maxlen, sum wobbles on evictions)
+        self._dist_totals: Dict[str, List[float]] = {}
         self._records = collections.deque(maxlen=_RECORD_RING)
         self._spans = collections.deque(maxlen=_SPAN_RING)
         self._trace_on = False
@@ -181,17 +198,24 @@ class Telemetry:
             if d is None:
                 d = self._dists[name] = collections.deque(
                     maxlen=_DIST_RING)
+                self._dist_totals[name] = [0, 0.0]
             d.append(float(value))
+            tot = self._dist_totals[name]
+            tot[0] += 1
+            tot[1] += float(value)
 
     @staticmethod
-    def _dist_summary(samples) -> Dict[str, float]:
+    def _dist_summary(samples, totals=None) -> Dict[str, float]:
         vals = sorted(samples)
         n = len(vals)
 
         def q(p: float) -> float:
             return vals[min(n - 1, int(p * (n - 1) + 0.5))]
 
-        return {"count": n, "min": vals[0], "max": vals[-1],
+        count, total = (totals if totals is not None
+                        else (n, float(sum(vals))))
+        return {"count": int(count), "sum": float(total),
+                "min": vals[0], "max": vals[-1],
                 "p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
 
     def event(self, name: str, iteration: Optional[int] = None,
@@ -366,14 +390,20 @@ class Telemetry:
                       seconds or 0.0, track="collectives",
                       count=int(count), bytes=int(nbytes))
 
-    def compile_event(self, phase: str, seconds: float) -> None:
+    def compile_event(self, phase: str, seconds: float,
+                      **attrs: Any) -> None:
         """XLA compile phase (fed by obs.jaxmon); attributed to the open
-        iteration when one is active."""
+        iteration when one is active.  ``attrs`` carry whatever identity
+        jax.monitoring passed along (e.g. ``fun_name`` on newer jax) —
+        kept on the counters so the exporter can expose recompile
+        rates, not per-phase JSONL spam."""
         if not self.enabled:
             return
         with self._lock:
             self._counters["compile.events"] = \
                 self._counters.get("compile.events", 0) + 1
+            self._counters["compile.seconds"] = \
+                self._counters.get("compile.seconds", 0) + float(seconds)
             self._observe_locked("compile." + phase, seconds)
             if self._cur_iter is not None:
                 self._cur_compile["count"] += 1
@@ -383,7 +413,38 @@ class Telemetry:
             # span so it occupies its real window on the compile track
             now = self.wall_now()
             self.span("compile:" + phase, now - seconds, seconds,
-                      track="compile")
+                      track="compile", **attrs)
+
+    def compile_executable(self, signature: str, compile_ms: float,
+                           operand_bytes: int, **attrs: Any) -> None:
+        """Per-executable compile accounting: one structured event per
+        NEW jit signature (megastep chunk, serving bucket) carrying the
+        signature, the first-call wall time (trace + XLA compile) and an
+        estimate of the operand bytes the executable touches — the
+        record the exporter's recompile-rate and HBM-headroom story
+        hangs off (compiles are rare; the event volume is bounded by
+        the number of distinct signatures)."""
+        if not self.enabled:
+            return
+        self.inc("compile.executables")
+        self.inc("compile.operand_bytes", max(0, int(operand_bytes)))
+        self.event("compile_executable", signature=str(signature),
+                   compile_ms=round(float(compile_ms), 3),
+                   operand_bytes=int(operand_bytes), **attrs)
+
+    # ----------------------------------------------------- fleet counters
+    def set_fleet_counters(self, per_rank: List[Dict[str, Any]]) -> None:
+        """Store the newest per-rank counter snapshots (each entry
+        ``{"rank": r, "counters": {...}}``) — fed by the health
+        auditor's existing allgather so the metrics exporter's rank-0
+        fleet view costs zero additional collectives."""
+        with self._lock:
+            self._fleet = [dict(e) for e in per_rank
+                           if isinstance(e, dict)]
+
+    def fleet_counters(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._fleet]
 
     def end_iteration(self, it: int, **attrs: Any) -> Dict[str, Any]:
         """Close the iteration: emit its record (sections, collectives,
@@ -481,6 +542,33 @@ class Telemetry:
         return out
 
     # --------------------------------------------------------- snapshot
+    def counters_snapshot(self) -> Dict[str, float]:
+        """Counters alone — the cheap view the health auditor ships in
+        its allgather payload (snapshot() copies the whole event ring,
+        which a per-period collective should not)."""
+        with self._lock:
+            return dict(self._counters)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Counters/gauges/timings/dists WITHOUT the event rings — the
+        exporter's per-scrape view (obs/export.py).  A busy serving
+        process holds ~1500 event dicts in its rings; deep-copying them
+        under the registry lock on every 15-second Prometheus scrape
+        would contend with the batcher's hot-path ``event()`` calls for
+        data the exposition never renders.  Dist ``count``/``sum`` are
+        CUMULATIVE (monotone — what OpenMetrics summaries require);
+        quantiles/min/max cover the bounded recent-sample ring."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "rank": self.rank,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timings": {k: dict(v) for k, v in self._timings.items()},
+                "dists": {k: self._dist_summary(v, self._dist_totals[k])
+                          for k, v in self._dists.items() if v},
+            }
+
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time dict view: counters, gauges, timing
         distributions and the recent event ring (rank-local; the
@@ -492,7 +580,7 @@ class Telemetry:
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "timings": {k: dict(v) for k, v in self._timings.items()},
-                "dists": {k: self._dist_summary(v)
+                "dists": {k: self._dist_summary(v, self._dist_totals[k])
                           for k, v in self._dists.items() if v},
                 "events": [dict(e) for e in self._events],
                 "findings": [dict(e) for e in self._findings],

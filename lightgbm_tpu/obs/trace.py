@@ -27,7 +27,8 @@ import os
 from typing import Any, Dict, List
 
 # stable tid assignment so every rank's tracks line up in the viewer
-_TRACK_ORDER = ("train", "collectives", "compile", "health")
+# ("serve" carries the per-request spans of obs/reqtrace.py)
+_TRACK_ORDER = ("train", "collectives", "compile", "health", "serve")
 
 
 def chrome_trace_events(per_rank_spans: List[List[Dict[str, Any]]]

@@ -191,6 +191,16 @@ def train_distributed(params: Dict, data_path: str, num_processes: int,
         deadline = time.time() + timeout
         attempt = 0
         resume = ""
+        metrics_port = int(params.get("metrics_port", 0) or 0)
+        if metrics_port > 0:
+            # each rank's _setup_telemetry binds metrics_port + rank —
+            # say where the endpoints are so the operator does not have
+            # to derive the per-rank offsets from the docs
+            log.info(
+                "live OpenMetrics endpoints: %s (rank 0 also serves the "
+                "fleet counter view)",
+                ", ".join(f"http://127.0.0.1:{metrics_port + r}/metrics"
+                          for r in range(num_processes)))
         while True:
             coord = coordinator_address or f"127.0.0.1:{_free_port()}"
             procs, logs = _spawn_cohort(

@@ -31,12 +31,15 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from ..obs import reqtrace
+
 
 class _Request:
     __slots__ = ("model_id", "X", "rows", "cols", "future", "t_submit",
-                 "sparse")
+                 "sparse", "trace_id", "w_submit")
 
-    def __init__(self, model_id: str, X, rows: int, sparse: bool):
+    def __init__(self, model_id: str, X, rows: int, sparse: bool,
+                 wall_now: float):
         self.model_id = model_id
         self.X = X
         self.rows = rows
@@ -44,6 +47,13 @@ class _Request:
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.sparse = sparse
+        # request identity (obs/reqtrace.py): minted HERE, the moment
+        # the request exists — every downstream record (serve_access
+        # JSONL line, Perfetto serve-track span) quotes it, and the
+        # caller reads it back off future.trace_id
+        self.trace_id = reqtrace.mint_trace_id()
+        self.future.trace_id = self.trace_id
+        self.w_submit = wall_now
 
 
 def _resolve(future: Future, result=None, exc=None) -> None:
@@ -64,12 +74,14 @@ class MicroBatcher:
 
     def __init__(self, dispatch: Callable[[str, Any], np.ndarray],
                  max_batch_rows: int = 8192, max_delay_ms: float = 2.0,
-                 telemetry=None, batch_events: bool = True):
+                 telemetry=None, batch_events: bool = True,
+                 memory_watermarks: bool = True):
         self._dispatch = dispatch
         self.max_batch_rows = int(max_batch_rows)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.tel = telemetry
         self.batch_events = batch_events
+        self.memory_watermarks = bool(memory_watermarks)
         self._q: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -90,11 +102,14 @@ class MicroBatcher:
                 # request must raise in its own submit call, not poison
                 # the np.concatenate of a whole coalesced batch
                 X = X.astype(np.float64)
-        req = _Request(model_id, X, int(X.shape[0]), sparse)
+        wall = (self.tel.wall_now() if self.tel is not None
+                else time.time())
+        req = _Request(model_id, X, int(X.shape[0]), sparse, wall)
         with self._cv:
             if self._stop:
                 req.future.set_exception(
                     RuntimeError("MicroBatcher is closed"))
+                self._emit_failed(req, "MicroBatcherClosed")
                 return req.future
             self._q.append(req)
             depth = len(self._q)
@@ -160,6 +175,22 @@ class MicroBatcher:
                         break
             self._run_batch(first.model_id, batch, rows)
 
+    def _emit_failed(self, req: "_Request", error: str) -> None:
+        """serve_access for a request that never reached a dispatch
+        (submit-after-stop, close(drain=False)) — the exactly-one-
+        record-per-request contract covers the failure paths an
+        operator actually debugs."""
+        if self.tel is None:
+            return
+
+        def _go():
+            done_wall = self.tel.wall_now()
+            reqtrace.emit_access(
+                self.tel, req, {"error": error},
+                queue_ms=(time.perf_counter() - req.t_submit) * 1000.0,
+                batch_ms=0.0, done_wall=done_wall)
+        self._record(_go)
+
     def _record(self, fn, *args, **kwargs) -> None:
         """Telemetry from the worker thread must be best-effort: a
         failing sink (disk full under telemetry_out) would otherwise
@@ -180,20 +211,41 @@ class MicroBatcher:
                                             len(self._q)))
         t0 = time.perf_counter()
         wait_ms = (t0 - batch[0].t_submit) * 1000.0
+        # request-scoped batch context: the engine annotates bucket /
+        # dispatch wall / degradation from inside the dispatch without
+        # the batcher knowing its internals (obs/reqtrace.py)
+        reqtrace.begin_batch(model_id)
         try:
             X = batch[0].X if len(batch) == 1 else np.concatenate(
                 [r.X for r in batch], axis=0)
             out = self._dispatch(model_id, X)
             out = np.asarray(out)
         except Exception as exc:  # resolve, don't wedge
+            ctx = reqtrace.end_batch()
+            done_wall = (self.tel.wall_now() if self.tel is not None
+                         else time.time())
             for r in batch:
                 _resolve(r.future, exc=exc)
-            self._record(lambda: (
-                self.tel.inc("serve.batch_errors"),
+
+            def _error_telemetry():
+                self.tel.inc("serve.batch_errors")
                 self.tel.event("serve_batch_error", model_id=model_id,
-                               rows=rows, error=type(exc).__name__)))
+                               rows=rows, error=type(exc).__name__)
+                # the exactly-one-serve_access-per-request contract
+                # holds on the failure path too — a request that died
+                # must still be traceable by its trace_id
+                for r in batch:
+                    reqtrace.emit_access(
+                        self.tel, r, dict(ctx, error=type(exc).__name__),
+                        queue_ms=(t0 - r.t_submit) * 1000.0,
+                        batch_ms=(time.perf_counter() - t0) * 1000.0,
+                        done_wall=done_wall)
+            self._record(_error_telemetry)
             return
+        ctx = reqtrace.end_batch()
         done = time.perf_counter()
+        done_wall = (self.tel.wall_now() if self.tel is not None
+                     else time.time())
         c0 = 0
         for r in batch:
             _resolve(r.future, result=out[c0:c0 + r.rows])
@@ -202,14 +254,27 @@ class MicroBatcher:
         def _batch_telemetry():
             self.tel.inc("serve.batches")
             self.tel.dist("serve.batch_rows", rows)
+            batch_ms = (done - t0) * 1000.0
             for r in batch:
                 self.tel.dist("serve.latency_ms",
                               (done - r.t_submit) * 1000.0)
+                reqtrace.emit_access(
+                    self.tel, r, ctx,
+                    queue_ms=(t0 - r.t_submit) * 1000.0,
+                    batch_ms=batch_ms, done_wall=done_wall)
             if self.batch_events:
                 self.tel.event("serve_batch", model_id=model_id,
                                rows=rows, requests=len(batch),
                                wait_ms=round(wait_ms, 3),
-                               exec_ms=round((done - t0) * 1000.0, 3))
+                               exec_ms=round(batch_ms, 3),
+                               trace_ids=[r.trace_id for r in batch])
+            if self.memory_watermarks:
+                # serving dispatch boundary: the allocator peak just
+                # moved (or didn't) — refresh the per-device HBM gauges
+                # the exporter serves; cached no-op on stat-less
+                # backends
+                from ..obs.jaxmon import memory_watermarks
+                memory_watermarks(self.tel, where="serve")
 
         self._record(_batch_telemetry)
 
@@ -219,10 +284,14 @@ class MicroBatcher:
         queued first; ``drain=False`` fails queued requests."""
         with self._cv:
             self._stop = True
+            dropped = []
             if not drain:
                 while self._q:
                     r = self._q.popleft()
                     _resolve(r.future,
                              exc=RuntimeError("MicroBatcher closed"))
+                    dropped.append(r)
             self._cv.notify_all()
+        for r in dropped:
+            self._emit_failed(r, "MicroBatcherClosed")
         self._worker.join(timeout=30)

@@ -24,13 +24,16 @@ float32-representable inputs route bit-identically to the host walk.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ..models.predictor import (DevicePredictor, RawDevicePredictor,
                                 _round_up_pow2)
+from ..obs import reqtrace
 
 # process-wide registry of dispatched jit signatures: the deterministic
 # model of XLA's compile cache the serve counters are asserted against.
@@ -195,6 +198,7 @@ class ServingEngine:
         sig = self._signature(bucket)
         with _SIG_LOCK:
             fresh = sig not in _COMPILED_SIGS
+        t0 = time.perf_counter() if fresh else 0.0
         out = stacked_run_fn(self.pred.variant)(
             jnp.asarray(enc), *self._operands, k=self.k,
             max_steps=self.pred.max_steps)
@@ -203,6 +207,7 @@ class ServingEngine:
         # or the successful retry's real compile would count as a cache
         # hit and the zero-recompile gates would go blind to it
         if fresh:
+            compile_ms = (time.perf_counter() - t0) * 1000.0
             with _SIG_LOCK:
                 if sig in _COMPILED_SIGS:
                     fresh = False      # another thread won the compile
@@ -212,11 +217,28 @@ class ServingEngine:
                 with self._lock:
                     self.compiles += 1
                 self._inc("serve.compiles")
+                reqtrace.annotate(compiles=1)
+                # per-executable compile record: the jit cache key,
+                # the first-call wall (trace + XLA compile — the call
+                # blocks through compilation before dispatching async)
+                # and the bytes the executable's operands pin on device
+                sig_hash = hashlib.sha1(
+                    repr(sig).encode()).hexdigest()[:12]
+                op_bytes = self.packed_nbytes + int(enc.nbytes)
                 self._event("serve_compile", model_id=self.model_id,
-                            bucket=bucket, variant=self.pred.variant)
+                            bucket=bucket, variant=self.pred.variant,
+                            signature=sig_hash,
+                            compile_ms=round(compile_ms, 3),
+                            operand_bytes=op_bytes)
+                if self.tel is not None:
+                    self.tel.compile_executable(
+                        f"serve[{self.pred.variant},bucket={bucket},"
+                        f"sig={sig_hash}]", compile_ms, op_bytes,
+                        model_id=self.model_id)
         with self._lock:
             self.dispatches += 1
         self._inc("serve.dispatches")
+        reqtrace.annotate(dispatches=1, bucket=bucket)
         return out
 
     # ------------------------------------------------------------------
@@ -234,8 +256,15 @@ class ServingEngine:
             Xc = X[sl].toarray() if sparse_in else X[sl]
             rows = Xc.shape[0]
             bucket = self.bucket_for(rows)
+            t0 = time.perf_counter()
             raw = self._dispatch(self._encode_pad(Xc, bucket), bucket)
+            # np.asarray blocks on the device result, so this window is
+            # the honest dispatch+execute wall the serve_access record
+            # reports per request (summed across an oversized request's
+            # chunks)
             out[:, sl] = np.asarray(raw, np.float64)[:, :rows]
+            reqtrace.annotate(
+                dispatch_ms=(time.perf_counter() - t0) * 1000.0)
         return out
 
     def _host_predict_raw(self, X) -> np.ndarray:
@@ -243,12 +272,15 @@ class ServingEngine:
         host_walk_raw — the one shared implementation, with its bounded
         per-chunk sparse densify)."""
         from ..basic import host_walk_raw
+        t0 = time.perf_counter()
         out = host_walk_raw(self.booster.models, X, self.lo, self.hi,
                             self.k)
         n = X.shape[0]
         with self._lock:
             self.host_rows += n
         self._inc("serve.host_rows", n)
+        reqtrace.annotate(degraded=True,
+                          dispatch_ms=(time.perf_counter() - t0) * 1000.0)
         return out
 
     # ------------------------------------------------------------------

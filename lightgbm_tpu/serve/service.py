@@ -8,10 +8,12 @@ north star's "millions of users" half needs::
     svc = lgb.serve.PredictionService(
         {"churn": "churn_model.txt", "rank": rank_booster},
         max_batch_rows=8192, max_delay_ms=2.0,
-        device_budget_bytes=256 << 20, telemetry_out="serve.jsonl")
+        device_budget_bytes=256 << 20, telemetry_out="serve.jsonl",
+        metrics_port=9200,                # live OpenMetrics endpoint
+        trace_out="serve_trace.json")     # per-request Perfetto spans
     svc.warmup()                          # AOT-compile every bucket
     y = svc.predict("churn", X)           # sync (submit + wait)
-    fut = svc.submit("rank", X2)          # future form
+    fut = svc.submit("rank", X2)          # future form (.trace_id set)
     svc.stats()                           # latency p50/p95/p99, counters
     svc.close()
 
@@ -61,7 +63,10 @@ class PredictionService:
                  raw_score: bool = False,
                  num_iteration: Optional[int] = None,
                  telemetry_out: str = "",
-                 batch_events: bool = True):
+                 batch_events: bool = True,
+                 metrics_port: int = 0,
+                 trace_out: str = "",
+                 memory_watermarks: bool = True):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -75,6 +80,20 @@ class PredictionService:
         self.tel = Telemetry(enabled=True)
         if telemetry_out:
             self.tel.enable(telemetry_out)
+        # request-scoped Perfetto spans (serve track): trace_out turns
+        # span collection on; close() writes the timeline
+        self._trace_out = str(trace_out or "")
+        if self._trace_out:
+            self.tel.enable(trace=True)
+        # live OpenMetrics endpoint over the serving registry
+        # (obs/export.py; rank offset matters when a serving process
+        # rides inside a multi-rank job)
+        self._metrics = None
+        if int(metrics_port or 0) > 0:
+            from ..obs.export import MetricsExporter
+            self._metrics = MetricsExporter(
+                self.tel, int(metrics_port) + self.tel.rank)
+            self._metrics.start()
         self.residency = ResidencyManager(
             budget_bytes=device_budget_bytes, telemetry=self.tel,
             max_batch_rows=max_batch_rows,
@@ -85,7 +104,8 @@ class PredictionService:
         self.batcher = MicroBatcher(
             self._dispatch_batch, max_batch_rows=max_batch_rows,
             max_delay_ms=max_delay_ms, telemetry=self.tel,
-            batch_events=batch_events)
+            batch_events=batch_events,
+            memory_watermarks=memory_watermarks)
         self._closed = False
         self.tel.event("serve_start", models=list(specs),
                        max_batch_rows=int(max_batch_rows),
@@ -93,6 +113,12 @@ class PredictionService:
                        budget_bytes=device_budget_bytes)
 
     # ------------------------------------------------------------------
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """The live OpenMetrics endpoint (None when ``metrics_port``
+        was not set)."""
+        return None if self._metrics is None else self._metrics.url
+
     def _dispatch_batch(self, model_id: str, X) -> np.ndarray:
         return self.residency.get(model_id).predict(
             X, raw_score=self.raw_score)
@@ -102,7 +128,10 @@ class PredictionService:
         return self.residency.model_ids()
 
     def submit(self, model_id: str, X) -> Future:
-        """Future form: enqueue and return immediately."""
+        """Future form: enqueue and return immediately.  The returned
+        future carries ``future.trace_id`` — the request's identity in
+        every ``serve_access`` JSONL record and Perfetto serve-track
+        span (docs/Serving.md)."""
         if self._closed:
             raise RuntimeError("PredictionService is closed")
         model_id = str(model_id)
@@ -189,6 +218,19 @@ class PredictionService:
         final = self.stats()
         final.pop("residency", None)
         self.tel.event("serve_stats", **final)
+        if self._trace_out:
+            from ..obs import trace as trace_mod
+            from ..utils import log
+            try:
+                trace_mod.write_trace(self._trace_out,
+                                      [self.tel.drain_spans()])
+                log.info("serving trace written to %s", self._trace_out)
+            except Exception as e:   # close() must not raise over a dump
+                log.warning("serving trace export to %s failed: %s",
+                            self._trace_out, e)
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
         self.tel.close()
 
     def __enter__(self) -> "PredictionService":

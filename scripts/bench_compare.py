@@ -13,11 +13,13 @@ Usage:
     python scripts/bench_compare.py [--trajectory PATH] [--threshold 0.15]
                                     [--min-seconds 0.005] [--fail-on-regress]
 
-Besides the timing diffs, three DETERMINISTIC counters are gated when
-both records carry them: ``dispatches_per_iter`` (training fast-path
-eviction), ``dispatches_per_request`` and ``compiles_per_1k_requests``
-(serving bucketing/recompile regressions, bench.py --serve) — these
-flag structural losses even on runners too noisy for timing thresholds.
+Besides the timing diffs, the DETERMINISTIC counters are gated when
+both records carry them: ``dispatches_per_iter`` and its
+eval/checkpoint/observability-leg twins (training fast-path eviction,
+bench.py --micro), ``dispatches_per_request`` and
+``compiles_per_1k_requests`` (serving bucketing/recompile regressions,
+bench.py --serve) — these flag structural losses even on runners too
+noisy for timing thresholds.
 
 Prints one JSON report line; with ``--fail-on-regress`` exits 1 when any
 regression was flagged (the CI smoke gate). Fewer than two comparable
@@ -134,9 +136,13 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   checkpoints capture at drain boundaries off the dispatch path,
     #   so this must EQUAL dispatches_per_iter; drift means
     #   checkpointing started evicting the fast path.
+    # - obs_dispatches_per_iter (bench.py --micro observability leg):
+    #   the same training with the live OpenMetrics exporter serving
+    #   scrapes — the observability plane reads registry snapshots off
+    #   the device path, so this too must EQUAL dispatches_per_iter.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
-                 "ckpt_dispatches_per_iter",
+                 "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
                  "dispatches_per_request", "compiles_per_1k_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):

@@ -1,0 +1,201 @@
+"""Tail / summarize a telemetry JSONL stream without hand-parsing.
+
+The observability sinks are all JSONL (``telemetry_out`` event streams,
+``BENCH_TRAJECTORY.jsonl`` bench records); operators keep re-deriving
+the same jq incantations to answer "what happened".  This tool is the
+shared reader:
+
+    # one line per event, human-ordered fields
+    python scripts/obs_tail.py run.jsonl
+
+    # only what matters right now
+    python scripts/obs_tail.py run.jsonl --event anomaly,straggler
+    python scripts/obs_tail.py run.jsonl.rank1 --rank 1 --last 20
+
+    # per-event counts, iteration span, findings
+    python scripts/obs_tail.py run.jsonl --summary
+
+    # live: keep printing as the training run appends
+    python scripts/obs_tail.py run.jsonl --follow
+
+    # bench trajectory: dedup re-emitted records by run_id with the
+    # same last-wins reader bench_compare uses
+    python scripts/obs_tail.py BENCH_TRAJECTORY.jsonl --dedup-runs
+
+Corrupt lines are skipped with a note (a crashed writer must not make
+the stream unreadable), matching ``bench_compare.load_trajectory``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+_HEAD_KEYS = ("event", "iter", "rank")
+
+
+def _parse_lines(lines) -> Iterator[Dict[str, Any]]:
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"skipping corrupt line: {line[:80]}", file=sys.stderr)
+            continue
+        if isinstance(rec, dict):
+            yield rec
+
+
+def load_records(path: str, dedup_runs: bool = False
+                 ) -> List[Dict[str, Any]]:
+    if dedup_runs:
+        # the bench trajectory's reader already solves run_id dedup
+        # (each run may emit several progressively richer lines; the
+        # LAST one wins) — reuse it rather than fork the semantics
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_compare import load_trajectory
+        return load_trajectory(path)
+    with open(path) as fh:
+        return list(_parse_lines(fh))
+
+
+def _match(rec: Dict[str, Any], events: Optional[List[str]],
+           rank: Optional[int]) -> bool:
+    if events and str(rec.get("event", "")) not in events:
+        return False
+    if rank is not None and rec.get("rank") != rank:
+        return False
+    return True
+
+
+def format_record(rec: Dict[str, Any], t0: Optional[float] = None) -> str:
+    """One human line: relative timestamp, rank, event, then the
+    record's own fields in insertion order."""
+    parts = []
+    ts = rec.get("ts")
+    if isinstance(ts, (int, float)):
+        parts.append(f"+{ts - t0:9.3f}s" if t0 else
+                     time.strftime("%H:%M:%S", time.localtime(ts)))
+    for k in _HEAD_KEYS:
+        if k in rec:
+            parts.append(f"{k}={rec[k]}")
+    for k, v in rec.items():
+        if k in _HEAD_KEYS or k == "ts":
+            continue
+        if isinstance(v, float):
+            v = round(v, 4)
+        sv = json.dumps(v, separators=(",", ":"), default=str) \
+            if isinstance(v, (dict, list)) else str(v)
+        if len(sv) > 120:
+            sv = sv[:117] + "..."
+        parts.append(f"{k}={sv}")
+    return "  ".join(parts)
+
+
+def summarize(records: List[Dict[str, Any]]) -> str:
+    by_event: Dict[str, int] = {}
+    ranks = set()
+    iters: List[int] = []
+    findings: List[Dict[str, Any]] = []
+    for r in records:
+        by_event[str(r.get("event", "?"))] = \
+            by_event.get(str(r.get("event", "?")), 0) + 1
+        if "rank" in r:
+            ranks.add(r["rank"])
+        if isinstance(r.get("iter"), int):
+            iters.append(r["iter"])
+        if r.get("event") in ("anomaly", "rank_divergence", "straggler",
+                              "serve_batch_error", "recovery"):
+            findings.append(r)
+    lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
+    if iters:
+        lines.append(f"iterations: {min(iters)}..{max(iters)}")
+    lines.append("events:")
+    for name, n in sorted(by_event.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {name:<24} {n}")
+    if findings:
+        lines.append(f"findings ({len(findings)}):")
+        t0 = records[0].get("ts") if records else None
+        for f in findings[-20:]:
+            lines.append("  " + format_record(f, t0))
+    return "\n".join(lines)
+
+
+def follow(path: str, events: Optional[List[str]],
+           rank: Optional[int]) -> None:
+    """tail -f semantics: print matching records as the writer appends
+    (poll loop).  A readline() that races the writer mid-flush returns
+    a newline-less fragment — buffer it and re-read until the line
+    completes, so a large record split across flushes is parsed whole
+    instead of dropped as two corrupt halves."""
+    t0 = None
+    partial = ""
+    with open(path) as fh:
+        while True:
+            chunk = fh.readline()
+            if not chunk:
+                time.sleep(0.2)
+                continue
+            partial += chunk
+            if not partial.endswith("\n"):
+                continue       # mid-flush fragment: wait for the rest
+            line, partial = partial, ""
+            for rec in _parse_lines([line]):
+                if t0 is None and isinstance(rec.get("ts"), (int, float)):
+                    t0 = rec["ts"]
+                if _match(rec, events, rank):
+                    print(format_record(rec, t0), flush=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSONL file (or bench "
+                                 "trajectory with --dedup-runs)")
+    ap.add_argument("--event", default="",
+                    help="comma-separated event names to keep")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="keep only this rank's records")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the last N matching records")
+    ap.add_argument("--summary", action="store_true",
+                    help="per-event counts + findings instead of lines")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep reading as the file grows (Ctrl-C stops)")
+    ap.add_argument("--dedup-runs", action="store_true",
+                    help="dedup records by run_id (bench trajectory "
+                         "semantics, reusing bench_compare's reader)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw JSON lines instead of human format")
+    args = ap.parse_args(argv)
+
+    events = [e for e in args.event.split(",") if e] or None
+    if args.follow:
+        try:
+            follow(args.path, events, args.rank)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    records = load_records(args.path, dedup_runs=args.dedup_runs)
+    matched = [r for r in records if _match(r, events, args.rank)]
+    if args.last > 0:
+        matched = matched[-args.last:]
+    if args.summary:
+        print(summarize(matched))
+        return 0
+    t0 = None
+    for rec in matched:
+        if t0 is None and isinstance(rec.get("ts"), (int, float)):
+            t0 = rec["ts"]
+        print(json.dumps(rec, separators=(",", ":"), default=str)
+              if args.json else format_record(rec, t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

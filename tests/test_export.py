@@ -1,0 +1,488 @@
+"""Live observability plane (obs/export.py + obs/reqtrace.py).
+
+Tier-1 coverage of the OpenMetrics exporter (scrape-during-training,
+scrape-during-serving, full-registry coverage, port-in-use fallback,
+rank-distinct endpoints + rank-0 fleet aggregate under the two-process
+driver), the request-scoped serving traces (exactly one ``serve_access``
+record per request, trace_id threading into the Perfetto serve track),
+per-device memory accounting, and the obs_tail operator tool.
+"""
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import MetricsExporter, Telemetry
+from lightgbm_tpu.obs.export import (CONTENT_TYPE, _metric_name,
+                                     render_openmetrics)
+
+
+def _data(n=600, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    return X, y
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _scrape(port, path="/metrics", timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def _parse_exposition(body):
+    """Minimal OpenMetrics reader: {family: type} from # TYPE lines and
+    {sample_name+labels: value} from sample lines; asserts basic
+    well-formedness on the way."""
+    types, samples = {}, {}
+    lines = body.splitlines()
+    assert lines[-1] == "# EOF", "exposition must end with # EOF"
+    for line in lines[:-1]:
+        assert line, "no blank lines inside the exposition"
+        if line.startswith("# TYPE "):
+            _, _, fam, mtype = line.split(" ", 3)
+            types[fam] = mtype
+        elif not line.startswith("#"):
+            name_labels, value = line.rsplit(" ", 1)
+            samples[name_labels] = float(value)
+    return types, samples
+
+
+# ---------------------------------------------------------------- unit
+def test_render_openmetrics_unit():
+    tel = Telemetry(enabled=True)
+    tel.inc("serve.requests", 3)
+    tel.gauge("mem.d0.bytes_in_use", 12345)
+    tel.observe("section.boosting", 0.25)
+    for v in (1.0, 2.0, 100.0):
+        tel.dist("serve.latency_ms", v)
+    body = render_openmetrics(tel.snapshot(),
+                              {"rank": 0, "run_id": "r1"})
+    types, samples = _parse_exposition(body)
+    assert types["lgbm_serve_requests"] == "counter"
+    assert samples['lgbm_serve_requests_total{rank="0",run_id="r1"}'] == 3
+    assert types["lgbm_mem_d0_bytes_in_use"] == "gauge"
+    assert types["lgbm_section_boosting_seconds"] == "summary"
+    assert samples[
+        'lgbm_section_boosting_seconds_count{rank="0",run_id="r1"}'] == 1
+    assert types["lgbm_serve_latency_ms"] == "summary"
+    assert samples['lgbm_serve_latency_ms{quantile="0.5",rank="0",'
+                   'run_id="r1"}'] == 2.0
+    assert samples[
+        'lgbm_serve_latency_ms_count{rank="0",run_id="r1"}'] == 3
+    assert samples['lgbm_serve_latency_ms_sum{rank="0",run_id="r1"}'] \
+        == 103.0
+
+    # fleet entries render under the same family with their own rank
+    # label (and no run_id — the peers' run ids are not ours)
+    body = render_openmetrics(
+        tel.snapshot(), {"rank": 0, "run_id": "r1"},
+        fleet=[{"rank": 1, "counters": {"serve.requests": 7}},
+               {"rank": 0, "counters": {"serve.requests": 3}}])
+    _, samples = _parse_exposition(body)
+    assert samples['lgbm_serve_requests_total{rank="1"}'] == 7
+    # the local rank's own series stays the live one, not the stale
+    # allgathered copy
+    assert samples['lgbm_serve_requests_total{rank="0",run_id="r1"}'] == 3
+
+
+def test_render_sanitizes_names():
+    assert _metric_name("events.megastep") == "lgbm_events_megastep"
+    assert _metric_name("mem.d0.bytes_in_use") == \
+        "lgbm_mem_d0_bytes_in_use"
+    assert _metric_name("weird name-1!") == "lgbm_weird_name_1_"
+
+
+# ---------------------------------------------------- training scrapes
+def test_exporter_scrape_during_training(tmp_path):
+    port = _free_port()
+    X, y = _data()
+    mid = {}
+
+    def scrape_cb(env):
+        if env.iteration == 2 and not mid:
+            mid["ctype"], mid["body"] = _scrape(port)
+
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "metrics_port": port,
+                     "telemetry_out": str(tmp_path / "t.jsonl")},
+                    lgb.Dataset(X, label=y), num_boost_round=5,
+                    callbacks=[scrape_cb])
+    try:
+        # the mid-run scrape answered with valid, live OpenMetrics
+        assert mid, "callback never scraped"
+        assert mid["ctype"] == CONTENT_TYPE
+        types, samples = _parse_exposition(mid["body"])
+        assert types["lgbm_iterations"] == "counter"
+
+        # post-train the endpoint is still live and the exposition
+        # carries EVERY registry counter, gauge, timing and dist with
+        # the rank/run_id labels
+        _, body = _scrape(port)
+        _parse_exposition(body)
+        snap = bst.telemetry()
+        labels = f'rank="0",run_id="{bst._gbdt.telemetry.run_id}"'
+        for name, v in snap["counters"].items():
+            line = f"{_metric_name(name)}_total{{{labels}}}"
+            assert any(l.startswith(line) for l in body.splitlines()), \
+                f"counter {name} missing from exposition"
+        for name in snap["gauges"]:
+            assert f"{_metric_name(name)}{{{labels}}}" in body, \
+                f"gauge {name} missing"
+        for name in snap["timings"]:
+            assert f"{_metric_name(name)}_seconds_count{{{labels}}}" \
+                in body, f"timing {name} missing"
+        for name in snap["dists"]:
+            assert f'{_metric_name(name)}{{quantile="0.5",{labels}}}' \
+                in body, f"dist {name} missing"
+        # the scraped counter values agree with the registry snapshot
+        _, samples = _parse_exposition(body)
+        assert samples[f"lgbm_iterations_total{{{labels}}}"] == \
+            snap["counters"]["iterations"]
+        # a structured metrics_exporter event recorded the bind
+        evs = [e for e in snap["events"]
+               if e["event"] == "metrics_exporter"]
+        assert evs and evs[0]["port"] == port \
+            and evs[0]["fallback"] is False
+        # liveness endpoint answers too
+        ctype, ok = _scrape(port, "/healthz")
+        assert ok == "ok\n"
+    finally:
+        bst._gbdt._metrics.stop()
+
+
+def test_exporter_port_in_use_falls_back(tmp_path):
+    port = _free_port()
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", port))
+    blocker.listen(1)
+    try:
+        X, y = _data(n=300)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1, "metrics_port": port},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        try:
+            exp = bst._gbdt._metrics
+            # training survived, the exporter fell back to an ephemeral
+            # port and said so with a structured event
+            assert exp.port is not None and exp.port != port
+            evs = [e for e in bst.telemetry()["events"]
+                   if e["event"] == "metrics_exporter"]
+            assert evs and evs[0]["fallback"] is True \
+                and evs[0]["requested_port"] == port \
+                and evs[0]["port"] == exp.port
+            _, body = _scrape(exp.port)
+            _parse_exposition(body)
+        finally:
+            bst._gbdt._metrics.stop()
+    finally:
+        blocker.close()
+
+
+def test_exporter_lifecycle_on_reset(tmp_path):
+    """reset_parameter clearing metrics_port stops the endpoint; an
+    unchanged port keeps the same running server."""
+    port = _free_port()
+    X, y = _data(n=300)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "metrics_port": port},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    exp = bst._gbdt._metrics
+    assert exp is not None and exp.port == port
+    bst.reset_parameter({"metrics_port": port, "learning_rate": 0.05})
+    assert bst._gbdt._metrics is exp        # same server kept
+    bst.reset_parameter({"metrics_port": 0})
+    assert bst._gbdt._metrics is None
+    with pytest.raises(Exception):
+        _scrape(port, timeout=2)
+
+
+# ----------------------------------------------------- serving traces
+def test_serve_access_records_and_trace_spans(tmp_path):
+    from lightgbm_tpu.serve import PredictionService
+    X, y = _data(n=500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "max_bin": 63},
+                    lgb.Dataset(X, label=y,
+                                params={"max_bin": 63, "verbose": -1}),
+                    num_boost_round=10)
+    port = _free_port()
+    tel_path = tmp_path / "serve.jsonl"
+    trace_path = tmp_path / "serve_trace.json"
+    rng = np.random.RandomState(7)
+    svc = PredictionService(
+        {"m": bst}, max_batch_rows=256, min_bucket_rows=16,
+        max_delay_ms=1.0, telemetry_out=str(tel_path),
+        trace_out=str(trace_path), metrics_port=port)
+    svc.warmup()
+    sizes = [1, 3, 17, 120, 256, 5]
+    futs = [svc.submit("m", rng.rand(s, X.shape[1]).astype(np.float32))
+            for s in sizes]
+    # every future carries its minted trace id
+    tids = [f.trace_id for f in futs]
+    assert len(set(tids)) == len(tids)
+    assert all(len(t) == 16 for t in tids)
+    for f in futs:
+        f.result(timeout=120)
+
+    # live scrape while the service is up: registry dists exposed as
+    # summaries with quantiles, request counter correct
+    ctype, body = _scrape(port)
+    assert ctype == CONTENT_TYPE
+    types, samples = _parse_exposition(body)
+    labels = f'rank="0",run_id="{svc.tel.run_id}"'
+    assert samples[f"lgbm_serve_requests_total{{{labels}}}"] == \
+        len(sizes)
+    assert types["lgbm_serve_latency_ms"] == "summary"
+    assert f'lgbm_serve_latency_ms{{quantile="0.5",{labels}}}' in body
+    svc.close()
+    assert svc.metrics_url is None           # closed: exporter stopped
+
+    recs = [json.loads(line) for line in open(tel_path)]
+    access = [r for r in recs if r["event"] == "serve_access"]
+    # exactly ONE serve_access per request, schema complete
+    assert sorted(r["trace_id"] for r in access) == sorted(tids)
+    for r in access:
+        assert r["model_id"] == "m"
+        assert r["rows"] in sizes
+        for key in ("queue_ms", "batch_ms", "dispatch_ms"):
+            assert isinstance(r[key], (int, float)) and r[key] >= 0.0
+        assert r["degraded"] is False
+        assert isinstance(r["bucket"], int) and r["bucket"] >= 16
+
+    # Perfetto: one serve-track span per request, trace_id matching its
+    # serve_access record
+    doc = json.load(open(trace_path))
+    spans = [e for e in doc["traceEvents"]
+             if e.get("cat") == "serve" and e.get("ph") == "X"]
+    assert sorted(e["args"]["trace_id"] for e in spans) == sorted(tids)
+    by_tid = {r["trace_id"]: r for r in access}
+    for e in spans:
+        rec = by_tid[e["args"]["trace_id"]]
+        assert e["args"]["rows"] == rec["rows"]
+        assert e["args"]["bucket"] == rec["bucket"]
+        # the span covers at least the queue wait
+        assert e["dur"] >= rec["queue_ms"] * 1000.0 * 0.5
+
+
+def test_serve_access_on_degraded_host_walk(tmp_path):
+    """A model the device path cannot represent (linear_tree) still
+    yields its serve_access record — flagged degraded."""
+    from lightgbm_tpu.serve import PredictionService
+    X, y = _data(n=400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    tel_path = tmp_path / "serve.jsonl"
+    svc = PredictionService({"m": bst}, telemetry_out=str(tel_path))
+    fut = svc.submit("m", X[:5])
+    fut.result(timeout=120)
+    svc.close()
+    recs = [json.loads(line) for line in open(tel_path)]
+    access = [r for r in recs if r["event"] == "serve_access"]
+    assert len(access) == 1
+    assert access[0]["trace_id"] == fut.trace_id
+    assert access[0]["degraded"] is True
+    assert access[0]["bucket"] is None
+
+
+def test_serve_access_on_closed_batcher():
+    """Even a request rejected at submit (batcher already stopped)
+    yields its serve_access record — the exactly-one-per-request
+    contract covers the failure paths an operator debugs."""
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    tel = Telemetry(enabled=True)
+    b = MicroBatcher(lambda m, X: np.zeros((1, X.shape[0])),
+                     telemetry=tel)
+    b.close()
+    fut = b.submit("m", np.zeros((2, 3), np.float32))
+    assert isinstance(fut.exception(timeout=5), RuntimeError)
+    acc = [e for e in tel.snapshot()["events"]
+           if e["event"] == "serve_access"]
+    assert len(acc) == 1
+    assert acc[0]["trace_id"] == fut.trace_id
+    assert acc[0]["error"] == "MicroBatcherClosed"
+
+
+# --------------------------------------------- per-device memory stats
+def test_device_memory_stats_cpu_degrades_to_none():
+    from lightgbm_tpu.obs.jaxmon import (device_memory_stats,
+                                         memory_watermarks)
+    stats = device_memory_stats()
+    # CPU backends report no allocator stats → clean None; on a real
+    # accelerator the contract is per-device keyed dicts
+    if stats is not None:
+        assert all(isinstance(k, int) for k in stats)
+        assert all("bytes_in_use" in v for v in stats.values())
+    tel = Telemetry(enabled=True)
+    out = memory_watermarks(tel, where="drain")
+    if out is None:
+        assert not any(k.startswith("mem.d")
+                       for k in tel.snapshot()["gauges"])
+    else:
+        gauges = tel.snapshot()["gauges"]
+        assert any(k.startswith("mem.d") and k.endswith("bytes_in_use")
+                   for k in gauges)
+        assert tel.snapshot()["counters"]["mem.watermarks.drain"] == 1
+
+
+# ------------------------------------------------------------ obs_tail
+def _load_obs_tail():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "obs_tail.py")
+    spec = importlib.util.spec_from_file_location("obs_tail", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_tail_summary_and_filter(tmp_path, capsys):
+    out = tmp_path / "t.jsonl"
+    X, y = _data(n=300)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "telemetry_out": str(out)},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    obs_tail = _load_obs_tail()
+    assert obs_tail.main([str(out), "--summary"]) == 0
+    text = capsys.readouterr().out
+    assert "iteration" in text and "records:" in text
+
+    assert obs_tail.main([str(out), "--event", "iteration",
+                          "--rank", "0", "--last", "2"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 2
+    assert all("event=iteration" in l for l in lines)
+
+    # corrupt lines are skipped, not fatal
+    with open(out, "a") as fh:
+        fh.write("{not json\n")
+    assert obs_tail.main([str(out), "--summary"]) == 0
+
+
+def test_obs_tail_dedups_bench_runs(tmp_path, capsys):
+    traj = tmp_path / "traj.jsonl"
+    with open(traj, "w") as fh:
+        fh.write(json.dumps({"run_id": "a", "value": 1.0,
+                             "event": "bench"}) + "\n")
+        fh.write(json.dumps({"run_id": "a", "value": 2.0,
+                             "event": "bench"}) + "\n")
+        fh.write(json.dumps({"run_id": "b", "value": 3.0,
+                             "event": "bench"}) + "\n")
+    obs_tail = _load_obs_tail()
+    recs = obs_tail.load_records(str(traj), dedup_runs=True)
+    # last-wins per run_id, bench_compare semantics
+    assert [r["value"] for r in recs] == [2.0, 3.0]
+
+
+# ------------------------------------------------- two-process cohort
+_MP_WORKER = textwrap.dedent("""
+    import json, os, sys, urllib.request
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=sys.argv[1],
+        num_processes=int(sys.argv[2]), process_id=int(sys.argv[3]))
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    path, base_port, out_path = sys.argv[4], int(sys.argv[5]), sys.argv[6]
+    rank = jax.process_index()
+    result = {"rank": rank}
+
+    def scrape(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            return r.read().decode()
+
+    def cb(env):
+        # after iteration 3 both health checks ((it+1) % 2) have run,
+        # so rank 0's fleet view is populated; scrape OWN endpoint live
+        if env.iteration == 3 and "self_body" not in result:
+            result["self_body"] = scrape(base_port + rank)
+
+    ds = lgb.Dataset(path, params={"label_column": 0, "verbose": -1,
+                                   "max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.2, "tree_learner": "data",
+                     "verbose": -1, "metrics_port": base_port,
+                     "health_check_period": 2},
+                    ds, num_boost_round=5, callbacks=[cb])
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+""")
+
+
+def test_multiproc_rank_endpoints_and_fleet_aggregate(tmp_path):
+    """Two-process driver: rank r serves metrics_port + r, every rank's
+    exposition is self-labelled, and rank 0's endpoint additionally
+    carries the fleet counter series (rank=\"1\" labels) fed by the
+    health auditor's existing allgather."""
+    rng = np.random.RandomState(5)
+    n, F = 2000, 6
+    X = rng.rand(n, F)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+    coord_port = _free_port()
+    base_port = _free_port()
+    if base_port + 1 == coord_port:
+        base_port = _free_port()
+    coord = f"127.0.0.1:{coord_port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_MP_WORKER)
+    outs = [tmp_path / f"rank{i}.json" for i in range(2)]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, "2", str(i), str(train),
+         str(base_port), str(outs[i])],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err.decode()[-3000:]
+
+    bodies = {}
+    for rank in range(2):
+        res = json.loads(outs[rank].read_text())
+        assert res["rank"] == rank
+        body = res["self_body"]
+        bodies[rank] = body
+        types, samples = _parse_exposition(body)
+        assert types["lgbm_iterations"] == "counter"
+        # self series carries the scraping rank's own label
+        own = [k for k in samples
+               if k.startswith("lgbm_iterations_total")
+               and f'rank="{rank}"' in k]
+        assert own, f"rank {rank} exposition lacks its own series"
+        # the health collectives were counted on both ranks
+        assert any(k.startswith("lgbm_health_checks_total")
+                   for k in samples)
+    # rank 0 aggregates the fleet: a rank="1" counter series without
+    # run_id (the peer's counters arrived via the audit allgather)
+    _, samples0 = _parse_exposition(bodies[0])
+    assert 'lgbm_iterations_total{rank="1"}' in samples0, \
+        sorted(k for k in samples0 if "iterations_total" in k)
+    # rank 1 serves only itself (no fleet series for rank 0)
+    _, samples1 = _parse_exposition(bodies[1])
+    assert 'lgbm_iterations_total{rank="0"}' not in samples1
