@@ -13,7 +13,7 @@ from .config import Config
 from .engine import CVBooster, cv, train
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import LightGBMError, register_logger
-from . import serve
+from . import ingest, serve
 from .serve import PredictionService
 
 try:  # plotting needs matplotlib (optional)
@@ -33,4 +33,5 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "record_telemetry", "reset_parameter", "EarlyStopException",
     "register_logger", "LightGBMError", "serve", "PredictionService",
+    "ingest",
 ] + _PLOT

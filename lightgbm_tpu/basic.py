@@ -9,7 +9,7 @@ model IO, continued training) is preserved.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence as _TSeq, Union
+from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -112,6 +112,39 @@ def pred_trees_stale(pred, booster) -> bool:
     return getattr(pred, "model_version", -1) != booster._model_version
 
 
+def _mappers_match(ref_inner, inner) -> bool:
+    """Do two constructed datasets bin identically?  Identical mapper
+    list objects short-circuit (streamed-with-reference builds, a
+    dataset referencing itself); otherwise compare the full mapper
+    digests.  The ONE alignment predicate both cache-acceptance paths
+    (explicit .bin refusal, auto-sidecar miss) share."""
+    if ref_inner.mappers is inner.mappers:
+        return True
+    from .binning import mappers_digest
+    return mappers_digest(ref_inner.mappers) == mappers_digest(
+        inner.mappers)
+
+
+def _cohort_votes(flag: bool):
+    """Allgather a boolean vote -> (any_true, all_true).  Cache hit/miss
+    decisions must be cohort-consistent under multi-process loading:
+    the rebuild path enters the binning-sample allgather, so a split
+    vote (one rank's shard valid, another's missing/corrupt) would
+    leave the hitting ranks outside a collective their peers are
+    blocked in — every rank sees the split and can act on it."""
+    import jax
+    if jax.process_count() <= 1:
+        return flag, flag
+    from jax.experimental import multihost_utils
+    votes = np.asarray(multihost_utils.process_allgather(
+        np.array([1 if flag else 0], np.int32)))
+    return bool(votes.max() == 1), bool(votes.min() == 1)
+
+
+def _cohort_all_agree(flag: bool) -> bool:
+    return _cohort_votes(flag)[1]
+
+
 def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sp
@@ -169,70 +202,15 @@ class Dataset:
                 and all(isinstance(x, Sequence) for x in self.data)):
             # chunked out-of-core assembly (ref: Sequence streaming push)
             self.data = _materialize_sequences(self.data)
+        pending_cache = None
         if isinstance(self.data, (str, os.PathLike)):
-            # binary-cache files short-circuit the text loader entirely
-            # (ref: dataset_loader.cpp:336 LoadFromBinFile — the cache
-            # magic is checked before any parsing)
-            with open(self.data, "rb") as _fh:
-                if _fh.read(8) == b"LGBMTPU1":
-                    self._inner = TpuDataset.load_binary(str(self.data))
-                    # explicitly-passed metadata overrides the cached
-                    # copy (the reference's LoadFromBinFile + SetField
-                    # sequence behaves the same way)
-                    if self.label is not None:
-                        self._inner.metadata.set_label(
-                            np.asarray(self.label))
-                    elif self._inner.metadata is not None:
-                        self.label = self._inner.metadata.label
-                    if self.weight is not None:
-                        self._inner.metadata.set_weight(
-                            np.asarray(self.weight))
-                    if self.group is not None:
-                        self._inner.metadata.set_group(
-                            np.asarray(self.group, np.int64))
-                    if self.init_score is not None:
-                        self._inner.metadata.set_init_score(
-                            np.asarray(self.init_score))
-                    return self
-            # file-based ingestion (ref: DatasetLoader::LoadFromFile).
-            # Multi-process: each rank reads its contiguous row slice
-            # unless pre_partition says the file already IS this rank's
-            # partition (ref: dataset_loader.cpp:203 LoadFromFile(rank,
-            # num_machines) + config.h pre_partition)
-            from .io.file_loader import load_text_file
-            import jax as _jax
-            rank, nm = 0, 1
-            if _jax.process_count() > 1 and not bool(cfg.pre_partition):
-                rank, nm = _jax.process_index(), _jax.process_count()
-            X, y, side = load_text_file(
-                str(self.data), label_column=self.params.get("label_column"),
-                rank=rank, num_machines=nm)
-            self.data = X
-            if self.label is None and y is not None:
-                self.label = y
-            if self.weight is None and "weight" in side:
-                self.weight = side["weight"]
-            if self.group is None and "group" in side:
-                self.group = side["group"]
-            if self.init_score is None and "init_score" in side:
-                self.init_score = side["init_score"]
+            if self._construct_from_file(cfg):
+                return self
+            pending_cache = self._pending_cache_write
+            self._pending_cache_write = None
         is_sparse = _is_scipy_sparse(self.data)
         data = self.data if is_sparse else _to_2d_numpy(self.data)
-        feature_names = None
-        if self.feature_name != "auto" and self.feature_name is not None:
-            feature_names = list(self.feature_name)
-        elif hasattr(self.data, "columns"):
-            feature_names = [str(c) for c in self.data.columns]
-        cats: _TSeq[int] = ()
-        if self.categorical_feature != "auto" \
-                and self.categorical_feature is not None:
-            cats = []
-            for c in self.categorical_feature:
-                if isinstance(c, str):
-                    if feature_names and c in feature_names:
-                        cats.append(feature_names.index(c))
-                else:
-                    cats.append(int(c))
+        cats, feature_names = self._resolve_cats_names(self.data)
         ref_inner = None
         if self.reference is not None:
             ref_inner = self.reference.construct()._inner
@@ -270,7 +248,271 @@ class Dataset:
         if self.free_raw_data:
             # keep raw features for prediction-time use only if small
             pass
+        if pending_cache is not None:
+            self._write_sidecar_cache(*pending_cache)
         return self
+
+    # ------------------------------------------------------------------
+    def _apply_explicit_metadata(self) -> None:
+        """Explicitly-passed metadata overrides a cache/stream-loaded
+        copy (the reference's LoadFromBinFile + SetField sequence
+        behaves the same way); absent overrides adopt the loaded
+        values onto the facade attributes."""
+        # a cache used as validation data must share its reference's
+        # bin mappers (it was built with reference= at save time, or it
+        # is the train cache itself) — anything else would route eval
+        # rows through foreign bins silently (the reference's
+        # CheckAlign contract)
+        if self.reference is not None:
+            if not _mappers_match(self.reference.construct()._inner,
+                                  self._inner):
+                raise LightGBMError(
+                    "cached dataset was binned with different mappers "
+                    "than its reference dataset; rebuild the cache from "
+                    "text with reference= the training data")
+        elif getattr(self._inner, "reference_binned", False):
+            # a validation cache carries ANOTHER dataset's mappers —
+            # training on it standalone would bin against foreign
+            # boundaries silently
+            raise LightGBMError(
+                "this dataset cache was binned against a reference "
+                "(validation) dataset; pass reference= the training "
+                "data, or rebuild the cache from text standalone")
+        # the cache round-trips the binning-defining params (like the
+        # reference's .bin): a booster built on the reloaded dataset
+        # resolves the SAME values the original build used — explicit
+        # user params still win
+        for k, v in (getattr(self._inner, "dataset_params", None)
+                     or {}).items():
+            self.params.setdefault(k, v)
+        md = self._inner.metadata
+        if self.label is not None:
+            md.set_label(np.asarray(self.label))
+        elif md is not None:
+            self.label = md.label
+        if self.weight is not None:
+            md.set_weight(np.asarray(self.weight))
+        elif md.weight is not None:
+            self.weight = md.weight
+        if self.group is not None:
+            md.set_group(np.asarray(self.group, np.int64))
+        if self.init_score is not None:
+            md.set_init_score(np.asarray(self.init_score))
+        elif md.init_score is not None:
+            self.init_score = md.init_score
+
+    def _resolve_cats_names(self, columns_source=None):
+        """(categorical index list, feature names or None) — the ONE
+        resolution of user feature names / string categoricals, shared
+        by the generic construct tail (``columns_source`` supplies
+        pandas column names) and the streamed file build (which needs
+        them BEFORE mapper construction)."""
+        feature_names = None
+        if self.feature_name != "auto" and self.feature_name is not None:
+            feature_names = list(self.feature_name)
+        elif columns_source is not None \
+                and hasattr(columns_source, "columns"):
+            feature_names = [str(c) for c in columns_source.columns]
+        cats = []
+        if self.categorical_feature != "auto" \
+                and self.categorical_feature is not None:
+            for c in self.categorical_feature:
+                if isinstance(c, str):
+                    if feature_names and c in feature_names:
+                        cats.append(feature_names.index(c))
+                else:
+                    cats.append(int(c))
+        return cats, feature_names
+
+    def _construct_from_file(self, cfg) -> bool:
+        """File-based construction routing (ref:
+        DatasetLoader::LoadFromFile / LoadFromBinFile).  Returns True
+        when ``_inner`` is fully built (binary-cache hit or streamed
+        chunked ingest); False to fall through to the monolithic tail
+        with ``self.data`` holding the parsed shard.  Multi-process:
+        each rank reads its contiguous row slice unless pre_partition
+        says the file already IS this rank's partition
+        (ref: dataset_loader.cpp:203 + config.h pre_partition)."""
+        import jax as _jax
+
+        from .ingest.cache import (CACHE_MAGIC, CacheError,
+                                   cache_shard_path, load_dataset_cache,
+                                   read_manifest, source_fingerprint)
+        from .ingest.pipeline import (dataset_params_digest,
+                                      ingest_text_streamed,
+                                      streaming_eligible)
+        self._pending_cache_write = None
+        path = str(self.data)
+        rank, nm = 0, 1
+        if _jax.process_count() > 1 and not bool(cfg.pre_partition):
+            rank, nm = _jax.process_index(), _jax.process_count()
+
+        def _magic(p):
+            try:
+                with open(p, "rb") as fh:
+                    return fh.read(8)
+            except OSError:
+                return b""
+
+        # ---- explicit binary-cache input short-circuits the text
+        # loader entirely (the cache magic is checked before any
+        # parsing). Multi-process ranks resolve their own shard file
+        # (<path>.rank<r>of<w>) first; the take-the-cache decision must
+        # be UNANIMOUS across the cohort — a rank whose shard is
+        # missing would fall through to the text path and block in a
+        # binning-sample collective its cache-hitting peers never join
+        shard = cache_shard_path(path, rank, nm)
+        head = _magic(path)
+        local_cache = None
+        if nm > 1 and _magic(shard) == CACHE_MAGIC:
+            local_cache = shard
+        elif head in (CACHE_MAGIC, b"LGBMTPU1"):
+            local_cache = path
+        if nm > 1:
+            any_hit, all_hit = _cohort_votes(local_cache is not None)
+            if any_hit and not all_hit:
+                # EVERY rank raises (both sides see the split), so the
+                # cohort fails together instead of hanging
+                raise CacheError(
+                    f"binary cache shards for {path} exist on some "
+                    "ranks only — rebuild every rank's shard "
+                    "(save_binary under the current launcher layout) "
+                    "or point data= at the text source")
+            if not all_hit:
+                local_cache = None
+        if local_cache is not None:
+            if _magic(local_cache) == b"LGBMTPU1":   # legacy v1 pickle
+                self._inner = TpuDataset.load_binary(local_cache)
+            else:
+                self._inner = load_dataset_cache(
+                    local_cache, expect_rank=rank, expect_world=nm)
+            self._apply_explicit_metadata()
+            return True
+
+        # ---- auto-maintained sidecar cache (save_binary=true): hit
+        # only when the source fingerprint (size/mtime/dataset params),
+        # rank layout AND binning provenance (standalone vs
+        # reference-binned) still match — anything else rebuilds.
+        # Multi-process: the hit/miss decision must be COHORT-WIDE —
+        # the rebuild path joins the binning-sample allgather, so one
+        # rank hitting while another rebuilds would deadlock the
+        # collective; every rank reaches the agreement allgather below
+        # whether or not its own shard file exists.
+        cats, feature_names = self._resolve_cats_names()
+        auto_cache = None
+        if bool(cfg.save_binary):
+            auto_cache = cache_shard_path(path + ".bin", rank, nm)
+            loaded = None
+            if os.path.exists(auto_cache):
+                try:
+                    manifest = read_manifest(auto_cache)
+                    cur = source_fingerprint(
+                        path, dataset_params_digest(cfg, cats))
+                    if manifest.get("source") == cur \
+                            and int(manifest.get("world", 1)) == nm \
+                            and bool(manifest.get("reference_binned",
+                                                  False)) \
+                            == (self.reference is not None):
+                        # full load INCLUDING hash verification here, so
+                        # a corrupt-bins shard counts as a miss at the
+                        # agreement point instead of crashing post-vote
+                        loaded = load_dataset_cache(
+                            auto_cache, expect_rank=rank,
+                            expect_world=nm)
+                    else:
+                        log.info("binary cache %s is stale (source, "
+                                 "params, layout or provenance "
+                                 "changed); rebuilding", auto_cache)
+                except CacheError as e:
+                    log.warning("ignoring unusable binary cache: %s", e)
+            if loaded is not None and self.reference is not None:
+                # an auto (validation) sidecar whose reference dataset
+                # was itself rebuilt carries outdated mappers: on this
+                # best-effort path that is a MISS to rebuild, not the
+                # hard error the explicitly-passed-cache path raises
+                if not _mappers_match(self.reference.construct()._inner,
+                                      loaded):
+                    log.info("binary cache %s no longer matches its "
+                             "reference dataset's mappers; rebuilding",
+                             auto_cache)
+                    loaded = None
+            hit = loaded is not None
+            if nm > 1:
+                hit = _cohort_all_agree(hit)
+            if hit:
+                self._inner = loaded
+                self._apply_explicit_metadata()
+                return True
+
+        eligible, _reason = streaming_eligible(cfg, path)
+        if eligible:
+            ref_inner = None
+            if self.reference is not None:
+                ref_inner = self.reference.construct()._inner
+            def _stream(cache_to):
+                return ingest_text_streamed(
+                    path, cfg,
+                    label_column=self.params.get("label_column"),
+                    rank=rank, num_machines=nm,
+                    categorical_feature=cats,
+                    feature_names=feature_names, reference=ref_inner,
+                    cache_out=cache_to, world=nm)
+            try:
+                inner, y, _side = _stream(auto_cache)
+            except (CacheError, OSError) as e:
+                if auto_cache is None:
+                    raise
+                # the sidecar cache is best-effort: a full disk or a
+                # read-only data directory must not kill the build —
+                # re-stream assembling in memory instead
+                log.warning("binary cache not written (%s); streaming "
+                            "without a cache", e)
+                inner, y, _side = _stream(None)
+            self._inner = inner
+            self._apply_explicit_metadata()
+            return True
+
+        # ---- monolithic fallback: parse the shard as one array and
+        # let the generic tail bin it; with save_binary the built
+        # dataset is cached after construction
+        from .io.file_loader import load_text_file
+        X, y, side = load_text_file(
+            path, label_column=self.params.get("label_column"),
+            rank=rank, num_machines=nm)
+        self.data = X
+        if self.label is None and y is not None:
+            self.label = y
+        if self.weight is None and "weight" in side:
+            self.weight = side["weight"]
+        if self.group is None and "group" in side:
+            self.group = side["group"]
+        if self.init_score is None and "init_score" in side:
+            self.init_score = side["init_score"]
+        if auto_cache is not None:
+            self._pending_cache_write = (
+                auto_cache, path, rank, nm,
+                dataset_params_digest(cfg, cats))
+        return False
+
+    def _write_sidecar_cache(self, cache_path: str, src_path: str,
+                             rank: int, world: int,
+                             params_digest: str) -> None:
+        """Post-construction cache write for the monolithic path
+        (streamed ingest writes during pass 2 instead)."""
+        from .ingest.cache import (CacheError, save_dataset_cache,
+                                   source_fingerprint)
+        try:
+            save_dataset_cache(
+                self._inner, cache_path, rank=rank, world=world,
+                source=source_fingerprint(src_path, params_digest))
+            # marker for callers (cli task=save_binary): the artifact at
+            # this path is fresh and fingerprinted — do not rewrite it
+            self._inner.sidecar_cache_path = cache_path
+        except (CacheError, OSError) as e:
+            # best-effort: ineligible datasets (CacheError) and write
+            # failures (disk full, read-only dir) warn, never abort a
+            # successfully-built construct
+            log.warning("binary cache not written: %s", e)
 
     # ------------------------------------------------------------------
     def set_label(self, label) -> "Dataset":
@@ -436,6 +678,18 @@ class Booster:
         train_set.construct()
         self.train_set = train_set
         inner = train_set._inner
+        # a binary-cache-loaded dataset restores the binning-defining
+        # params it was built with (construction may have happened just
+        # now, AFTER the config snapshot above): fold them in unless
+        # the user explicitly set a conflicting value, so the resolved
+        # config (and the serialized parameters echo) matches the
+        # original build's
+        restored = {k: v for k, v in (getattr(inner, "dataset_params",
+                                              None) or {}).items()
+                    if not self.config.was_set(k)}
+        if restored:
+            self.config.update(restored)
+            train_set.params.update(restored)
         self.objective = create_objective(self.config)
         if self.objective is not None:
             if inner.metadata.label is None:

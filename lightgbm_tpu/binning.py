@@ -542,3 +542,22 @@ class BinMapper:
 
     def missing_type_str(self) -> str:
         return _MISSING_TYPE_STR[self.missing_type]
+
+
+def mappers_digest(mappers: Sequence["BinMapper"]) -> str:
+    """Stable SHA-256 over every mapper's defining state (bounds at full
+    float64 precision via repr, vocabularies, missing semantics).  Two
+    datasets whose mappers share a digest bin any value identically —
+    the ingest binary-cache manifest records it so a cache hit can
+    assert bit-compatibility instead of assuming it, and a reference-
+    aligned validation cache can be checked against its training
+    dataset."""
+    import hashlib
+    import json
+    h = hashlib.sha256()
+    for m in mappers:
+        d = m.to_dict()
+        d["bin_upper_bound"] = [repr(float(b)) for b in d["bin_upper_bound"]]
+        h.update(json.dumps(d, sort_keys=True, default=str).encode())
+        h.update(b"\x00")
+    return h.hexdigest()

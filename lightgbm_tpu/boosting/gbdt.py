@@ -349,8 +349,45 @@ class GBDT:
     @property
     def bins_dev(self):
         if self._bins_dev is None:
-            self._bins_dev = jnp.asarray(self.train_data.bins)
+            self._bins_dev = self._dataset_bins_to_device(self.train_data)
         return self._bins_dev
+
+    def _dataset_bins_to_device(self, ds):
+        """Host->device transfer of a dataset's bin matrix.  Streamed /
+        mmap-cached datasets (ingest/) go through the double-buffered
+        chunk prefetcher — the next chunk's host read (page faults on a
+        cache mmap) overlaps the in-flight copy, at most two chunks
+        live host-side, and the counters/watermarks land in telemetry —
+        instead of faulting the whole artifact into RAM for one giant
+        ``jnp.asarray``.  The result is elementwise-identical either
+        way (prefetch is a transfer schedule, not a data transform)."""
+        if getattr(ds, "streamed", False) \
+                and bool(getattr(self.config, "ingest_prefetch", True)):
+            from ..ingest.prefetch import stream_to_device
+            tel = self.telemetry
+            out = stream_to_device(
+                ds.bins, int(self.config.ingest_chunk_rows), tel=tel)
+            if tel.enabled and getattr(self, "_mem_watermarks", False):
+                # the prefetch assembly is where a streamed dataset's
+                # HBM residency materializes — watermark it like the
+                # drain boundary
+                from ..obs.jaxmon import memory_watermarks
+                memory_watermarks(tel, where="prefetch")
+            return out
+        return jnp.asarray(ds.bins)
+
+    def _publish_ingest(self, ds) -> None:
+        """Fold a dataset's ingest counters (chunked parse/bin stats,
+        cache hit, max-live-chunks watermark) into the telemetry
+        registry — ingest runs before the booster owns a registry, so
+        the stats ride the dataset and land here exactly once."""
+        stats = getattr(ds, "ingest_stats", None)
+        if not stats or getattr(ds, "_ingest_published", False) \
+                or not self.telemetry.enabled:
+            return
+        from ..ingest.prefetch import publish_ingest_stats
+        publish_ingest_stats(self.telemetry, stats)
+        ds._ingest_published = True
 
     # ------------------------------------------------------------------
     def _setup_telemetry(self, config: Config) -> None:
@@ -426,6 +463,13 @@ class GBDT:
                         gran)
             gran = "batch"
         self._tel_gran = gran
+        # streamed/cached datasets carry their ingest counters from
+        # before the registry existed; fold them in now (init and any
+        # reset_config that turns telemetry on)
+        if getattr(self, "train_data", None) is not None:
+            self._publish_ingest(self.train_data)
+            for vd in getattr(self, "valid_data", []) or []:
+                self._publish_ingest(vd)
 
     def _tel_granularity(self) -> str:
         """Effective time-attribution granularity. trace_out (spans come
@@ -1776,7 +1820,8 @@ class GBDT:
                         "consumer was armed; disabling on-device eval")
             self.arm_megastep(self._megastep_armed, eval_consumer=None)
         self.valid_data.append(valid_data)
-        self.valid_bins.append(jnp.asarray(valid_data.bins))
+        self._publish_ingest(valid_data)
+        self.valid_bins.append(self._dataset_bins_to_device(valid_data))
         k = self.num_tree_per_iteration
         n = valid_data.num_data
         md = valid_data.metadata

@@ -472,7 +472,13 @@ class _PushBuild:
                 raise ValueError(
                     f"dataset declared {self.n} rows but {missing} were "
                     f"never pushed (first missing row: {first})")
-            self.ds = Dataset(self.buf, reference=self.reference)
+            # inherit the reference's params: binning already comes from
+            # its mappers, but the booster's resolved config (and hence
+            # the serialized parameters block) must see the same
+            # dataset-defining keys, or a pushed-rows model's
+            # serialization differs from the monolithic one by its echo
+            self.ds = Dataset(self.buf, reference=self.reference,
+                              params=dict(self.reference.params))
             for name, vals in self.fields.items():
                 self.ds.set_field(name, vals)
             self.ds.construct()

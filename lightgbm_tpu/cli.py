@@ -182,14 +182,40 @@ def run_convert_model(params: Dict[str, str]) -> None:
 
 def run_save_binary(params: Dict[str, str]) -> None:
     """(ref: application.cpp:70-83 task=save_binary — load the training
-    data, write the binary cache next to it, exit)"""
+    data, write the binary cache next to it, exit)
+
+    Writes the sharded v2 cache artifact (docs/Data.md): versioned,
+    SHA-256-manifested, mmap-able; ``Dataset(data="<file>.bin")`` /
+    ``data=<file>.bin`` on a later run skips text parsing and binning
+    entirely.  The build itself streams in bounded chunks
+    (``two_round`` defaults ON here so host RSS stays O(chunk) — pass
+    ``two_round=false`` to force the monolithic load;
+    ``ingest_chunk_rows`` sizes the chunks)."""
+    from .ingest.cache import CacheError
     data = params.pop("data", None)
     if not data:
         raise SystemExit("task=save_binary requires data=<file>")
+    out = params.get("output_model", data + ".bin")
+    params.setdefault("two_round", "true")
+    if out == data + ".bin":
+        # default destination == the auto-cache sidecar: stream packed
+        # chunks STRAIGHT into the artifact (the parsed shard never
+        # exists in RAM at once), fingerprinted for later auto-hits
+        params.setdefault("save_binary", "true")
     ds = Dataset(data, params=dict(params))
     ds.construct()
-    out = params.get("output_model", data + ".bin")
-    ds._inner.save_binary(out)
+    # the construct may already have produced the artifact at `out`
+    # (streamed cache_out or the sidecar auto-write) — rewriting it
+    # here would REPLACE the fingerprinted manifest with a source-less
+    # one and turn every later save_binary auto-load into a miss
+    stats = getattr(ds._inner, "ingest_stats", None) or {}
+    already = (stats.get("cache_path") == out
+               or getattr(ds._inner, "sidecar_cache_path", None) == out)
+    if not already:
+        try:
+            ds._inner.save_binary(out)
+        except CacheError as e:
+            raise SystemExit(f"cannot save binary dataset: {e}")
     log.info("Finished saving binary dataset to %s", out)
 
 

@@ -157,7 +157,27 @@ _PARAMS: List[_Param] = [
     _p("zero_as_missing", bool, False),
     _p("feature_pre_filter", bool, True),
     _p("pre_partition", bool, False, ("is_pre_partition",)),
-    _p("two_round", bool, False, ("two_round_loading", "use_two_round_loading")),
+    _p("two_round", bool, False, ("two_round_loading", "use_two_round_loading"),
+       desc="stream file-based dataset construction in bounded chunks "
+            "(ingest/): pass 1 collects the binning sample, pass 2 "
+            "parses -> bins -> packs per chunk, so peak host RSS is "
+            "O(ingest_chunk_rows) instead of O(shard) — the trained "
+            "model is bit-identical to the monolithic load "
+            "(docs/Data.md). With save_binary, the packed chunks "
+            "stream straight into the binary cache artifact and the "
+            "parsed shard never exists in RAM at once"),
+    _p("ingest_chunk_rows", int, 65536, ("ingest_chunk_size",),
+       check=(">", 0),
+       desc="rows per streaming-ingest chunk (parse/bin/pack and "
+            "host->device prefetch granularity). Setting it explicitly "
+            "also OPTS IN to chunked ingest for file loads, like "
+            "two_round=true"),
+    _p("ingest_prefetch", bool, True,
+       desc="double-buffered host->device transfer of streamed/"
+            "mmap-cached bin matrices: the next chunk's host read "
+            "overlaps the in-flight copy, at most two chunks live on "
+            "host (ingest.max_live_chunks gauge), host stall time in "
+            "prefetch.host_wait_ms. Off = one-shot jnp.asarray upload"),
     _p("header", bool, False, ("has_header",)),
     _p("label_column", str, "", ("label",)),
     _p("weight_column", str, "", ("weight",)),
@@ -167,7 +187,16 @@ _PARAMS: List[_Param] = [
     _p("categorical_feature", list, [], ("cat_feature", "categorical_column",
                                          "cat_column")),
     _p("forcedbins_filename", str, ""),
-    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file")),
+    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file"),
+       desc="maintain a binary dataset cache next to a file-based "
+            "training input (<data>.bin, per-rank shards under the "
+            "multiproc launcher): written after construction (or "
+            "streamed during it with two_round), and LOADED instead of "
+            "the text file on later constructs when the source "
+            "fingerprint (size/mtime/dataset params) still matches — "
+            "cache-hit startup skips parsing and binning entirely "
+            "(docs/Data.md). cli.py task=save_binary writes the same "
+            "artifact explicitly"),
     _p("precise_float_parser", bool, False),
     # ---- Predict parameters ----
     _p("start_iteration_predict", int, 0),

@@ -27,6 +27,18 @@ from .config import Config
 from .utils import log
 
 
+# the binning-defining keys a binary cache round-trips (the same family
+# the C-API's UpdateParamChecking guards)
+_DATASET_DEFINING_KEYS = (
+    "max_bin", "max_bin_by_feature", "bin_construct_sample_cnt",
+    "min_data_in_bin", "use_missing", "zero_as_missing",
+    "feature_pre_filter", "min_data_in_leaf", "data_random_seed")
+
+
+def dataset_defining_params(config: "Config") -> Dict[str, Any]:
+    return {k: getattr(config, k) for k in _DATASET_DEFINING_KEYS}
+
+
 class Metadata:
     """Label / weight / query-boundary / init-score holder
     (ref: include/LightGBM/dataset.h:42, src/io/metadata.cpp)."""
@@ -178,6 +190,21 @@ class TpuDataset:
         # sparse-built datasets: ``bins`` holds EFB BUNDLE columns and
         # this carries the ops.efb.BundleLayout decode (None = logical)
         self.prebundled = None
+        # streaming-ingest bookkeeping (ingest/): counters published into
+        # the training telemetry registry at booster init, and the flag
+        # that routes host->device transfer through the double-buffered
+        # prefetcher (also set for mmap-backed cache loads)
+        self.ingest_stats: Optional[Dict[str, Any]] = None
+        self.streamed: bool = False
+        # resolved dataset-defining params captured at mapper build —
+        # persisted in the binary cache (the reference's .bin stores its
+        # config too) so a reloaded dataset's booster resolves/echoes
+        # the same values the original build used
+        self.dataset_params: Dict[str, Any] = {}
+        # True when the bins were produced against ANOTHER dataset's
+        # mappers (validation builds): a cache of such a dataset must
+        # never be reused as standalone training data
+        self.reference_binned: bool = False
 
     # ------------------------------------------------------------------
     @classmethod
@@ -217,6 +244,9 @@ class TpuDataset:
         if reference is not None:
             self.mappers = reference.mappers
             self.used_features = reference.used_features
+            self.dataset_params = dict(
+                getattr(reference, "dataset_params", {}) or {})
+            self.reference_binned = True
             self._finalize_feature_arrays()
             self._push_data(data)
             return self
@@ -225,6 +255,27 @@ class TpuDataset:
         sample_idx = _sample_rows(n, config.bin_construct_sample_cnt,
                                   config.data_random_seed)
         sample = np.asarray(data[sample_idx], dtype=np.float64)
+        self.build_mappers_from_sample(sample, config, cat_set,
+                                       forced_bounds)
+        self._push_data(data)
+        if config.monotone_constraints:
+            mc = np.asarray(config.monotone_constraints, dtype=np.int32)
+            log.check(mc.size == f, "monotone_constraints length mismatch")
+            self.monotone_constraints = mc
+        return self
+
+    def build_mappers_from_sample(self, sample: np.ndarray, config: Config,
+                                  cat_set=frozenset(),
+                                  forced_bounds=None) -> None:
+        """Construct per-feature BinMappers from a float64 row sample and
+        finalize the feature arrays.  The ONE mapper-construction path:
+        the monolithic ``from_data`` and the chunked streaming ingest
+        pipeline (ingest/pipeline.py, which collects the SAME sampled
+        rows in bounded passes) both land here, so a streamed dataset's
+        mappers are bit-identical to the monolithic build's by
+        construction."""
+        f = self.num_total_features
+        self.dataset_params = dataset_defining_params(config)
         # distributed loading: every rank holds only its row shard — the
         # bin mappers must still be IDENTICAL everywhere, so the samples
         # are allgathered across processes before FindBin (the TPU-native
@@ -281,12 +332,6 @@ class TpuDataset:
                         "and re-construct Dataset might resolve this "
                         "warning.")
         self._finalize_feature_arrays()
-        self._push_data(data)
-        if config.monotone_constraints:
-            mc = np.asarray(config.monotone_constraints, dtype=np.int32)
-            log.check(mc.size == f, "monotone_constraints length mismatch")
-            self.monotone_constraints = mc
-        return self
 
     # ------------------------------------------------------------------
     @classmethod
@@ -329,6 +374,7 @@ class TpuDataset:
                 # train sample never saw, skewing eval vs predict
                 self.mappers = reference.mappers
                 self.used_features = reference.used_features
+                self.reference_binned = True
                 self._finalize_feature_arrays()
                 dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
                 out = np.zeros((n, len(self.used_features)), dtype)
@@ -430,18 +476,30 @@ class TpuDataset:
         self.missing_types = np.array(
             [self.mappers[j].missing_type for j in used], np.int32)
 
-    def _push_data(self, data: np.ndarray) -> None:
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+    def bin_dtype(self):
+        return np.uint8 if self.max_num_bin <= 256 else np.uint16
+
+    def bin_rows(self, data: np.ndarray) -> np.ndarray:
+        """Bin a [rows, num_total_features] float block against the
+        finalized mappers -> packed [rows, num_used_features] uint8/16.
+        The ONE binning hop for raw rows — the monolithic ``_push_data``
+        and the chunked ingest pipeline both call it, so per-chunk
+        binning is elementwise-identical to the whole-shard pass."""
+        dtype = self.bin_dtype()
         # transpose copies on both sides keep every inner loop contiguous
         # (strided per-column access to the row-major matrices dominates
         # otherwise); float32 input stays float32 — value_to_bin bins it
         # exactly against pre-rounded f32 bounds
         dataT = np.ascontiguousarray(data.T)
-        outT = np.empty((len(self.used_features), self.num_data), dtype=dtype)
+        outT = np.empty((len(self.used_features), data.shape[0]),
+                        dtype=dtype)
         for k, j in enumerate(self.used_features):
             outT[k] = self.mappers[j].value_to_bin(dataT[j]).astype(
                 dtype, copy=False)
-        self.bins = np.ascontiguousarray(outT.T)
+        return np.ascontiguousarray(outT.T)
+
+    def _push_data(self, data: np.ndarray) -> None:
+        self.bins = self.bin_rows(data)
 
     # ------------------------------------------------------------------
     def add_features_from(self, other: "TpuDataset") -> None:
@@ -505,32 +563,27 @@ class TpuDataset:
     # ------------------------------------------------------------------
     def save_binary(self, path: str) -> None:
         """Binary dataset cache (analog of ref: dataset_loader.cpp:336
-        LoadFromBinFile / Dataset::SaveBinaryFile)."""
-        payload = {
-            "version": 1,
-            "bins": self.bins,
-            "mappers": [m.to_dict() for m in self.mappers],
-            "used_features": self.used_features,
-            "num_data": self.num_data,
-            "num_total_features": self.num_total_features,
-            "feature_names": self.feature_names,
-            "label": self.metadata.label if self.metadata else None,
-            "weight": self.metadata.weight if self.metadata else None,
-            "query_boundaries": (self.metadata.query_boundaries
-                                 if self.metadata else None),
-            "init_score": self.metadata.init_score if self.metadata else None,
-            "monotone_constraints": self.monotone_constraints,
-        }
-        with open(path, "wb") as fh:
-            fh.write(b"LGBMTPU1")
-            pickle.dump(payload, fh, protocol=4)
+        LoadFromBinFile / Dataset::SaveBinaryFile).  Writes the sharded
+        v2 artifact (ingest/cache.py): hash-manifested, versioned,
+        written streaming + atomically, and mmap-able on reload so a
+        cache-hit startup never re-parses text or re-bins."""
+        from .ingest.cache import save_dataset_cache
+        save_dataset_cache(self, path)
 
     @classmethod
     def load_binary(cls, path: str) -> "TpuDataset":
+        """Load a binary dataset cache: the current v2 artifact
+        (``LGBMTPU2``, mmap + manifest verification) or the legacy v1
+        pickle (``LGBMTPU1``) written by earlier versions."""
+        from .ingest.cache import CACHE_MAGIC, load_dataset_cache
         with open(path, "rb") as fh:
             magic = fh.read(8)
-            log.check(magic == b"LGBMTPU1", f"{path} is not a lightgbm_tpu "
-                      "binary dataset file")
+        if magic == CACHE_MAGIC:
+            return load_dataset_cache(path)
+        log.check(magic == b"LGBMTPU1", f"{path} is not a lightgbm_tpu "
+                  "binary dataset file")
+        with open(path, "rb") as fh:
+            fh.read(8)
             payload = pickle.load(fh)
         self = cls()
         self.bins = payload["bins"]
@@ -558,6 +611,7 @@ class TpuDataset:
         out.bins = self.bins[row_indices]
         out.mappers = self.mappers
         out.used_features = self.used_features
+        out.dataset_params = dict(self.dataset_params)
         out.num_data = len(row_indices)
         out.num_total_features = self.num_total_features
         out.feature_names = self.feature_names

@@ -1,0 +1,40 @@
+"""Streaming out-of-core ingest: chunked bin-and-pack, sharded binary
+dataset cache, and double-buffered host->device prefetch.
+
+The monolithic text pipeline (io/file_loader.py -> dataset.py) holds a
+rank's ENTIRE parsed float shard in host RAM before binning; this
+package is the beyond-RAM path (ROADMAP item 3, ref: LightGBM's
+streaming ``LGBM_DatasetPushRows`` build + ``save_binary`` cache;
+arxiv 1706.08359 / 2011.02022 on keeping the boosting loop fed):
+
+- chunker.py — bounded resumable text chunk iteration over the SAME
+  native field parser as the monolithic load (bit-identical values);
+- pipeline.py — two-pass chunked build: pass 1 streams the binning
+  sample (exactly the rows the monolithic build would sample), pass 2
+  parses -> bins -> packs per chunk, so peak host RSS is O(chunk), not
+  O(shard);
+- cache.py — the sharded v2 binary dataset artifact (``LGBMTPU2``):
+  versioned, SHA-256-manifested, written streaming + atomically
+  (resilience/atomicio.py write-then-rename), mmap-able on reload —
+  cache-hit startup skips text parsing AND binning entirely;
+- prefetch.py — double-buffered host->device chunk transfer feeding the
+  training driver's device bin matrix, with at most two chunks live on
+  host and ``ingest.*``/``prefetch.*`` telemetry counters.
+
+Contract: a model trained from the streamed/cached path serializes
+byte-equal to one trained from the monolithic text load (the pipeline
+shares the mapper construction, sampling, and row binning code with the
+monolithic path — see tests/test_ingest.py). Knobs and the artifact
+format are documented in docs/Data.md.
+"""
+from .cache import (CACHE_FORMAT_VERSION, CACHE_MAGIC, CacheError,
+                    CacheWriter, cache_shard_path, load_dataset_cache,
+                    read_manifest, save_dataset_cache)
+from .pipeline import ingest_text_streamed, streaming_eligible
+from .prefetch import IngestStats, publish_ingest_stats, stream_to_device
+
+__all__ = ["CACHE_FORMAT_VERSION", "CACHE_MAGIC", "CacheError",
+           "CacheWriter", "cache_shard_path", "load_dataset_cache",
+           "read_manifest", "save_dataset_cache", "ingest_text_streamed",
+           "streaming_eligible", "IngestStats", "publish_ingest_stats",
+           "stream_to_device"]

@@ -1,0 +1,365 @@
+"""Sharded binary dataset cache — the v2 ``LGBMTPU2`` artifact.
+
+Single-file-per-rank layout, written streaming (O(chunk) writer RSS) and
+atomically (resilience/atomicio.py write-then-rename), mmap-able on
+reload:
+
+    [8 B magic "LGBMTPU2"]
+    [packed bin matrix, C-order uint8/uint16  [num_data, num_used]]
+    [metadata pickle (mappers, label, weight, queries, init_score, ...)]
+    [manifest JSON]
+    [8 B little-endian uint64: manifest length][8 B magic "LGBMTPU2"]
+
+The manifest travels at the TAIL so the whole artifact is produced in
+one forward streaming pass (bins are hashed as they are appended — no
+seek-back), yet a reader finds it in one 16-byte footer read.  It
+records the format version, region offsets/sizes, SHA-256 of the bins
+and metadata regions, the mapper digest, the producing rank/world, and
+an optional source-file fingerprint — so corruption, truncation,
+version skew, and rank-layout mismatches are all REFUSED with a
+structured :class:`CacheError` instead of silently training on bad
+bins.  Reloading mmaps the bins region read-only: a cache-hit startup
+does zero text parsing, zero binning, and zero bulk host allocation
+(the OS pages bins in as the device prefetcher streams them up).
+
+Analog of ref: src/io/dataset_loader.cpp:336 LoadFromBinFile /
+Dataset::SaveBinaryFile, extended with the hash manifest and per-rank
+sharding (``cache_shard_path``) the multiproc launcher routes through.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..resilience.atomicio import atomic_stream
+from ..utils import log
+
+CACHE_MAGIC = b"LGBMTPU2"
+CACHE_FORMAT_VERSION = 2
+CACHE_SCHEMA = "lightgbm_tpu.dataset_cache"
+_FOOTER = struct.Struct("<Q8s")
+_HASH_BLOCK = 1 << 22          # 4 MB streaming-hash read block
+
+
+class CacheError(Exception):
+    """A binary dataset cache that must not be used: corrupt, truncated,
+    version-mismatched, or written for a different rank layout."""
+
+
+def cache_shard_path(path: str, rank: int = 0, world: int = 1) -> str:
+    """Per-rank shard file name: the bare path for single-process, a
+    ``.rank<r>of<w>`` suffix under a multi-process layout (each rank
+    caches only its contiguous row slice)."""
+    if world <= 1:
+        return str(path)
+    return f"{path}.rank{int(rank)}of{int(world)}"
+
+
+def source_fingerprint(path: str, params_digest: str = "") -> Dict[str, Any]:
+    """Identity of the text file a cache was built from: size + mtime +
+    the dataset-defining-params digest.  An auto-maintained sidecar
+    cache (``save_binary=true``) is a HIT only when all three match."""
+    st = os.stat(path)
+    return {"path": os.path.abspath(str(path)), "size": int(st.st_size),
+            "mtime_ns": int(st.st_mtime_ns),
+            "params_digest": params_digest}
+
+
+class CacheWriter:
+    """Streaming cache writer: ``append_rows`` packed-bin chunks in row
+    order, then ``finalize`` with the metadata dict.  Everything lands
+    in an atomic temp sibling; a crash (or ``abort``) before finalize
+    leaves the destination untouched."""
+
+    def __init__(self, path: str, num_data: int, num_total_features: int,
+                 used_features, bin_dtype, rank: int = 0, world: int = 1,
+                 source: Optional[Dict[str, Any]] = None,
+                 fsync: bool = True):
+        self.path = str(path)
+        self.num_data = int(num_data)
+        self.num_total_features = int(num_total_features)
+        self.used_features = list(used_features)
+        self.dtype = np.dtype(bin_dtype)
+        self.rank, self.world = int(rank), int(world)
+        self.source = source
+        self.rows_written = 0
+        self.chunks_written = 0
+        self._bins_hash = hashlib.sha256()
+        self._cm = atomic_stream(self.path, fsync=fsync)
+        self._fh = self._cm.__enter__()
+        self._fh.write(CACHE_MAGIC)
+        self._done = False
+
+    def append_rows(self, packed: np.ndarray) -> None:
+        if self._done:
+            raise CacheError("cache writer already finalized")
+        if packed.dtype != self.dtype or packed.ndim != 2 \
+                or packed.shape[1] != len(self.used_features):
+            raise CacheError(
+                f"chunk shape/dtype {packed.shape}/{packed.dtype} does "
+                f"not match the declared "
+                f"[*, {len(self.used_features)}] {self.dtype}")
+        if self.rows_written + packed.shape[0] > self.num_data:
+            raise CacheError(
+                f"cache overflow: {self.rows_written + packed.shape[0]} "
+                f"rows pushed into a {self.num_data}-row artifact")
+        buf = np.ascontiguousarray(packed).tobytes()
+        self._bins_hash.update(buf)
+        self._fh.write(buf)
+        self.rows_written += packed.shape[0]
+        self.chunks_written += 1
+
+    def finalize(self, meta: Dict[str, Any], mappers_digest: str = "",
+                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Write metadata + manifest + footer, fsync, rename into place.
+        ``extra`` merges additional manifest fields (e.g. the
+        reference-binned provenance flag). Returns the manifest."""
+        if self._done:
+            raise CacheError("cache writer already finalized")
+        if self.rows_written != self.num_data:
+            raise CacheError(
+                f"cache underflow: {self.rows_written} of "
+                f"{self.num_data} rows written")
+        meta_bytes = pickle.dumps(meta, protocol=4)
+        bins_nbytes = self.num_data * len(self.used_features) \
+            * self.dtype.itemsize
+        manifest = {
+            "format_version": CACHE_FORMAT_VERSION,
+            "schema": CACHE_SCHEMA,
+            "num_data": self.num_data,
+            "num_used_features": len(self.used_features),
+            "num_total_features": self.num_total_features,
+            "bin_dtype": self.dtype.name,
+            "bins_offset": len(CACHE_MAGIC),
+            "bins_nbytes": bins_nbytes,
+            "meta_offset": len(CACHE_MAGIC) + bins_nbytes,
+            "meta_nbytes": len(meta_bytes),
+            "bins_sha256": self._bins_hash.hexdigest(),
+            "meta_sha256": hashlib.sha256(meta_bytes).hexdigest(),
+            "mappers_digest": mappers_digest,
+            "rank": self.rank, "world": self.world,
+            "chunks": self.chunks_written,
+            "source": self.source,
+            "created": round(time.time(), 3),
+        }
+        manifest.update(extra or {})
+        mf = json.dumps(manifest, sort_keys=True).encode("utf-8")
+        self._fh.write(meta_bytes)
+        self._fh.write(mf)
+        self._fh.write(_FOOTER.pack(len(mf), CACHE_MAGIC))
+        self._cm.__exit__(None, None, None)      # fsync + rename
+        self._done = True
+        return manifest
+
+    def abort(self) -> None:
+        """Discard the temp artifact (destination stays untouched)."""
+        if self._done:
+            return
+        self._done = True
+        exc = CacheError("cache write aborted")
+        self._cm.__exit__(CacheError, exc, None)
+
+
+# --------------------------------------------------------------- reading
+def read_manifest(path: str) -> Dict[str, Any]:
+    """Footer -> manifest dict; raises CacheError on any structural
+    problem (short file, bad magic, unparseable manifest, version or
+    schema skew)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        raise CacheError(f"cannot stat cache {path}: {e}")
+    if size < len(CACHE_MAGIC) + _FOOTER.size:
+        raise CacheError(f"{path}: too short to be a dataset cache "
+                         f"({size} bytes)")
+    with open(path, "rb") as fh:
+        if fh.read(8) != CACHE_MAGIC:
+            raise CacheError(f"{path}: bad cache magic")
+        fh.seek(size - _FOOTER.size)
+        mf_len, tail_magic = _FOOTER.unpack(fh.read(_FOOTER.size))
+        if tail_magic != CACHE_MAGIC:
+            raise CacheError(f"{path}: truncated cache (footer magic "
+                             "missing — the write never finalized)")
+        if mf_len <= 0 or mf_len > size - _FOOTER.size - len(CACHE_MAGIC):
+            raise CacheError(f"{path}: corrupt manifest length {mf_len}")
+        fh.seek(size - _FOOTER.size - mf_len)
+        raw = fh.read(mf_len)
+    try:
+        manifest = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CacheError(f"{path}: corrupt manifest JSON: {e}")
+    ver = manifest.get("format_version")
+    if ver != CACHE_FORMAT_VERSION:
+        raise CacheError(
+            f"{path}: cache format version {ver} != supported "
+            f"{CACHE_FORMAT_VERSION} — rebuild the cache from the text "
+            "source (task=save_binary)")
+    if manifest.get("schema") != CACHE_SCHEMA:
+        raise CacheError(f"{path}: unknown cache schema "
+                         f"{manifest.get('schema')!r}")
+    expect_end = manifest["meta_offset"] + manifest["meta_nbytes"] \
+        + mf_len + _FOOTER.size
+    if expect_end != size:
+        raise CacheError(
+            f"{path}: size {size} does not match manifest layout "
+            f"({expect_end}) — truncated or corrupt")
+    return manifest
+
+
+def _verify_region(path: str, offset: int, nbytes: int, expect: str,
+                   what: str) -> None:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        fh.seek(offset)
+        left = nbytes
+        while left > 0:
+            block = fh.read(min(_HASH_BLOCK, left))
+            if not block:
+                raise CacheError(f"{path}: {what} region truncated")
+            h.update(block)
+            left -= len(block)
+    if h.hexdigest() != expect:
+        raise CacheError(
+            f"{path}: {what} hash mismatch (expected {expect[:12]}…, "
+            f"got {h.hexdigest()[:12]}…) — the cache is corrupt; delete "
+            "it and rebuild from the text source")
+
+
+def load_dataset_cache(path: str, verify: bool = True, mmap: bool = True,
+                       expect_rank: Optional[int] = None,
+                       expect_world: Optional[int] = None):
+    """Cache file -> TpuDataset.  ``verify`` streams the SHA-256 of both
+    regions against the manifest (bounded memory); ``mmap`` maps the
+    bins region read-only instead of reading it into RAM.  The returned
+    dataset is flagged ``streamed`` so the training driver routes its
+    host->device transfer through the double-buffered prefetcher."""
+    from ..dataset import Metadata, TpuDataset
+    from ..binning import BinMapper
+
+    manifest = read_manifest(path)
+    if expect_world is not None and int(manifest.get("world", 1)) \
+            != int(expect_world):
+        raise CacheError(
+            f"{path}: cache was written for world={manifest.get('world')}"
+            f" but this run has world={expect_world} — rebuild per-rank "
+            "caches (save_binary under the current launcher layout)")
+    if expect_rank is not None and int(manifest.get("rank", 0)) \
+            != int(expect_rank):
+        raise CacheError(
+            f"{path}: cache shard belongs to rank {manifest.get('rank')} "
+            f"but rank {expect_rank} tried to load it")
+    if verify:
+        _verify_region(path, manifest["bins_offset"],
+                       manifest["bins_nbytes"],
+                       manifest["bins_sha256"], "bins")
+        _verify_region(path, manifest["meta_offset"],
+                       manifest["meta_nbytes"],
+                       manifest["meta_sha256"], "metadata")
+    with open(path, "rb") as fh:
+        fh.seek(manifest["meta_offset"])
+        meta = pickle.loads(fh.read(manifest["meta_nbytes"]))
+
+    n = int(manifest["num_data"])
+    n_used = int(manifest["num_used_features"])
+    dtype = np.dtype(manifest["bin_dtype"])
+    if mmap and n * n_used > 0:
+        bins = np.memmap(path, dtype=dtype, mode="r",
+                         offset=int(manifest["bins_offset"]),
+                         shape=(n, n_used))
+    else:
+        with open(path, "rb") as fh:
+            fh.seek(manifest["bins_offset"])
+            bins = np.frombuffer(
+                fh.read(manifest["bins_nbytes"]),
+                dtype=dtype).reshape(n, n_used).copy()
+
+    ds = TpuDataset()
+    ds.bins = bins
+    ds.mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
+    ds.used_features = list(meta["used_features"])
+    ds.num_data = n
+    ds.num_total_features = int(manifest["num_total_features"])
+    ds.feature_names = list(meta.get("feature_names") or [])
+    ds.metadata = Metadata(n)
+    if meta.get("label") is not None:
+        ds.metadata.set_label(meta["label"])
+    ds.metadata.weight = meta.get("weight")
+    ds.metadata.query_boundaries = meta.get("query_boundaries")
+    ds.metadata.init_score = meta.get("init_score")
+    ds.monotone_constraints = meta.get("monotone_constraints")
+    ds.dataset_params = dict(meta.get("dataset_params") or {})
+    ds.reference_binned = bool(manifest.get("reference_binned", False))
+    if meta.get("mp_sample_bins") is not None:
+        ds.mp_sample_bins = meta["mp_sample_bins"]
+    ds._finalize_feature_arrays()
+    ds.streamed = True
+    ds.ingest_stats = {"source": "cache", "cache_hit": 1,
+                       "cache_path": str(path),
+                       "chunks": int(manifest.get("chunks", 1)),
+                       "rows": n, "max_live_chunks": 0,
+                       "verified": bool(verify), "mmap": bool(mmap)}
+    return ds
+
+
+def dataset_meta(ds) -> Dict[str, Any]:
+    """The picklable metadata region for a built TpuDataset."""
+    md = ds.metadata
+    return {
+        "mappers": [m.to_dict() for m in ds.mappers],
+        "used_features": list(ds.used_features),
+        "feature_names": list(ds.feature_names or []),
+        "label": None if md is None else md.label,
+        "weight": None if md is None else md.weight,
+        "query_boundaries": None if md is None else md.query_boundaries,
+        "init_score": None if md is None else md.init_score,
+        "monotone_constraints": ds.monotone_constraints,
+        "dataset_params": dict(getattr(ds, "dataset_params", {}) or {}),
+        # multi-process builds retain the allgathered binning sample
+        # (BINNED, uint16) for EFB conflict masks — without it a
+        # cache-hit rank would skip bundling and diverge from a
+        # cache-miss rank's layout
+        "mp_sample_bins": getattr(ds, "mp_sample_bins", None),
+    }
+
+
+def save_dataset_cache(ds, path: str, rank: int = 0, world: int = 1,
+                       source: Optional[Dict[str, Any]] = None,
+                       chunk_rows: int = 65536) -> Dict[str, Any]:
+    """Write a constructed TpuDataset as a v2 cache artifact, streaming
+    its bin matrix in ``chunk_rows`` blocks.  Returns the manifest."""
+    from ..binning import mappers_digest
+    if getattr(ds, "prebundled", None) is not None:
+        raise CacheError(
+            "sparse EFB-bundled datasets store bundle columns, not "
+            "per-feature bins, and are not cacheable — construct from "
+            "dense/text input to use the binary cache")
+    if getattr(ds, "raw_data", None) is not None:
+        raise CacheError(
+            "linear_tree datasets retain raw feature values, which the "
+            "binary cache does not carry — train linear_tree from the "
+            "text/array source")
+    bins = np.asarray(ds.bins)
+    w = CacheWriter(path, ds.num_data, ds.num_total_features,
+                    ds.used_features, bins.dtype, rank=rank, world=world,
+                    source=source)
+    try:
+        for lo in range(0, ds.num_data, max(1, int(chunk_rows))):
+            w.append_rows(bins[lo:lo + int(chunk_rows)])
+        manifest = w.finalize(
+            dataset_meta(ds), mappers_digest=mappers_digest(ds.mappers),
+            extra={"reference_binned": bool(getattr(ds, "reference_binned",
+                                                    False))})
+    except BaseException:
+        w.abort()
+        raise
+    log.info("Saved binary dataset cache: %s (%d rows x %d features, "
+             "%d chunks)", path, ds.num_data, len(ds.used_features),
+             manifest["chunks"])
+    return manifest

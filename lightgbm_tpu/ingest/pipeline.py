@@ -1,0 +1,235 @@
+"""Two-pass chunked bin-and-pack: text shard -> binned TpuDataset with
+O(chunk) peak host residency.
+
+Pass 1 streams the file once collecting EXACTLY the rows the monolithic
+build would sample (``dataset._sample_rows`` over the rank's slice — the
+same RandomState stream, so the BinMappers come out bit-identical) plus
+the label column.  Pass 2 streams again, binning each chunk through
+``TpuDataset.bin_rows`` (the same code the monolithic ``_push_data``
+uses) and packing it either into the preallocated bin matrix (1 B/elem)
+or straight into a :class:`~.cache.CacheWriter` — in which case the
+finished artifact is mmapped back and the parsed float rows NEVER exist
+as one array (the reference's ``two_round`` semantics, ref:
+dataset_loader.cpp two-round loading + PushRows streaming build).
+
+Eligibility: dense/LibSVM text input; ``linear_tree`` needs retained raw
+values and falls back to the monolithic load (reported via the
+dataset's ingest stats / a ``megastep``-style structured event at
+booster init).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..io.file_loader import (_label_spec, compute_rank_slice,
+                              load_sidecars, split_label_column)
+from ..utils import log
+from .chunker import iter_chunks, scan_layout, slice_start_offset
+from .prefetch import IngestStats
+
+
+def streaming_eligible(config, data) -> Tuple[bool, str]:
+    """(eligible, reason) — may this construct take the chunked ingest
+    path?  Engages when the user opted in (``two_round=true``, the
+    reference's memory-saving switch, or an explicit
+    ``ingest_chunk_rows``) and nothing requires retained raw values."""
+    if not isinstance(data, (str, os.PathLike)):
+        return False, "not_a_file"
+    if not (bool(config.two_round) or config.was_set("ingest_chunk_rows")):
+        return False, "not_requested"
+    if bool(config.linear_tree):
+        return False, "linear_tree_needs_raw_data"
+    return True, "ok"
+
+
+def ingest_text_streamed(path: str, config, label_column=None,
+                         rank: int = 0, num_machines: int = 1,
+                         categorical_feature=(), feature_names=None,
+                         reference=None,
+                         cache_out: Optional[str] = None,
+                         world: int = 1):
+    """Chunked two-pass build -> (TpuDataset, label, sidecars).
+
+    ``reference`` (a constructed TpuDataset) skips pass 1 entirely and
+    bins against its mappers (validation files).  ``cache_out`` streams
+    the packed chunks into a v2 cache artifact and mmaps it back instead
+    of materializing the bin matrix in RAM."""
+    from ..dataset import Metadata, TpuDataset, _sample_rows
+
+    chunk_rows = max(1, int(config.ingest_chunk_rows))
+    layout = scan_layout(str(path))
+    if layout.n_rows == 0:
+        raise ValueError(f"no data rows in {path}")
+    sl = compute_rank_slice(str(path), layout.n_rows, rank, num_machines)
+    n = sl.stop - sl.start
+    li = None if layout.is_libsvm else _label_spec(label_column,
+                                                  layout.header_names)
+    n_feat = layout.n_cols - 1 if layout.is_libsvm else (
+        layout.n_cols - 1 if li is not None and 0 <= li < layout.n_cols
+        else layout.n_cols)
+    if not layout.is_libsvm and li is not None and li >= layout.n_cols:
+        raise ValueError(
+            f"label_column={li} out of range for {layout.n_cols}-column "
+            f"file {path}")
+
+    stats = IngestStats(source="text")
+    # the byte offset of this rank's first row is walked ONCE; both
+    # streaming passes resume from it
+    off0 = slice_start_offset(layout, sl.start)
+    ds = TpuDataset()
+    ds.num_data = n
+    ds.num_total_features = n_feat
+    ds.feature_names = (list(feature_names) if feature_names
+                        else [f"Column_{i}" for i in range(n_feat)])
+    ds.metadata = Metadata(n)
+
+    label = np.empty((n,), np.float32) if (layout.is_libsvm or
+                                           (li is not None and li >= 0)) \
+        else None
+
+    def _features_of(Xc, yc, row0):
+        """Chunk -> (feature rows float32, rows consumed); stashes the
+        label slice."""
+        if layout.is_libsvm:
+            if label is not None:
+                label[row0:row0 + len(Xc)] = yc
+            return Xc
+        Xf, yl = split_label_column(Xc, li, layout.n_cols, str(path))
+        if yl is not None and label is not None:
+            label[row0:row0 + len(Xc)] = yl
+        return Xf
+
+    if reference is not None:
+        ds.mappers = reference.mappers
+        ds.used_features = reference.used_features
+        ds.dataset_params = dict(
+            getattr(reference, "dataset_params", {}) or {})
+        ds.reference_binned = True
+        ds._finalize_feature_arrays()
+    else:
+        # ---- pass 1: stream the binning sample (the SAME rows the
+        # monolithic build samples: _sample_rows over this rank's slice)
+        sample_idx = _sample_rows(n, config.bin_construct_sample_cnt,
+                                  config.data_random_seed)
+        sample = np.empty((len(sample_idx), n_feat), np.float64)
+        filled = 0
+        for row0, Xc, yc in iter_chunks(layout, chunk_rows, sl.start,
+                                        sl.stop, start_offset=off0):
+            stats.chunk_opened(len(Xc))
+            lo_i = int(np.searchsorted(sample_idx, row0))
+            hi_i = int(np.searchsorted(sample_idx, row0 + len(Xc)))
+            if hi_i > lo_i:
+                # work only on the SAMPLED rows of this chunk: the
+                # label-column delete commutes with row selection, so
+                # slicing first keeps pass 1 at O(sample) copies while
+                # binning off values bit-identical to the monolithic
+                # np.asarray(X[sample_idx], np.float64)
+                rows = sample_idx[lo_i:hi_i] - row0
+                sub = Xc[rows]
+                if not layout.is_libsvm:
+                    sub, _ = split_label_column(sub, li, layout.n_cols,
+                                                str(path))
+                sample[lo_i:hi_i] = np.asarray(sub, np.float64)
+                filled += hi_i - lo_i
+            stats.chunk_closed()
+        log.check(filled == len(sample_idx),
+                  f"ingest sample collected {filled} of "
+                  f"{len(sample_idx)} rows")
+        stats.sample_rows = len(sample_idx)
+        cat_set = set(int(c) for c in categorical_feature)
+        ds.build_mappers_from_sample(sample, config, cat_set)
+        del sample
+
+    # ---- pass 2: parse -> bin -> pack per chunk
+    writer = None
+    bins_out = None
+    if cache_out is not None:
+        from .cache import CacheWriter
+        writer = CacheWriter(cache_out, n, n_feat, ds.used_features,
+                             ds.bin_dtype(), rank=rank, world=world,
+                             source=None)
+    else:
+        bins_out = np.empty((n, len(ds.used_features)), ds.bin_dtype())
+    try:
+        for row0, Xc, yc in iter_chunks(layout, chunk_rows, sl.start,
+                                        sl.stop, start_offset=off0):
+            stats.chunk_opened(len(Xc))
+            Xf = _features_of(Xc, yc, row0)
+            packed = ds.bin_rows(Xf)
+            if writer is not None:
+                writer.append_rows(packed)
+            else:
+                bins_out[row0:row0 + len(packed)] = packed
+            stats.chunk_closed()
+    except BaseException:
+        if writer is not None:
+            writer.abort()
+        raise
+
+    side = load_sidecars(str(path), sl, rank, num_machines)
+    if label is not None:
+        ds.metadata.set_label(label)
+    if "weight" in side:
+        ds.metadata.set_weight(side["weight"])
+    if "group" in side:
+        ds.metadata.set_group(side["group"])
+    if "init_score" in side:
+        ds.metadata.set_init_score(side["init_score"])
+    if config.monotone_constraints:
+        mc = np.asarray(config.monotone_constraints, dtype=np.int32)
+        log.check(mc.size == n_feat, "monotone_constraints length mismatch")
+        ds.monotone_constraints = mc
+
+    if writer is not None:
+        from ..binning import mappers_digest
+        from .cache import (dataset_meta, load_dataset_cache,
+                            source_fingerprint)
+        writer.source = source_fingerprint(
+            str(path),
+            dataset_params_digest(config, categorical_feature))
+        writer.finalize(
+            dataset_meta(ds), mappers_digest=mappers_digest(ds.mappers),
+            extra={"reference_binned": bool(ds.reference_binned)})
+        cached = load_dataset_cache(cache_out, verify=False, mmap=True,
+                                    expect_rank=rank, expect_world=world)
+        cached.ingest_stats = dict(stats.to_dict(), source="text+cache",
+                                   cache_path=str(cache_out), cache_hit=0)
+        cached.streamed = True
+        log.info("Streamed ingest wrote cache %s (%d rows, %d chunks)",
+                 cache_out, n, stats.chunks)
+        return cached, label, side
+
+    ds.bins = bins_out
+    ds.streamed = True
+    ds.ingest_stats = stats.to_dict()
+    log.info("Streamed ingest: %s -> %d rows x %d features in %d chunks "
+             "(max %d live)", path, n, len(ds.used_features),
+             stats.chunks, stats.max_live_chunks)
+    return ds, label, side
+
+
+def dataset_params_digest(config, categorical_feature=()) -> str:
+    """Digest over the dataset-defining parameters: a sidecar cache
+    built under different binning params must MISS, not silently serve
+    stale bins.  Keys derive from dataset._DATASET_DEFINING_KEYS (the
+    ONE binning-defining list, also round-tripped in the cache meta)
+    plus the load-shaping extras the cache cannot represent.
+    ``categorical_feature`` takes the RESOLVED index list — the Python
+    API passes categoricals via the Dataset constructor, which the
+    config key never sees, and a categorical change rebinbs every
+    affected feature."""
+    import hashlib
+    import json
+
+    from ..dataset import _DATASET_DEFINING_KEYS
+    keys = _DATASET_DEFINING_KEYS + (
+        "label_column", "categorical_feature", "monotone_constraints",
+        "linear_tree")
+    d = {k: getattr(config, k, None) for k in keys}
+    d["resolved_categorical_feature"] = sorted(
+        int(c) for c in (categorical_feature or ()))
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()).hexdigest()

@@ -12,6 +12,15 @@ Distributed loading (ref: dataset_loader.cpp:1015 rank partitioning) maps
 to ``rank``/``num_machines``: each host parses only its contiguous row
 slice; bin mappers must then be built from a shared sample or a reference
 dataset so shards agree (TpuDataset(reference=...)).
+
+Parsing is bounded: whole-file loads go through the native parser's
+streaming line reader (or the preallocated numpy fallback — no per-line
+Python list accumulation), and rank-sharded multi-process loads parse
+ONLY the rank's row slice via the resumable chunk iterator
+(ingest/chunker.py) instead of materializing the full file on every
+rank.  The fully streaming O(chunk)-RSS path is ingest/pipeline.py;
+this module remains the monolithic "give me the shard as one array"
+surface.
 """
 from __future__ import annotations
 
@@ -39,6 +48,116 @@ def _label_spec(label_column, header_names):
     return int(s)
 
 
+def query_sidecar_path(path: str) -> Optional[str]:
+    return next((path + sfx for sfx in (".query", ".group")
+                 if os.path.exists(path + sfx)), None)
+
+
+# last-parsed query sidecar, keyed by (path, mtime_ns, size): the rank
+# slice computation AND the sidecar loader both need the sizes, and a
+# ranking file can carry millions of queries — parse once per file state
+_QUERY_SIZES_CACHE: dict = {}
+
+
+def _query_sizes(path: str) -> np.ndarray:
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _QUERY_SIZES_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    vals = np.loadtxt(path, dtype=np.float64, ndmin=1)
+    _QUERY_SIZES_CACHE.clear()      # keep exactly one entry live
+    _QUERY_SIZES_CACHE[path] = (key, vals)
+    return vals
+
+
+def compute_rank_slice(path: str, n_rows: int, rank: int,
+                       num_machines: int) -> slice:
+    """This rank's contiguous row slice of an ``n_rows``-row file
+    (reference pre_partition-style).  Ranking data: slice boundaries
+    ALIGN to query boundaries so every rank holds whole queries (ref:
+    metadata.cpp:141 CheckOrPartition — "Data partition error, data
+    didn't match queries" is a hard error there; here the partition is
+    computed query-aligned up front).  Shared by the monolithic loader
+    and the streaming ingest pipeline so both shard identically."""
+    if num_machines <= 1:
+        return slice(0, n_rows)
+    qside = query_sidecar_path(path)
+    if qside is not None:
+        sizes = _query_sizes(qside).astype(np.int64)
+        ends = np.cumsum(sizes)
+        if int(ends[-1]) != n_rows:
+            raise ValueError(
+                f"query sizes sum to {int(ends[-1])} but the file has "
+                f"{n_rows} rows")
+        cuts = [0]
+        for r in range(1, num_machines):
+            target = (r * n_rows) // num_machines
+            qi = int(np.searchsorted(ends, target, side="left"))
+            cuts.append(int(ends[min(qi, len(ends) - 1)]))
+        cuts.append(n_rows)
+        return slice(cuts[rank], cuts[rank + 1])
+    per = (n_rows + num_machines - 1) // num_machines
+    # clamp BOTH bounds: with more machines than rows the ceil division
+    # overshoots and an unclamped start would make the slice length
+    # negative (the downstream np.empty allocations need >= 0; the
+    # overflow ranks legitimately hold an empty shard)
+    return slice(min(n_rows, rank * per), min(n_rows, (rank + 1) * per))
+
+
+def load_sidecars(path: str, sl: slice, rank: int,
+                  num_machines: int) -> dict:
+    """Load ``<file>.weight``/``.query``/``.group``/``.init`` sidecars
+    sliced to this rank's rows (ref: src/io/metadata.cpp loaders +
+    CheckOrPartition group sharding)."""
+    side = {}
+    for suffix, key in ((".weight", "weight"), (".query", "group"),
+                        (".group", "group"), (".init", "init_score")):
+        sp = path + suffix
+        if not os.path.exists(sp):
+            continue
+        vals = (_query_sizes(sp) if key == "group"
+                else np.loadtxt(sp, dtype=np.float64, ndmin=1))
+        if key == "group":
+            if num_machines > 1:
+                # shard whole queries: keep those whose rows fall in
+                # this rank's slice (ref: metadata.cpp CheckOrPartition)
+                ends = np.cumsum(vals.astype(np.int64))
+                starts = ends - vals.astype(np.int64)
+                keep = (starts >= sl.start) & (ends <= sl.stop)
+                if not keep.any() or \
+                        int(vals[keep].sum()) != sl.stop - sl.start:
+                    log.warning(
+                        "rank %d row slice cuts through query "
+                        "boundaries; group sizes clipped to the slice",
+                        rank)
+                    clipped = (np.minimum(ends, sl.stop)
+                               - np.maximum(starts, sl.start))
+                    side[key] = clipped[clipped > 0]
+                else:
+                    side[key] = vals[keep].astype(np.int64)
+            else:
+                side[key] = vals.astype(np.int64)
+        else:
+            side[key] = vals[sl]
+        log.info("Loaded %s from %s", key, sp)
+    return side
+
+
+def split_label_column(data: np.ndarray, li: Optional[int],
+                       n_cols: int, path: str):
+    """Extract the label column from parsed dense rows -> (X, y)."""
+    if li is None or li < 0:
+        return data, None        # label_column < 0: no label column
+    if li >= n_cols:
+        raise ValueError(
+            f"label_column={li} out of range for {n_cols}-column file "
+            f"{path}")
+    y = data[:, li].copy()
+    X = np.delete(data, li, axis=1)
+    return X, y
+
+
 def load_text_file(path: str, label_column=None, rank: int = 0,
                    num_machines: int = 1, force_header: bool = None
                    ) -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
@@ -49,99 +168,39 @@ def load_text_file(path: str, label_column=None, rank: int = 0,
     ``has_header`` flag — an all-numeric header line would otherwise be
     misread as a data row).
     """
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    sep, n_rows, n_cols, is_libsvm, has_header = native.scan(path)
-    if force_header is not None and bool(force_header) != bool(has_header):
-        if force_header and not has_header:
-            n_rows -= 1   # the scan counted the numeric header as data
-        elif has_header and not force_header:
-            n_rows += 1
-        has_header = bool(force_header)
+    from ..ingest.chunker import iter_chunks, scan_layout
+    layout = scan_layout(path, force_header=force_header)
+    n_rows, n_cols = layout.n_rows, layout.n_cols
     if n_rows == 0:
         raise ValueError(f"no data rows in {path}")
+    sl = compute_rank_slice(path, n_rows, rank, num_machines)
 
-    header_names = None
-    if has_header:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line and not line.startswith("#"):
-                    header_names = [t.strip() for t in line.split(sep)]
-                    break
-
-    if is_libsvm:
+    if num_machines > 1:
+        # rank-sharded load: parse ONLY this rank's slice via the
+        # resumable chunk iterator (same native field parser) — a rank
+        # never materializes the rows it is about to throw away
+        n_local = sl.stop - sl.start
+        if layout.is_libsvm:
+            X = np.empty((n_local, n_cols - 1), np.float32)
+            y = np.empty((n_local,), np.float32)
+            for row0, Xc, yc in iter_chunks(layout, 1 << 18,
+                                            sl.start, sl.stop):
+                X[row0:row0 + len(Xc)] = Xc
+                y[row0:row0 + len(Xc)] = yc
+        else:
+            data = np.empty((n_local, n_cols), np.float32)
+            for row0, Xc, _ in iter_chunks(layout, 1 << 18,
+                                           sl.start, sl.stop):
+                data[row0:row0 + len(Xc)] = Xc
+            li = _label_spec(label_column, layout.header_names)
+            X, y = split_label_column(data, li, n_cols, path)
+    elif layout.is_libsvm:
         X, y = native.parse_libsvm(path, n_rows, n_cols)
     else:
-        data = native.parse_dense(path, sep, has_header, n_rows, n_cols)
-        li = _label_spec(label_column, header_names)
-        if li is None or li < 0:
-            X, y = data, None        # label_column < 0: no label column
-        elif li >= n_cols:
-            raise ValueError(
-                f"label_column={li} out of range for {n_cols}-column file "
-                f"{path}")
-        else:
-            y = data[:, li].copy()
-            X = np.delete(data, li, axis=1)
+        data = native.parse_dense(path, layout.sep, layout.has_header,
+                                  n_rows, n_cols)
+        li = _label_spec(label_column, layout.header_names)
+        X, y = split_label_column(data, li, n_cols, path)
 
-    # rank-sharded slice (contiguous, reference pre_partition-style).
-    # Ranking data: slice boundaries ALIGN to query boundaries so every
-    # rank holds whole queries (ref: metadata.cpp:141 CheckOrPartition —
-    # "Data partition error, data didn't match queries" is a hard error
-    # there; here the partition is computed query-aligned up front)
-    if num_machines > 1:
-        qside = next((path + sfx for sfx in (".query", ".group")
-                      if os.path.exists(path + sfx)), None)
-        if qside is not None:
-            sizes = np.loadtxt(qside, dtype=np.float64,
-                               ndmin=1).astype(np.int64)
-            ends = np.cumsum(sizes)
-            if int(ends[-1]) != n_rows:
-                raise ValueError(
-                    f"query sizes sum to {int(ends[-1])} but the file has "
-                    f"{n_rows} rows")
-            cuts = [0]
-            for r in range(1, num_machines):
-                target = (r * n_rows) // num_machines
-                qi = int(np.searchsorted(ends, target, side="left"))
-                cuts.append(int(ends[min(qi, len(ends) - 1)]))
-            cuts.append(n_rows)
-            sl = slice(cuts[rank], cuts[rank + 1])
-        else:
-            per = (n_rows + num_machines - 1) // num_machines
-            sl = slice(rank * per, min(n_rows, (rank + 1) * per))
-        X = X[sl]
-        y = None if y is None else y[sl]
-    else:
-        sl = slice(0, n_rows)
-
-    side = {}
-    for suffix, key in ((".weight", "weight"), (".query", "group"),
-                        (".group", "group"), (".init", "init_score")):
-        sp = path + suffix
-        if os.path.exists(sp):
-            vals = np.loadtxt(sp, dtype=np.float64, ndmin=1)
-            if key == "group":
-                if num_machines > 1:
-                    # shard whole queries: keep those whose rows fall in
-                    # this rank's slice (ref: metadata.cpp CheckOrPartition)
-                    ends = np.cumsum(vals.astype(np.int64))
-                    starts = ends - vals.astype(np.int64)
-                    keep = (starts >= sl.start) & (ends <= sl.stop)
-                    if not keep.any() or                             int(vals[keep].sum()) != sl.stop - sl.start:
-                        log.warning(
-                            "rank %d row slice cuts through query "
-                            "boundaries; group sizes clipped to the slice",
-                            rank)
-                        clipped = (np.minimum(ends, sl.stop)
-                                   - np.maximum(starts, sl.start))
-                        side[key] = clipped[clipped > 0]
-                    else:
-                        side[key] = vals[keep].astype(np.int64)
-                else:
-                    side[key] = vals.astype(np.int64)
-            else:
-                side[key] = vals[sl]
-            log.info("Loaded %s from %s", key, sp)
+    side = load_sidecars(path, sl, rank, num_machines)
     return X, y, side

@@ -198,11 +198,23 @@ def save_model_to_string(booster, start_iteration: int = 0,
     elif getattr(booster, "config", None) is not None:
         body += "\nparameters:\n"
         for kk, vv in booster.config.to_dict().items():
+            if kk in _INGEST_TRANSPORT_KEYS:
+                # data-loading transport knobs (chunked ingest, binary
+                # cache maintenance) select HOW the shard reached the
+                # device, never what was learned: the streamed/cached
+                # paths' bit-identical-serialization contract
+                # (docs/Data.md) requires they not echo, like `resume`
+                continue
             if isinstance(vv, list):
                 vv = ",".join(str(x) for x in vv)
             body += f"[{kk}: {vv}]\n"
         body += "end of parameters\n"
     return body
+
+
+# scrubbed from the serialized parameters block — see above
+_INGEST_TRANSPORT_KEYS = frozenset(
+    ("two_round", "ingest_chunk_rows", "ingest_prefetch", "save_binary"))
 
 
 def parse_model_string(model_str: str) -> Tuple[Dict[str, str],

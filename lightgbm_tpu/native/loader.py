@@ -96,6 +96,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.lgbt_parse_libsvm.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+        for name in ("lgbt_parse_dense_range", "lgbt_parse_libsvm_range"):
+            fn = getattr(lib, name, None)
+            if fn is None:
+                continue   # stale cached .so predating the range ABI
+            fn.restype = ctypes.c_int
+        if hasattr(lib, "lgbt_parse_dense_range"):
+            lib.lgbt_parse_dense_range.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
+        if hasattr(lib, "lgbt_parse_libsvm_range"):
+            lib.lgbt_parse_libsvm_range.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
         _LIB = lib
         return _LIB
 
@@ -131,7 +150,65 @@ def parse_dense(path: str, sep: str, has_header: bool, n_rows: int,
         if rc != 0:
             raise IOError(f"cannot parse {path} (rc={rc})")
         return out
-    return _parse_dense_numpy(path, sep, has_header)
+    return _parse_dense_numpy(path, sep, has_header, n_rows, n_cols)
+
+
+def parse_dense_range(path: str, sep: str, skip_header: bool, offset: int,
+                      max_rows: int, n_cols: int):
+    """Chunked resumable dense parse -> (X [rows, n_cols] float32,
+    next_offset).  Byte ``offset`` 0 starts at the file head (the header
+    is skipped only there); pass the returned ``next_offset`` back to
+    continue.  Routes through the SAME native field parser as
+    ``parse_dense`` so chunked ingest is bit-identical to the monolithic
+    load; falls back to the shared numpy line parser without one."""
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "lgbt_parse_dense_range"):
+        out = np.empty((max_rows, n_cols), np.float32)
+        rows = ctypes.c_int64(0)
+        nxt = ctypes.c_int64(0)
+        rc = lib.lgbt_parse_dense_range(
+            path.encode(), sep.encode(), int(skip_header), int(offset),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_rows, n_cols, ctypes.byref(rows), ctypes.byref(nxt))
+        if rc != 0:
+            raise IOError(f"cannot parse {path} at {offset} (rc={rc})")
+        return out[:rows.value], int(nxt.value)
+    return _parse_range_numpy(path, offset, max_rows, skip_header,
+                              lambda line, dst: _dense_line_numpy(
+                                  line, sep, dst), n_cols)
+
+
+def parse_libsvm_range(path: str, offset: int, max_rows: int,
+                       n_cols: int):
+    """Chunked resumable LibSVM parse -> (X [rows, n_cols-1] float32,
+    label [rows] float32, next_offset); file column 0 is the label,
+    zeros implicit."""
+    lib = get_lib()
+    n_feat = n_cols - 1
+    if lib is not None and hasattr(lib, "lgbt_parse_libsvm_range"):
+        out = np.empty((max_rows, n_feat), np.float32)
+        lab = np.empty((max_rows,), np.float32)
+        rows = ctypes.c_int64(0)
+        nxt = ctypes.c_int64(0)
+        rc = lib.lgbt_parse_libsvm_range(
+            path.encode(), int(offset),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_rows, n_feat, ctypes.byref(rows), ctypes.byref(nxt))
+        if rc != 0:
+            raise IOError(f"cannot parse {path} at {offset} (rc={rc})")
+        return out[:rows.value], lab[:rows.value], int(nxt.value)
+    labels = np.empty((max_rows,), np.float32)
+
+    def _line(line, dst):
+        row_idx = _line.i
+        _line.i += 1
+        dst[:] = 0.0
+        labels[row_idx] = _libsvm_line_numpy(line, dst)
+    _line.i = 0
+    X, nxt = _parse_range_numpy(path, offset, max_rows, False, _line,
+                                n_feat, zero_fill=True)
+    return X, labels[:len(X)], nxt
 
 
 def parse_libsvm(path: str, n_rows: int,
@@ -160,7 +237,12 @@ def _scan_numpy(path: str):
     with open(path) as f:
         first = True
         for line in f:
-            line = line.strip()
+            # line classification MUST match the C scanner and the
+            # (range) parsers: empty after CR/LF strip, or FIRST char
+            # '#' — a whole-line strip would skip whitespace-only lines
+            # the parsers count as (all-NaN) data rows, desynchronizing
+            # n_rows from the parse
+            line = line.rstrip("\r\n")
             if not line or line.startswith("#"):
                 continue
             if first:
@@ -196,30 +278,91 @@ def _scan_numpy(path: str):
     return sep, rows, (cols + 1 if libsvm else cols), libsvm, header
 
 
-def _parse_dense_numpy(path: str, sep: str, has_header: bool) -> np.ndarray:
-    rows = []
-    with open(path) as f:
-        first = True
-        for line in f:
-            line = line.strip()
+def _dense_line_numpy(line: str, sep: str, dst: np.ndarray) -> None:
+    """The ONE numpy-fallback dense row parser (missing/garbage fields
+    -> NaN, ragged lines NaN-padded) — the monolithic and chunked
+    fallbacks share it so they cannot drift."""
+    toks = line.split(sep)
+    n = len(dst)
+    for col in range(n):
+        if col < len(toks):
+            t = toks[col].strip()
+            try:
+                dst[col] = float(t) if t else np.nan
+            except ValueError:
+                dst[col] = np.nan
+        else:
+            dst[col] = np.nan
+
+
+def _libsvm_line_numpy(line: str, dst: np.ndarray) -> float:
+    toks = line.split()
+    try:
+        lab = float(toks[0])
+    except (ValueError, IndexError):
+        lab = 0.0
+    for t in toks[1:]:
+        if ":" not in t:
+            continue
+        k, v = t.split(":", 1)
+        try:
+            k = int(k)
+        except ValueError:
+            continue
+        if 0 <= k < len(dst):
+            try:
+                dst[k] = float(v)
+            except ValueError:
+                pass
+    return lab
+
+
+def _parse_range_numpy(path: str, offset: int, max_rows: int,
+                       skip_header: bool, line_fn, n_cols: int,
+                       zero_fill: bool = False):
+    """Bounded resumable line-at-a-time parse into a preallocated chunk
+    buffer -> (X[:rows], next_byte_offset). Reads in binary so byte
+    offsets are exact across encodings/newlines."""
+    out = np.empty((max_rows, n_cols), np.float32)
+    row = 0
+    with open(path, "rb") as f:
+        if offset > 0:
+            f.seek(offset)
+        consumed = offset
+        first = offset == 0
+        while row < max_rows:
+            raw = f.readline()
+            if not raw:
+                break
+            line = raw.decode("utf-8", "replace").rstrip("\r\n")
             if not line or line.startswith("#"):
+                consumed = f.tell()
                 continue
-            if first and has_header:
+            if first and skip_header:
                 first = False
+                consumed = f.tell()
                 continue
             first = False
-            vals = []
-            for t in line.split(sep):
-                t = t.strip()
-                try:
-                    vals.append(float(t))
-                except ValueError:
-                    vals.append(np.nan)
-            rows.append(vals)
-    n_cols = max(len(r) for r in rows)
-    out = np.full((len(rows), n_cols), np.nan, np.float32)
-    for i, r in enumerate(rows):
-        out[i, :len(r)] = r
+            if zero_fill:
+                out[row] = 0.0
+            line_fn(line, out[row])
+            row += 1
+            consumed = f.tell()
+    return out[:row], consumed
+
+
+def _parse_dense_numpy(path: str, sep: str, has_header: bool,
+                       n_rows: int, n_cols: int) -> np.ndarray:
+    """Whole-file fallback parse via the bounded line iterator: one
+    preallocated [n_rows, n_cols] float32 output, no per-line Python
+    list accumulation (the old form held every field as a boxed float —
+    ~25x the array's own RSS on wide files)."""
+    out, _ = _parse_range_numpy(
+        path, 0, n_rows, has_header,
+        lambda line, dst: _dense_line_numpy(line, sep, dst), n_cols)
+    if out.shape[0] != n_rows:
+        raise IOError(f"{path}: expected {n_rows} data rows, parsed "
+                      f"{out.shape[0]}")
     return out
 
 
@@ -232,13 +375,7 @@ def _parse_libsvm_numpy(path: str, n_rows: int, n_feat: int):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            toks = line.split()
-            y[i] = float(toks[0])
-            for t in toks[1:]:
-                k, v = t.split(":")
-                k = int(k)
-                if 0 <= k < n_feat:
-                    X[i, k] = float(v)
+            y[i] = _libsvm_line_numpy(line, X[i])
             i += 1
     return X, y
 

@@ -15,6 +15,13 @@
 //       and "na"/"nan"/"null" become NaN.
 //   lgbt_parse_libsvm(path, out, label_out, n_rows, n_cols)
 //       fills zeros + sparse values; column 0 of the file is the label.
+//   lgbt_parse_dense_range / lgbt_parse_libsvm_range
+//       chunked resumable variants (ref: utils/text_reader.h
+//       ReadPartAndParse): parse up to max_rows data rows starting at a
+//       byte offset, reporting rows parsed and the offset after the last
+//       consumed line, so a caller can stream a file in bounded chunks
+//       through EXACTLY the same field parser as the monolithic entry
+//       points (bit-identical values by construction).
 //
 // Build: g++ -O3 -shared -fPIC parser.cpp -o libparser.so   (see loader.py)
 
@@ -30,15 +37,19 @@
 namespace {
 
 // Buffered line reader (64 KB chunks, handles \r\n and missing trailing \n).
+// Tracks the byte offset of the NEXT unconsumed character so the chunked
+// range parsers can resume exactly where a previous call stopped.
 class LineReader {
  public:
-  explicit LineReader(FILE* f) : f_(f), pos_(0), len_(0), eof_(false) {}
+  explicit LineReader(FILE* f, int64_t base = 0)
+      : f_(f), pos_(0), len_(0), eof_(false), base_(base) {}
 
   bool next(std::string* line) {
     line->clear();
     for (;;) {
       if (pos_ >= len_) {
         if (eof_) return !line->empty();
+        base_ += static_cast<int64_t>(len_);
         len_ = fread(buf_, 1, sizeof(buf_), f_);
         pos_ = 0;
         if (len_ == 0) {
@@ -61,11 +72,14 @@ class LineReader {
     }
   }
 
+  int64_t offset() const { return base_ + static_cast<int64_t>(pos_); }
+
  private:
   FILE* f_;
   char buf_[1 << 16];
   size_t pos_, len_;
   bool eof_;
+  int64_t base_;
 };
 
 inline const char* skip_ws(const char* p) {
@@ -114,6 +128,48 @@ bool is_libsvm_token(const char* s, const char* end) {
   for (const char* p = s; p < colon; ++p)
     if (!isdigit(static_cast<unsigned char>(*p))) return false;
   return true;
+}
+
+// The ONE dense row parser: the monolithic and range entry points both
+// route here, so chunked ingest cannot drift from whole-file parsing.
+inline void parse_dense_line(const std::string& line, char sep,
+                             float* dst, int64_t n_cols) {
+  const char* q = line.c_str();
+  const char* endl = q + line.size();
+  int64_t col = 0;
+  while (q <= endl && col < n_cols) {
+    const char* e = static_cast<const char*>(memchr(q, sep, endl - q));
+    if (e == nullptr) e = endl;
+    if (!parse_float(q, e, &dst[col])) dst[col] = NAN;
+    ++col;
+    q = e + 1;
+  }
+  for (; col < n_cols; ++col) dst[col] = NAN;  // ragged line
+}
+
+// The ONE LibSVM row parser (dst must be pre-zeroed).
+inline void parse_libsvm_line(const std::string& line, float* dst,
+                              int64_t n_feat, float* label_out) {
+  const char* q = skip_ws(line.c_str());
+  const char* endl = line.c_str() + line.size();
+  const char* e = q;
+  while (e < endl && *e != ' ' && *e != '\t') ++e;
+  float lab = 0.0f;
+  parse_float(q, e, &lab);
+  *label_out = lab;
+  q = skip_ws(e);
+  while (q < endl) {
+    const char* colon = static_cast<const char*>(
+        memchr(q, ':', endl - q));
+    if (colon == nullptr) break;
+    const char* ve = colon + 1;
+    while (ve < endl && *ve != ' ' && *ve != '\t') ++ve;
+    int64_t idx = strtoll(std::string(q, colon).c_str(), nullptr, 10);
+    float v = 0.0f;
+    parse_float(colon + 1, ve, &v);
+    if (idx >= 0 && idx < n_feat) dst[idx] = v;
+    q = skip_ws(ve);
+  }
 }
 
 }  // namespace
@@ -217,22 +273,47 @@ int lgbt_parse_dense(const char* path, char sep, int skip_header,
       continue;
     }
     first = false;
-    const char* q = line.c_str();
-    const char* endl = q + line.size();
-    float* dst = out + row * n_cols;
-    int64_t col = 0;
-    while (q <= endl && col < n_cols) {
-      const char* e = static_cast<const char*>(memchr(q, sep, endl - q));
-      if (e == nullptr) e = endl;
-      if (!parse_float(q, e, &dst[col])) dst[col] = NAN;
-      ++col;
-      q = e + 1;
-    }
-    for (; col < n_cols; ++col) dst[col] = NAN;  // ragged line
+    parse_dense_line(line, sep, out + row * n_cols, n_cols);
     ++row;
   }
   fclose(f);
   return row == n_rows ? 0 : -2;
+}
+
+int lgbt_parse_dense_range(const char* path, char sep, int skip_header,
+                           int64_t offset, float* out, int64_t max_rows,
+                           int64_t n_cols, int64_t* rows_out,
+                           int64_t* next_offset) {
+  FILE* f = fopen(path, "rb");
+  if (f == nullptr) return -1;
+  if (offset > 0 && fseeko(f, offset, SEEK_SET) != 0) {
+    fclose(f);
+    return -1;
+  }
+  LineReader r(f, offset);
+  std::string line;
+  int64_t row = 0;
+  int64_t consumed = offset;
+  bool first = (offset == 0);  // the header can only sit at the file head
+  while (row < max_rows && r.next(&line)) {
+    if (line.empty() || line[0] == '#') {
+      consumed = r.offset();
+      continue;
+    }
+    if (first && skip_header) {
+      first = false;
+      consumed = r.offset();
+      continue;
+    }
+    first = false;
+    parse_dense_line(line, sep, out + row * n_cols, n_cols);
+    ++row;
+    consumed = r.offset();
+  }
+  fclose(f);
+  *rows_out = row;
+  *next_offset = consumed;
+  return 0;
 }
 
 int lgbt_parse_libsvm(const char* path, float* out, float* label_out,
@@ -245,31 +326,41 @@ int lgbt_parse_libsvm(const char* path, float* out, float* label_out,
   memset(out, 0, sizeof(float) * n_rows * n_feat);
   while (r.next(&line) && row < n_rows) {
     if (line.empty() || line[0] == '#') continue;
-    const char* q = skip_ws(line.c_str());
-    const char* endl = line.c_str() + line.size();
-    const char* e = q;
-    while (e < endl && *e != ' ' && *e != '\t') ++e;
-    float lab = 0.0f;
-    parse_float(q, e, &lab);
-    label_out[row] = lab;
-    q = skip_ws(e);
-    float* dst = out + row * n_feat;
-    while (q < endl) {
-      const char* colon = static_cast<const char*>(
-          memchr(q, ':', endl - q));
-      if (colon == nullptr) break;
-      const char* ve = colon + 1;
-      while (ve < endl && *ve != ' ' && *ve != '\t') ++ve;
-      int64_t idx = strtoll(std::string(q, colon).c_str(), nullptr, 10);
-      float v = 0.0f;
-      parse_float(colon + 1, ve, &v);
-      if (idx >= 0 && idx < n_feat) dst[idx] = v;
-      q = skip_ws(ve);
-    }
+    parse_libsvm_line(line, out + row * n_feat, n_feat, &label_out[row]);
     ++row;
   }
   fclose(f);
   return row == n_rows ? 0 : -2;
+}
+
+int lgbt_parse_libsvm_range(const char* path, int64_t offset, float* out,
+                            float* label_out, int64_t max_rows,
+                            int64_t n_feat, int64_t* rows_out,
+                            int64_t* next_offset) {
+  FILE* f = fopen(path, "rb");
+  if (f == nullptr) return -1;
+  if (offset > 0 && fseeko(f, offset, SEEK_SET) != 0) {
+    fclose(f);
+    return -1;
+  }
+  LineReader r(f, offset);
+  std::string line;
+  int64_t row = 0;
+  int64_t consumed = offset;
+  memset(out, 0, sizeof(float) * max_rows * n_feat);
+  while (row < max_rows && r.next(&line)) {
+    if (line.empty() || line[0] == '#') {
+      consumed = r.offset();
+      continue;
+    }
+    parse_libsvm_line(line, out + row * n_feat, n_feat, &label_out[row]);
+    ++row;
+    consumed = r.offset();
+  }
+  fclose(f);
+  *rows_out = row;
+  *next_offset = consumed;
+  return 0;
 }
 
 }  // extern "C"

@@ -169,6 +169,18 @@ class Telemetry:
         with self._lock:
             self._gauges[name] = value
 
+    def gauge_max(self, name: str, value: float) -> None:
+        """High-watermark gauge: keeps the maximum ever recorded.  For
+        series whose contract is a bound (peak live ingest chunks), a
+        plain set() from a later, smaller observation would silently
+        erase the violation the gauge exists to expose."""
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self._gauges.get(name)
+            if prev is None or value > prev:
+                self._gauges[name] = value
+
     def observe(self, name: str, seconds: float) -> None:
         if not self.enabled:
             return

@@ -9,9 +9,10 @@ route through these helpers.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
-from typing import Any
+from typing import Any, Iterator
 
 
 def _tmp_path(path: str) -> str:
@@ -42,6 +43,30 @@ def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
 
 def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
     atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+@contextlib.contextmanager
+def atomic_stream(path: str, fsync: bool = True) -> Iterator[Any]:
+    """Streaming variant of ``atomic_write_bytes`` for artifacts too big
+    to hold in memory (the ingest binary dataset cache writes its packed
+    bin matrix chunk by chunk): yields a binary file object positioned
+    at the temp sibling; on clean exit the temp is fsynced and renamed
+    into place, on ANY exception it is removed and ``path`` is left
+    untouched — a reader can never see a half-written artifact."""
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, "wb") as fh:
+            yield fh
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def atomic_write_json(path: str, obj: Any, fsync: bool = True) -> None:

@@ -140,9 +140,21 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   the same training with the live OpenMetrics exporter serving
     #   scrapes — the observability plane reads registry snapshots off
     #   the device path, so this too must EQUAL dispatches_per_iter.
+    # - ingest_dispatches_per_iter (bench.py --micro ingest leg): the
+    #   same training fed by chunked streaming ingest + the binary
+    #   cache — a data-loading plane that must not touch the fast
+    #   path, so this must EQUAL dispatches_per_iter;
+    # - ingest_chunks / ingest_max_live_chunks: the chunked pipeline's
+    #   deterministic chunk arithmetic and its bounded-host-residency
+    #   invariant (<= 2) — a buffering regression moves either;
+    # - ingest_model_mismatch: 0.0 while the streamed/cached model
+    #   serializes byte-equal to the monolithic text load (the ingest
+    #   bit-identity contract); zero-to-nonzero always flags.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
+                 "ingest_dispatches_per_iter", "ingest_chunks",
+                 "ingest_max_live_chunks", "ingest_model_mismatch",
                  "dispatches_per_request", "compiles_per_1k_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):

@@ -102,6 +102,7 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     ranks = set()
     iters: List[int] = []
     findings: List[Dict[str, Any]] = []
+    ingest: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -112,9 +113,23 @@ def summarize(records: List[Dict[str, Any]]) -> str:
         if r.get("event") in ("anomaly", "rank_divergence", "straggler",
                               "serve_batch_error", "recovery"):
             findings.append(r)
+        if r.get("event") == "ingest":
+            ingest.append(r)
     lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
     if iters:
         lines.append(f"iterations: {min(iters)}..{max(iters)}")
+    if ingest:
+        # one line per ingest (streamed/cached dataset build): source,
+        # chunk arithmetic, the bounded-residency watermark, cache hit
+        chunks = sum(int(r.get("chunks", 0)) for r in ingest)
+        rows = sum(int(r.get("rows", 0)) for r in ingest)
+        max_live = max(int(r.get("max_live_chunks", 0)) for r in ingest)
+        hits = sum(1 for r in ingest if r.get("cache_hit"))
+        srcs = sorted({str(r.get("source", "?")) for r in ingest})
+        lines.append(
+            f"ingest: {len(ingest)} dataset(s)  src={','.join(srcs)}  "
+            f"chunks={chunks}  rows={rows}  max_live={max_live}  "
+            f"cache_hits={hits}")
     lines.append("events:")
     for name, n in sorted(by_event.items(), key=lambda kv: -kv[1]):
         lines.append(f"  {name:<24} {n}")

@@ -1,0 +1,574 @@
+"""Streaming out-of-core ingest (lightgbm_tpu/ingest/): chunked
+bin-and-pack pipeline, sharded binary dataset cache, double-buffered
+host->device prefetch.
+
+The load-bearing contract: a model trained from the streamed and/or
+cached path serializes BYTE-EQUAL to one trained from the monolithic
+text load, while peak host-side chunk residency stays bounded
+(max_live_chunks <= 2)."""
+import json
+import os
+import pickle
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ingest.cache import (CacheError, cache_shard_path,
+                                       load_dataset_cache, read_manifest)
+from lightgbm_tpu.ingest.chunker import iter_chunks, scan_layout
+from lightgbm_tpu.ingest.prefetch import stream_to_device
+
+
+def _write_csv(path, X, y, header=False, sep=","):
+    with open(path, "w") as f:
+        if header:
+            cols = ["label"] + [f"f{i}" for i in range(X.shape[1])]
+            f.write(sep.join(cols) + "\n")
+        for i in range(len(y)):
+            vals = [f"{y[i]:g}"] + [
+                "" if np.isnan(v) else repr(float(v)) for v in X[i]]
+            f.write(sep.join(vals) + "\n")
+
+
+def _data(R=700, F=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(R, F).astype(np.float32)
+    X[::7, 2] = np.nan
+    X[:, 4] = rng.randint(0, 4, R)      # low-cardinality column
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture()
+def csv_file(tmp_path):
+    X, y = _data()
+    p = str(tmp_path / "train.csv")
+    _write_csv(p, X, y)
+    return p, X, y
+
+
+_PARAMS = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
+           "verbose": -1, "metric": "None", "bagging_fraction": 0.8,
+           "bagging_freq": 2, "feature_fraction": 0.7,
+           "min_data_in_leaf": 5}
+_DS = {"max_bin": 63, "verbose": -1}
+_STREAM = dict(_DS, two_round=True, ingest_chunk_rows=97)
+
+
+def _model_str(bst):
+    return bst.model_to_string(num_iteration=-1)
+
+
+# ------------------------------------------------------------- chunker
+@pytest.mark.parametrize("sep,header", [(",", False), ("\t", True)])
+def test_chunker_matches_monolithic_dense(tmp_path, sep, header):
+    from lightgbm_tpu.io.file_loader import load_text_file
+    X, y = _data(R=311)
+    p = str(tmp_path / "d.csv")
+    _write_csv(p, X, y, header=header, sep=sep)
+    Xm, ym, _ = load_text_file(p, label_column=0)
+    layout = scan_layout(p)
+    parts = [Xc for _, Xc, _ in iter_chunks(layout, 64)]
+    whole = np.concatenate(parts, axis=0)
+    # column 0 is the label in the raw parse
+    np.testing.assert_array_equal(whole[:, 0], ym)
+    np.testing.assert_array_equal(whole[:, 1:], Xm)
+
+
+def test_chunker_matches_monolithic_libsvm(tmp_path):
+    from lightgbm_tpu.io.file_loader import load_text_file
+    X, y = _data(R=200)
+    p = str(tmp_path / "d.svm")
+    with open(p, "w") as f:
+        for i in range(len(y)):
+            toks = [f"{y[i]:g}"]
+            for j, v in enumerate(X[i]):
+                if not np.isnan(v) and v != 0:
+                    toks.append(f"{j}:{v!r}")
+            f.write(" ".join(toks) + "\n")
+    Xm, ym, _ = load_text_file(p)
+    layout = scan_layout(p)
+    assert layout.is_libsvm
+    Xs, ys = [], []
+    for _, Xc, yc in iter_chunks(layout, 77):
+        Xs.append(Xc)
+        ys.append(yc)
+    np.testing.assert_array_equal(np.concatenate(Xs), Xm)
+    np.testing.assert_array_equal(np.concatenate(ys), ym)
+
+
+def test_chunker_slice_with_whitespace_and_comment_lines(tmp_path):
+    # a whitespace-only line is a DATA row (all-NaN) to the scan and
+    # both parsers; the slice skipper must count it identically or
+    # every rank>0 slice shifts (and indented '#' still means comment
+    # only when '#' is the FIRST char)
+    from lightgbm_tpu.io.file_loader import load_text_file
+    p = str(tmp_path / "w.csv")
+    with open(p, "w") as f:
+        f.write("1,10\n# c\n2,20\n   \n3,30\n\n4,40\n")
+    Xm, ym, _ = load_text_file(p, label_column=0)
+    assert Xm.shape[0] == 5          # 4 numeric + 1 whitespace NaN row
+    parts = [load_text_file(p, label_column=0, rank=r, num_machines=2)
+             for r in range(2)]
+    yall = np.concatenate([y for _, y, _ in parts])
+    np.testing.assert_array_equal(np.nan_to_num(yall, nan=-9),
+                                  np.nan_to_num(ym, nan=-9))
+    layout = scan_layout(p)
+    tail = np.concatenate([c for _, c, _ in iter_chunks(layout, 2, 3, 5)])
+    np.testing.assert_array_equal(tail[:, 0], [3.0, 4.0])
+
+
+def test_chunker_rank_slice(tmp_path):
+    X, y = _data(R=250)
+    p = str(tmp_path / "d.csv")
+    _write_csv(p, X, y)
+    layout = scan_layout(p)
+    parts = [Xc for _, Xc, _ in iter_chunks(layout, 50, start_row=90,
+                                            stop_row=201)]
+    whole = np.concatenate(parts, axis=0)
+    assert whole.shape[0] == 111
+    np.testing.assert_array_equal(whole[:, 0], y[90:201])
+
+
+# ------------------------------------------------- streamed bin parity
+def test_streamed_bins_and_mappers_bit_identical(csv_file):
+    from lightgbm_tpu.binning import mappers_digest
+    p, X, y = csv_file
+    mono = lgb.Dataset(p, params=dict(_DS)).construct()._inner
+    streamed = lgb.Dataset(p, params=dict(_STREAM)).construct()._inner
+    assert streamed.streamed
+    assert mappers_digest(mono.mappers) == mappers_digest(streamed.mappers)
+    np.testing.assert_array_equal(np.asarray(mono.bins),
+                                  np.asarray(streamed.bins))
+    np.testing.assert_array_equal(mono.metadata.label,
+                                  streamed.metadata.label)
+    stats = streamed.ingest_stats
+    assert stats["chunks"] > 2 and stats["rows"] == 2 * 700
+    assert stats["max_live_chunks"] <= 2
+
+
+def test_streamed_categorical_matches_monolithic(csv_file):
+    p, X, y = csv_file
+    mono = lgb.Dataset(p, params=dict(_DS),
+                       categorical_feature=[4]).construct()._inner
+    st = lgb.Dataset(p, params=dict(_STREAM),
+                     categorical_feature=[4]).construct()._inner
+    np.testing.assert_array_equal(np.asarray(mono.bins),
+                                  np.asarray(st.bins))
+    assert bool(st.is_categorical[st.used_features.index(4)
+                                  if 4 in st.used_features else 0]) == \
+        bool(mono.is_categorical[mono.used_features.index(4)
+                                 if 4 in mono.used_features else 0])
+
+
+def test_streamed_sidecars(tmp_path):
+    X, y = _data(R=300)
+    p = str(tmp_path / "t.csv")
+    _write_csv(p, X, y)
+    rng = np.random.RandomState(3)
+    w = rng.rand(300).astype(np.float64)
+    np.savetxt(p + ".weight", w)
+    mono = lgb.Dataset(p, params=dict(_DS)).construct()._inner
+    st = lgb.Dataset(p, params=dict(_STREAM)).construct()._inner
+    np.testing.assert_array_equal(mono.metadata.weight,
+                                  st.metadata.weight)
+
+
+# ------------------------------------------------- model bit-identity
+def test_streamed_model_bit_identical_sync_driver(csv_file):
+    p, _, _ = csv_file
+    params = dict(_PARAMS, tpu_fast_path=False)
+    m1 = lgb.train(dict(params), lgb.Dataset(p, params=dict(_DS)),
+                   num_boost_round=10)
+    m2 = lgb.train(dict(params), lgb.Dataset(p, params=dict(_STREAM)),
+                   num_boost_round=10)
+    assert _model_str(m1) == _model_str(m2)
+
+
+def test_streamed_model_bit_identical_fast_path(csv_file):
+    p, _, _ = csv_file
+    m1 = lgb.train(dict(_PARAMS), lgb.Dataset(p, params=dict(_DS)),
+                   num_boost_round=10)
+    m2 = lgb.train(dict(_PARAMS), lgb.Dataset(p, params=dict(_STREAM)),
+                   num_boost_round=10)
+    assert _model_str(m1) == _model_str(m2)
+
+
+def test_streamed_model_bit_identical_megastep(csv_file):
+    # the megastep consumer (interpret-mode fused engine, explicit
+    # opt-in off-TPU) must drain the same model whether the bins came
+    # from the monolithic load or the chunked/cached ingest
+    p, _, _ = csv_file
+    params = dict(_PARAMS, tpu_engine="fused", tpu_megastep=True,
+                  num_leaves=7)
+    m1 = lgb.train(dict(params), lgb.Dataset(p, params=dict(_DS)),
+                   num_boost_round=6)
+    m2 = lgb.train(dict(params), lgb.Dataset(
+        p, params=dict(_STREAM, save_binary=True)), num_boost_round=6)
+    assert _model_str(m1) == _model_str(m2)
+
+
+# ------------------------------------------------------------- cache
+def test_cache_roundtrip_fields(tmp_path, csv_file):
+    p, X, y = csv_file
+    rng = np.random.RandomState(5)
+    w = rng.rand(700)
+    ds = lgb.Dataset(p, params=dict(_DS), weight=w)
+    cp = str(tmp_path / "c.bin")
+    ds.save_binary(cp)
+    mono = ds._inner
+    back = load_dataset_cache(cp)
+    assert back.streamed and back.ingest_stats["cache_hit"] == 1
+    assert isinstance(back.bins, np.memmap)
+    np.testing.assert_array_equal(np.asarray(back.bins),
+                                  np.asarray(mono.bins))
+    np.testing.assert_array_equal(back.metadata.label, mono.metadata.label)
+    np.testing.assert_array_equal(back.metadata.weight,
+                                  mono.metadata.weight)
+    assert back.feature_names == mono.feature_names
+    assert back.used_features == mono.used_features
+    m = read_manifest(cp)
+    assert m["num_data"] == 700 and m["format_version"] == 2
+
+
+def test_cache_hit_skips_text_parsing(tmp_path, csv_file, monkeypatch):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+
+    import lightgbm_tpu.io.file_loader as fl
+    import lightgbm_tpu.native.loader as nl
+
+    def _boom(*a, **k):
+        raise AssertionError("text parser invoked on a cache hit")
+    monkeypatch.setattr(fl, "load_text_file", _boom)
+    monkeypatch.setattr(nl, "scan", _boom)
+    ds = lgb.Dataset(cp, params={"verbose": -1})
+    ds.construct()
+    assert ds._inner.num_data == 700
+
+
+def test_cache_model_bit_identity(tmp_path, csv_file):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+    m1 = lgb.train(dict(_PARAMS), lgb.Dataset(p, params=dict(_DS)),
+                   num_boost_round=10)
+    m2 = lgb.train(dict(_PARAMS), lgb.Dataset(cp, params={"verbose": -1}),
+                   num_boost_round=10)
+    assert _model_str(m1) == _model_str(m2)
+
+
+def test_cache_corrupt_byte_detected(tmp_path, csv_file):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+    with open(cp, "r+b") as fh:
+        fh.seek(64)
+        b = fh.read(1)
+        fh.seek(64)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CacheError, match="hash mismatch"):
+        load_dataset_cache(cp)
+
+
+def test_cache_truncation_detected(tmp_path, csv_file):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+    with open(cp, "r+b") as fh:
+        fh.truncate(os.path.getsize(cp) - 33)
+    with pytest.raises(CacheError):
+        load_dataset_cache(cp)
+
+
+def test_cache_version_mismatch_refused(tmp_path, csv_file):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+    with open(cp, "rb") as fh:
+        data = fh.read()
+    mf_len, magic = struct.unpack("<Q8s", data[-16:])
+    mf = json.loads(data[-16 - mf_len:-16])
+    mf["format_version"] = 99
+    mfb = json.dumps(mf, sort_keys=True).encode()
+    with open(cp, "wb") as fh:
+        fh.write(data[:-16 - mf_len])
+        fh.write(mfb)
+        fh.write(struct.pack("<Q8s", len(mfb), magic))
+    with pytest.raises(CacheError, match="version"):
+        load_dataset_cache(cp)
+
+
+def test_cache_rank_layout_refused(tmp_path, csv_file):
+    p, _, _ = csv_file
+    cp = str(tmp_path / "c.bin")
+    lgb.Dataset(p, params=dict(_DS)).save_binary(cp)
+    with pytest.raises(CacheError, match="world"):
+        load_dataset_cache(cp, expect_world=4)
+    assert cache_shard_path("x.bin", 1, 4) == "x.bin.rank1of4"
+    assert cache_shard_path("x.bin", 0, 1) == "x.bin"
+
+
+def test_legacy_v1_cache_still_loads(tmp_path, csv_file):
+    p, _, _ = csv_file
+    mono = lgb.Dataset(p, params=dict(_DS)).construct()._inner
+    payload = {
+        "version": 1, "bins": np.asarray(mono.bins),
+        "mappers": [m.to_dict() for m in mono.mappers],
+        "used_features": mono.used_features,
+        "num_data": mono.num_data,
+        "num_total_features": mono.num_total_features,
+        "feature_names": mono.feature_names,
+        "label": mono.metadata.label, "weight": None,
+        "query_boundaries": None, "init_score": None,
+        "monotone_constraints": None,
+    }
+    cp = str(tmp_path / "legacy.bin")
+    with open(cp, "wb") as fh:
+        fh.write(b"LGBMTPU1")
+        pickle.dump(payload, fh, protocol=4)
+    ds = lgb.Dataset(cp, params={"verbose": -1})
+    ds.construct()
+    np.testing.assert_array_equal(np.asarray(ds._inner.bins),
+                                  np.asarray(mono.bins))
+
+
+def test_auto_cache_hit_and_staleness(tmp_path):
+    X, y = _data(R=400)
+    p = str(tmp_path / "a.csv")
+    _write_csv(p, X, y)
+    params = dict(_DS, save_binary=True)
+    ds1 = lgb.Dataset(p, params=dict(params))
+    ds1.construct()
+    cache = p + ".bin"
+    assert os.path.exists(cache)
+    # second construct with identical params/source: HIT
+    ds2 = lgb.Dataset(p, params=dict(params))
+    ds2.construct()
+    assert ds2._inner.ingest_stats["cache_hit"] == 1
+    np.testing.assert_array_equal(np.asarray(ds1._inner.bins),
+                                  np.asarray(ds2._inner.bins))
+    # a dataset-defining param change must MISS and rebuild (the
+    # rebuild re-caches under the NEW params digest)
+    ds3 = lgb.Dataset(p, params=dict(params, max_bin=31))
+    ds3.construct()
+    assert ds3._inner.ingest_stats is None \
+        or ds3._inner.ingest_stats.get("cache_hit") != 1
+    assert read_manifest(cache)["source"] is not None
+    ds4 = lgb.Dataset(p, params=dict(params, max_bin=31))
+    ds4.construct()
+    assert ds4._inner.ingest_stats["cache_hit"] == 1
+    # source edit must MISS too
+    with open(p, "a") as fh:
+        fh.write(",".join(["1"] + ["0.5"] * X.shape[1]) + "\n")
+    ds5 = lgb.Dataset(p, params=dict(params, max_bin=31))
+    ds5.construct()
+    assert ds5._inner.num_data == 401     # rebuilt from the new text
+
+
+# ----------------------------------------------------------- prefetch
+def test_prefetch_identical_to_one_shot(csv_file):
+    import jax.numpy as jnp
+    p, _, _ = csv_file
+    inner = lgb.Dataset(p, params=dict(_DS)).construct()._inner
+    bins = np.asarray(inner.bins)
+    out = stream_to_device(bins, 53)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(jnp.asarray(bins)))
+    assert out.dtype == jnp.asarray(bins).dtype
+
+
+def test_prefetch_bounded_residency_counters(tmp_path, csv_file):
+    p, _, _ = csv_file
+    tel_path = str(tmp_path / "tel.jsonl")
+    params = dict(_PARAMS, telemetry_out=tel_path)
+    bst = lgb.train(dict(params),
+                    lgb.Dataset(p, params=dict(_STREAM)),
+                    num_boost_round=4)
+    snap = bst.telemetry()
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    assert c.get("ingest.chunks", 0) > 0
+    assert c.get("ingest.rows", 0) == 2 * 700    # two streaming passes
+    assert c.get("prefetch.chunks", 0) > 1
+    assert "prefetch.host_wait_ms" in c
+    assert 0 < g.get("ingest.max_live_chunks", 99) <= 2
+    events = [json.loads(line) for line in open(tel_path)]
+    ing = [e for e in events if e.get("event") == "ingest"]
+    assert ing and ing[0]["max_live_chunks"] <= 2
+
+
+def test_prefetch_disabled_falls_back(csv_file):
+    p, _, _ = csv_file
+    m1 = lgb.train(dict(_PARAMS),
+                   lgb.Dataset(p, params=dict(_STREAM)),
+                   num_boost_round=5)
+    m2 = lgb.train(dict(_PARAMS),
+                   lgb.Dataset(p, params=dict(_STREAM,
+                                              ingest_prefetch=False)),
+                   num_boost_round=5)
+    assert _model_str(m1) == _model_str(m2)
+
+
+def test_cache_as_valid_set_requires_aligned_mappers(tmp_path, csv_file):
+    from lightgbm_tpu import LightGBMError
+    p, X, y = csv_file
+    train_ds = lgb.Dataset(p, params=dict(_DS))
+    # a cache built with reference= the training data aligns and works
+    good = str(tmp_path / "valid_good.bin")
+    lgb.Dataset(p, params=dict(_DS), reference=train_ds) \
+        .construct()._inner.save_binary(good)
+    bst = lgb.train(dict(_PARAMS), train_ds, num_boost_round=3,
+                    valid_sets=[lgb.Dataset(good, reference=train_ds)])
+    assert bst.num_trees() == 3
+    # a cache binned standalone under DIFFERENT params must be refused
+    bad = str(tmp_path / "valid_bad.bin")
+    lgb.Dataset(p, params=dict(_DS, max_bin=17)).save_binary(bad)
+    with pytest.raises(LightGBMError, match="different mappers"):
+        lgb.Dataset(bad, reference=train_ds).construct()
+    # ... and a REFERENCE-BINNED cache must never train standalone (its
+    # bins follow another dataset's boundaries)
+    with pytest.raises(LightGBMError, match="reference"):
+        lgb.Dataset(good, params={"verbose": -1}).construct()
+
+
+def test_auto_cache_provenance_mismatch_rebuilds(tmp_path):
+    from lightgbm_tpu.ingest.cache import read_manifest as rm
+    X, y = _data(R=300)
+    p = str(tmp_path / "v.csv")
+    _write_csv(p, X, y)
+    train_ds = lgb.Dataset(p, params=dict(_DS))
+    params = dict(_DS, save_binary=True)
+    # sidecar written by a VALIDATION (reference-binned) construct...
+    lgb.Dataset(p, params=dict(params), reference=train_ds).construct()
+    assert rm(p + ".bin")["reference_binned"] is True
+    # ...must MISS for a standalone construct of the same file (which
+    # then re-caches with standalone provenance), never hit-and-raise
+    ds = lgb.Dataset(p, params=dict(params))
+    ds.construct()
+    assert not ds._inner.reference_binned
+    assert ds._inner.ingest_stats is None \
+        or ds._inner.ingest_stats.get("cache_hit") != 1
+    assert rm(p + ".bin")["reference_binned"] is False
+
+
+def test_auto_cache_misses_on_categorical_change(tmp_path):
+    # constructor-passed categoricals never reach the config key, so
+    # the fingerprint hashes the RESOLVED index list — changing it must
+    # MISS, not silently serve bins where the feature was (or was not)
+    # categorical
+    X, y = _data(R=300)
+    p = str(tmp_path / "c.csv")
+    _write_csv(p, X, y)
+    params = dict(_DS, save_binary=True)
+    lgb.Dataset(p, params=dict(params),
+                categorical_feature=[4]).construct()
+    ds2 = lgb.Dataset(p, params=dict(params))     # no categoricals now
+    ds2.construct()
+    assert ds2._inner.ingest_stats is None \
+        or ds2._inner.ingest_stats.get("cache_hit") != 1
+    ds3 = lgb.Dataset(p, params=dict(params))     # same resolution: HIT
+    ds3.construct()
+    assert ds3._inner.ingest_stats["cache_hit"] == 1
+
+
+def test_auto_cache_stale_reference_miss_not_error(tmp_path):
+    # a validation sidecar whose reference was rebuilt with different
+    # binning must rebuild (best-effort path), never abort training
+    X, y = _data(R=300)
+    p = str(tmp_path / "v2.csv")
+    _write_csv(p, X, y)
+    params = dict(_DS, save_binary=True)
+    t1 = lgb.Dataset(p, params=dict(_DS))
+    lgb.Dataset(p, params=dict(params), reference=t1).construct()
+    # reference rebuilt under different binning -> valid cache stale
+    t2 = lgb.Dataset(p, params=dict(_DS, max_bin=17))
+    v2 = lgb.Dataset(p, params=dict(params, max_bin=17), reference=t2)
+    v2.construct()                                 # no raise
+    assert v2._inner.num_data == 300
+
+
+def test_rank_slice_clamped_when_machines_exceed_rows(tmp_path):
+    from lightgbm_tpu.io.file_loader import (compute_rank_slice,
+                                             load_text_file)
+    X, y = _data(R=9)
+    p = str(tmp_path / "tiny.csv")
+    _write_csv(p, X, y)
+    total = 0
+    for r in range(8):
+        sl = compute_rank_slice(p, 9, r, 8)
+        assert sl.stop >= sl.start >= 0
+        total += sl.stop - sl.start
+        Xr, yr, _ = load_text_file(p, label_column=0, rank=r,
+                                   num_machines=8)
+        assert Xr.shape[0] == sl.stop - sl.start
+    assert total == 9
+
+
+def test_cache_write_failure_is_best_effort(tmp_path, monkeypatch,
+                                            csv_file):
+    from lightgbm_tpu.ingest.cache import CacheWriter
+    p, _, _ = csv_file
+
+    def _boom(self, packed):
+        raise OSError(28, "No space left on device")
+    monkeypatch.setattr(CacheWriter, "append_rows", _boom)
+    # streamed build with a failing cache writer: warns and re-streams
+    # into memory
+    ds = lgb.Dataset(p, params=dict(_STREAM, save_binary=True))
+    ds.construct()
+    assert ds._inner.num_data == 700
+    assert not os.path.exists(p + ".bin")
+    # monolithic build with a failing post-hoc cache write: warns only
+    ds2 = lgb.Dataset(p, params=dict(_DS, save_binary=True))
+    ds2.construct()
+    assert ds2._inner.num_data == 700
+
+
+# ----------------------------------------------------------- multiproc
+def test_launcher_sharded_cache_roundtrip(tmp_path):
+    """The multiproc launcher routes through per-rank cache shards:
+    run 1 (save_binary + two_round) writes <data>.bin.rank<r>of2 per
+    rank; run 2 cache-HITS both shards and trains the identical
+    model."""
+    from lightgbm_tpu.parallel import train_distributed
+    rng = np.random.RandomState(21)
+    n, F = 1200, 5
+    X = rng.rand(n, F)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",",
+               fmt="%.6f")
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.2, "verbose": -1}
+    dsp = {"label_column": 0, "verbose": -1, "two_round": True,
+           "ingest_chunk_rows": 256, "save_binary": True}
+    bst1 = train_distributed(params, str(train), num_processes=2,
+                             num_boost_round=5, devices_per_process=2,
+                             dataset_params=dict(dsp), timeout=600)
+    shards = [str(train) + f".bin.rank{r}of2" for r in range(2)]
+    for s in shards:
+        assert os.path.exists(s), s
+        assert read_manifest(s)["world"] == 2
+    mtimes = [os.path.getmtime(s) for s in shards]
+    bst2 = train_distributed(params, str(train), num_processes=2,
+                             num_boost_round=5, devices_per_process=2,
+                             dataset_params=dict(dsp), timeout=600)
+    # the caches were HIT, not rewritten
+    assert [os.path.getmtime(s) for s in shards] == mtimes
+    assert bst1.model_to_string(num_iteration=-1) \
+        == bst2.model_to_string(num_iteration=-1)
+
+
+# ----------------------------------------------------------- eligibility
+def test_linear_tree_falls_back_to_monolithic(csv_file):
+    p, _, _ = csv_file
+    ds = lgb.Dataset(p, params=dict(_STREAM, linear_tree=True))
+    ds.construct()
+    # fell back: raw data retained for the ridge fits, not streamed
+    assert not ds._inner.streamed
+    assert ds._inner.raw_data is not None

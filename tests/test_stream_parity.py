@@ -1,0 +1,170 @@
+"""Parity of the streaming dataset-construction surfaces against the
+monolithic build: the C-API push-rows protocol (capi_support._PushBuild
+— dense chunks, CSR chunks, SetField-during-build) and the CLI
+``task=save_binary`` -> reload round trip."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import capi_support as capi
+
+
+def _data(R=600, F=5, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(R, F)
+    X[X < 0.15] = 0.0                    # sparsity for the CSR leg
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    return np.ascontiguousarray(X), y
+
+
+def _train_model_str(ds, rounds=8):
+    bst = capi.booster_create(
+        ds, "objective=binary num_leaves=15 learning_rate=0.2 verbose=-1")
+    for _ in range(rounds):
+        capi.booster_update(bst)
+    return capi.booster_save_model_to_string(bst, 0, -1, 0)
+
+
+def _mono_ds(X, y):
+    ds = capi.dataset_create_from_mat(
+        X.ctypes.data, 1, X.shape[0], X.shape[1], 1,
+        "max_bin=63 verbose=-1", None)
+    yc = np.ascontiguousarray(y, np.float32)
+    capi.dataset_set_field(ds, "label", yc.ctypes.data, len(yc), 0)
+    return ds
+
+
+def test_push_rows_dense_matches_monolithic():
+    X, y = _data()
+    mono = _mono_ds(X, y)
+    push = capi.dataset_create_by_reference(mono, X.shape[0])
+    for lo in range(0, X.shape[0], 173):
+        chunk = np.ascontiguousarray(X[lo:lo + 173])
+        capi.dataset_push_rows(push, chunk.ctypes.data, 1,
+                               chunk.shape[0], X.shape[1], lo)
+    yc = np.ascontiguousarray(y, np.float32)
+    capi.dataset_set_field(push, "label", yc.ctypes.data, len(yc), 0)
+    assert _train_model_str(mono) == _train_model_str(push)
+
+
+def test_push_rows_set_field_during_build():
+    # SetField BEFORE the final chunk arrives is legal (the reference's
+    # streaming protocol): it is applied at finalize and must match
+    # setting it after construction
+    X, y = _data(R=400)
+    mono = _mono_ds(X, y)
+    push = capi.dataset_create_by_reference(mono, X.shape[0])
+    yc = np.ascontiguousarray(y, np.float32)
+    half = X.shape[0] // 2
+    first = np.ascontiguousarray(X[:half])
+    capi.dataset_push_rows(push, first.ctypes.data, 1, half,
+                           X.shape[1], 0)
+    # mid-build SetField (the build is not finalized yet)
+    capi.dataset_set_field(push, "label", yc.ctypes.data, len(yc), 0)
+    assert capi.dataset_num_data(push) == X.shape[0]   # declared size
+    rest = np.ascontiguousarray(X[half:])
+    capi.dataset_push_rows(push, rest.ctypes.data, 1, X.shape[0] - half,
+                           X.shape[1], half)
+    assert _train_model_str(mono) == _train_model_str(push)
+
+
+def test_push_rows_missing_chunk_refused():
+    X, y = _data(R=300)
+    mono = _mono_ds(X, y)
+    push = capi.dataset_create_by_reference(mono, X.shape[0])
+    first = np.ascontiguousarray(X[:100])
+    capi.dataset_push_rows(push, first.ctypes.data, 1, 100, X.shape[1], 0)
+    with pytest.raises(ValueError, match="never pushed"):
+        push.finalize()
+
+
+def test_push_rows_csr_matches_monolithic():
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = _data()
+    mono = _mono_ds(X, y)
+    push = capi.dataset_create_by_reference(mono, X.shape[0])
+    for lo in range(0, X.shape[0], 211):
+        chunk = sp.csr_matrix(X[lo:lo + 211])
+        indptr = np.ascontiguousarray(chunk.indptr, np.int32)
+        indices = np.ascontiguousarray(chunk.indices, np.int32)
+        vals = np.ascontiguousarray(chunk.data, np.float64)
+        capi.dataset_push_rows_by_csr(
+            push, indptr.ctypes.data, 2, indices.ctypes.data,
+            vals.ctypes.data, 1, len(indptr), len(vals), X.shape[1], lo)
+    yc = np.ascontiguousarray(y, np.float32)
+    capi.dataset_set_field(push, "label", yc.ctypes.data, len(yc), 0)
+    assert _train_model_str(mono) == _train_model_str(push)
+
+
+def test_capi_save_binary_roundtrip(tmp_path):
+    X, y = _data()
+    mono = _mono_ds(X, y)
+    cp = str(tmp_path / "capi.bin")
+    capi.dataset_save_binary(mono, cp)
+    reloaded = capi.dataset_create_from_file(cp, "verbose=-1", None)
+    assert _train_model_str(mono) == _train_model_str(reloaded)
+
+
+# ------------------------------------------------------------ CLI task
+def _write_csv(path, X, y):
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write(",".join([f"{y[i]:g}"]
+                             + [repr(float(v)) for v in X[i]]) + "\n")
+
+
+def test_cli_save_binary_reload_roundtrip(tmp_path):
+    from lightgbm_tpu.cli import main as cli_main
+    X, y = _data(R=500)
+    p = str(tmp_path / "t.csv")
+    _write_csv(p, X, y)
+    cli_main([f"task=save_binary", f"data={p}", "max_bin=63",
+              "verbose=-1"])
+    cache = p + ".bin"
+    assert os.path.exists(cache)
+
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
+              "verbose": -1, "metric": "None"}
+    m_text = lgb.train(dict(params),
+                       lgb.Dataset(p, params={"max_bin": 63,
+                                              "verbose": -1}),
+                       num_boost_round=8)
+    m_cache = lgb.train(dict(params),
+                        lgb.Dataset(cache, params={"verbose": -1}),
+                        num_boost_round=8)
+    assert m_text.model_to_string(num_iteration=-1) \
+        == m_cache.model_to_string(num_iteration=-1)
+
+
+def test_cli_save_binary_explicit_output(tmp_path):
+    from lightgbm_tpu.cli import main as cli_main
+    from lightgbm_tpu.ingest.cache import read_manifest
+    X, y = _data(R=300)
+    p = str(tmp_path / "t.csv")
+    _write_csv(p, X, y)
+    out = str(tmp_path / "elsewhere.bin")
+    cli_main([f"task=save_binary", f"data={p}", f"output_model={out}",
+              "verbose=-1"])
+    assert read_manifest(out)["num_data"] == 300
+    ds = lgb.Dataset(out, params={"verbose": -1})
+    ds.construct()
+    assert ds._inner.num_data == 300
+
+
+def test_cli_train_from_cache(tmp_path):
+    # the full CLI train task fed a cache artifact instead of text
+    from lightgbm_tpu.cli import main as cli_main
+    X, y = _data(R=400)
+    p = str(tmp_path / "t.csv")
+    _write_csv(p, X, y)
+    cli_main([f"task=save_binary", f"data={p}", "max_bin=63",
+              "verbose=-1"])
+    model_out = str(tmp_path / "model.txt")
+    cli_main([f"task=train", f"data={p}.bin", "objective=binary",
+              "num_iterations=5", "max_bin=63", "verbose=-1",
+              f"output_model={model_out}"])
+    assert os.path.exists(model_out)
+    bst = lgb.Booster(model_file=model_out)
+    assert bst.num_trees() == 5
