@@ -556,12 +556,132 @@ def run_micro() -> None:
         m_text.model_to_string(num_iteration=-1)
         != m_hit.model_to_string(num_iteration=-1))
     shutil.rmtree(ingest_dir, ignore_errors=True)
+    _emit()   # the ingest-leg counters are on stdout now
+
+    # ---- multiproc leg: 2 REAL processes x 2 virtual CPU devices over
+    # one gloo mesh, tree_learner=data on the fused engine with the
+    # megastep armed — the pod-scale fast path. The deterministic gate
+    # is the ABSOLUTE parity contract `mp_dispatches_per_iter ==
+    # dispatches_per_iter` (0.125 at defaults): the multi-chip megastep
+    # keeps the in-trace collectives inside the scan, so a multi-process
+    # run pays EXACTLY the single-device dispatch schedule; a regression
+    # back to the per-iteration sync driver (the pre-round-12 eviction)
+    # moves it to >= 3. `mp_ranks_agree` (1.0 = both ranks emitted the
+    # byte-identical model) guards SPMD consistency vacuity.
+    _RESULT["mp_dispatches_per_iter"] = None
+    _RESULT["mp_ranks_agree"] = None
+    try:
+        mp_rows = int(os.environ.get("BENCH_MICRO_MP_ROWS", n_rows))
+        reports = _micro_multiproc_leg(
+            X[:mp_rows], y[:mp_rows], n_iters,
+            dict({k: v for k, v in params.items()
+                  if k != "telemetry_out"}, tree_learner="data"))
+        mp_iters = max(1, int(reports[0]["iterations"]))
+        _RESULT["mp_dispatches_per_iter"] = round(
+            float(reports[0]["dispatches"]) / mp_iters, 4)
+        _RESULT["mp_ranks_agree"] = float(
+            reports[0]["model"] == reports[1]["model"])
+        _RESULT["mp_fast_path"] = bool(reports[0]["fast_path"])
+        _RESULT["mp_iterations_kept"] = mp_iters
+    except Exception as e:
+        print(f"multiproc leg failed: {e}", file=sys.stderr)
     for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ing):
         try:
             os.remove(p)
         except OSError:
             pass
     _emit()
+
+
+_MP_WORKER = '''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+cache = os.environ.get("JAX_CACHE_DIR", "/tmp/lgbm_tpu_jax_cache_bench")
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=int(sys.argv[2]), process_id=int(sys.argv[3]))
+import lightgbm_tpu as lgb
+
+train_path, out_path = sys.argv[4], sys.argv[5]
+params = json.loads(sys.argv[6])
+rounds = int(sys.argv[7])
+ds = lgb.Dataset(train_path, params={"label_column": 0, "verbose": -1,
+                                     "max_bin": 63})
+bst = lgb.train(dict(params, num_iterations=rounds), ds)
+g = bst._gbdt
+c = bst.telemetry().get("counters", {})
+with open(out_path, "w") as fh:
+    json.dump({"rank": jax.process_index(),
+               "dispatches": float(c.get("train.dispatches", 0)),
+               "iterations": int(c.get("iterations", rounds)),
+               "fast_path": bool(g._fast_path_ok()),
+               "model": bst.model_to_string()}, fh)
+'''
+
+
+def _micro_multiproc_leg(X, y, n_iters, params):
+    """Run the 2-process joint training and return both rank reports.
+    The worker subprocesses carry the REAL product path end to end
+    (loader rank-sharding -> MultiProcLayout -> shard_map growers in the
+    megastep scan); the parent only compares their reports."""
+    import socket
+    import subprocess
+    import tempfile
+    mp_dir = tempfile.mkdtemp(prefix="bench_micro_mp_")
+    train_csv = os.path.join(mp_dir, "train.csv")
+    with open(train_csv, "w") as fh:
+        for i in range(len(y)):
+            fh.write(",".join([f"{y[i]:g}"]
+                              + [repr(float(v)) for v in X[i]]) + "\n")
+    worker_py = os.path.join(mp_dir, "worker.py")
+    with open(worker_py, "w") as fh:
+        fh.write(_MP_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    wp = dict(params)
+    outs = [os.path.join(mp_dir, f"rank{i}.json") for i in range(2)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # ONLY the repo on the path (same rule as the multiproc tests): the
+    # package must be importable from the workers' cwd-less interpreter,
+    # and the axon TPU plugin breaks multiprocess CPU backends
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for i in range(2):
+        wp_i = dict(wp, telemetry_out=os.path.join(
+            mp_dir, f"tel_rank{i}.jsonl"))
+        procs.append(subprocess.Popen(
+            [sys.executable, worker_py, coord, "2", str(i), train_csv,
+             outs[i], json.dumps(wp_i), str(n_iters)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(
+                timeout=int(os.environ.get("BENCH_MICRO_MP_TIMEOUT",
+                                           1200)))
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"mp worker rank {i} exited {p.returncode}: "
+                    + err.decode(errors="replace")[-2000:])
+        reports = [json.load(open(o)) for o in outs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        import shutil as _sh
+        _sh.rmtree(mp_dir, ignore_errors=True)
+    # the model strings embed per-rank telemetry_out paths; normalize so
+    # rank agreement compares the MODEL, not the config echo
+    for i, r in enumerate(reports):
+        r["model"] = r["model"].replace(f"tel_rank{i}.jsonl",
+                                        "tel_rank.jsonl")
+    return reports
 
 
 def run_serve() -> None:

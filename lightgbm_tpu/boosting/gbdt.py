@@ -210,6 +210,13 @@ class GBDT:
         self.n_shards = 1
         self.axis_name = None
         self._par_fns: Dict[str, object] = {}
+        # measured in-trace collective profiles (ops/collectives.py):
+        # (count, bytes) recorded from the traced static shapes at the
+        # first call of each fresh grower jit — per fused iteration
+        # (fast step / megastep scan body, k trees) and per sync-driver
+        # grow call (one tree)
+        self._coll_per_iter = None
+        self._coll_per_grow = None
         # telemetry registry (obs/): disabled by default — every record
         # call is a single attribute check until telemetry_out or
         # record_telemetry enables it
@@ -475,10 +482,29 @@ class GBDT:
         """Effective time-attribution granularity. trace_out (spans come
         from synced sections) and the health auditor (needs the sync
         driver's per-iteration records) imply 'section' regardless of the
-        configured value."""
-        if self._trace_out or self._health is not None:
+        configured value — EXCEPT under the multi-chip megastep, where
+        the health audit moves to drain boundaries (_health_at_drain)
+        instead of evicting the one configuration that needs dispatch
+        amortization most."""
+        if self._trace_out:
+            return "section"
+        if self._health is not None and not self._health_at_drain():
             return "section"
         return self._tel_gran
+
+    def _health_at_drain(self) -> bool:
+        """Multi-process fused runs audit at drain boundaries: the model
+        list and score carries are host-synced there already, so the
+        hash allgather costs zero extra dispatches and the megastep
+        keeps its 1-dispatch-per-chunk contract (section times are not
+        collected on the fast path, so the straggler skew check reads
+        empty sections — drain wall times still land in the batch
+        record). The sync drivers (XLA growers, non-batch granularity)
+        keep the per-iteration audit with real section times."""
+        return (getattr(self, "mp", None) is not None
+                and getattr(self, "use_fused", False)
+                and bool(getattr(self.config, "tpu_mp_megastep", True))
+                and self._tel_gran == "batch")
 
     @contextlib.contextmanager
     def _sec(self, name: str):
@@ -1084,6 +1110,11 @@ class GBDT:
                         "data-parallel")
             self.telemetry.degrade("feature_parallel_multiproc_rows",
                                    requested="feature", to="data")
+            # megastep-taxonomy twin of the degrade event: names the
+            # remaining multi-process limitation in the same reason
+            # namespace the eviction matrix documents
+            self._report_eviction("engine:multiproc_feature_parallel_rows",
+                                  to="data")
             mode = "data"
         # feature-parallel composition: the FUSED feature engine keeps
         # the whole replicated layout (global feature indices), so EFB
@@ -1412,6 +1443,21 @@ class GBDT:
                 donate_argnums=_donate(1))
         raise KeyError(kind)
 
+    @contextlib.contextmanager
+    def _maybe_record_collectives(self, fresh: bool):
+        """Trace-time collective payload recorder around the FIRST call
+        of a fresh grower jit (tracing happens exactly once per jit
+        signature, so the recorded static shapes are the program's real
+        per-call collective schedule — ops/collectives.py). Yields the
+        recorder, or None when there is nothing to measure (serial mode
+        or an already-traced function)."""
+        if not fresh or self.parallel_mode == "serial":
+            yield None
+            return
+        from ..ops.collectives import CollectiveTrace
+        with CollectiveTrace() as rec:
+            yield rec
+
     def _grow_parallel(self, gh):
         """Sync-path tree growth through the mesh (driver semantics of
         ref: data_parallel_tree_learner.cpp:126-276 — local histograms,
@@ -1432,24 +1478,36 @@ class GBDT:
                            jnp.pad(gh[:, 2], (0, pad)), self.fused_nch)
             fm_pad = jnp.zeros((self.fused_f_oh,), bool) \
                 .at[:fm.shape[0]].set(fm)
+            fresh = "fused_sync" not in self._par_fns
             fn = self._get_par_fn("fused_sync")
-            tree, row_leaf = fn(self.fused_bins_T, gh_T, fm_pad, *extra)
+            with self._maybe_record_collectives(fresh) as rec:
+                tree, row_leaf = fn(self.fused_bins_T, gh_T, fm_pad,
+                                    *extra)
+            if rec is not None:
+                self._coll_per_grow = rec.profile
             return tree, row_leaf[:n]
         if self.use_cegb:
             extra.append(jnp.asarray(self.cegb_used))
         self._place_par_data()
+        fresh = "xla_sync" not in self._par_fns
         if self.parallel_mode == "feature":
             Fp = self.par_feats
             fm_pad = jnp.zeros((Fp,), bool).at[:fm.shape[0]].set(fm)
             fn = self._get_par_fn("xla_sync")
-            tree, row_leaf = fn(self.bins_par, gh, fm_pad, *extra)
+            with self._maybe_record_collectives(fresh) as rec:
+                tree, row_leaf = fn(self.bins_par, gh, fm_pad, *extra)
+            if rec is not None:
+                self._coll_per_grow = rec.profile
             return tree, row_leaf
         pad = self.par_rows - n
         gh_p = jnp.pad(gh, ((0, pad), (0, 0)))
         bins = (self.bundle_bins_par if getattr(self, "use_bundles", False)
                 else self.bins_par)
         fn = self._get_par_fn("xla_sync")
-        tree, row_leaf = fn(bins, gh_p, fm, *extra)
+        with self._maybe_record_collectives(fresh) as rec:
+            tree, row_leaf = fn(bins, gh_p, fm, *extra)
+        if rec is not None:
+            self._coll_per_grow = rec.profile
         return tree, row_leaf[:n]
 
     # ------------------------------------------------------------------
@@ -1470,6 +1528,8 @@ class GBDT:
         self._epi_fm_pad = None
         self._epi_bag_ones = None
         self._valid_upd_fns = None    # close over shrinkage/depth bound
+        self._coll_per_iter = None    # re-measured on the fresh traces
+        self._coll_per_grow = None
         engine = config.tpu_engine
         if engine == "auto":
             engine = "fused" if (self.on_tpu and HAS_PALLAS) else "xla"
@@ -1487,6 +1547,8 @@ class GBDT:
                      "engines; using xla")
             self.telemetry.degrade("engine_multiproc_needs_xla_or_fused",
                                    requested=config.tpu_engine, to="xla")
+            self._report_eviction("engine:multiproc_needs_xla_or_fused",
+                                  requested=str(config.tpu_engine))
             engine = "xla"
         if self.parallel_mode in ("voting", "feature") \
                 and engine not in ("xla", "fused"):
@@ -2441,12 +2503,25 @@ class GBDT:
             return False
         if self._fast_ok_cache is None:
             obj = self.objective
+            # the row-sharded distribution modes (data, voting) ride the
+            # fast path on the FUSED engine since round 12: the
+            # shard_map growers compose with the pipelined step and the
+            # megastep scan, and multi-process runs (one global mesh
+            # over the pod) keep the same trace — the histogram psum /
+            # vote exchange already lives inside the jit, so no
+            # per-iteration host collective remains (tpu_mp_megastep=
+            # false restores the pre-round-12 sync eviction for A/B).
+            # feature-parallel stays on the sync driver: its contract is
+            # bit-equality with the serial model (replicated rows), and
+            # the fast path's f32 leaf-value shrink would break it.
             self._fast_ok_cache = bool(
                 type(self) is GBDT
                 and bool(self.config.tpu_fast_path)
                 and self.use_fused
-                and getattr(self, "mp", None) is None
-                and self.parallel_mode in ("serial", "data")
+                and self.parallel_mode in ("serial", "data", "voting")
+                and (getattr(self, "mp", None) is None
+                     or bool(getattr(self.config, "tpu_mp_megastep",
+                                     True)))
                 and obj is not None
                 and not obj.is_renew_tree_output
                 and not bool(self.config.linear_tree)
@@ -2471,10 +2546,19 @@ class GBDT:
         if not bool(self.config.tpu_fast_path):
             return "config:tpu_fast_path=false"
         if not self.use_fused:
+            if getattr(self, "mp", None) is not None:
+                # the XLA growers' sync driver is the only multi-process
+                # path off the fused engine (the megastep composes with
+                # the shard_map growers through grow_tree_fused only)
+                return "engine:multiproc_xla_growers"
             return f"engine:{self.config.tpu_engine}"
-        if getattr(self, "mp", None) is not None:
-            return "multi_process"
-        if self.parallel_mode not in ("serial", "data"):
+        if getattr(self, "mp", None) is not None \
+                and not bool(getattr(self.config, "tpu_mp_megastep", True)):
+            return "config:tpu_mp_megastep=false"
+        if self.parallel_mode not in ("serial", "data", "voting"):
+            # feature-parallel: bit-equality with the serial model is its
+            # contract (replicated rows) — the fast path's f32 leaf-value
+            # shrink would break it, so it stays on the sync driver
             return f"tree_learner:{self.parallel_mode}"
         obj = self.objective
         if obj is None:
@@ -2588,16 +2672,23 @@ class GBDT:
         extra = int(self.config.tpu_extra_levels)
         interp = self.fused_interpret
 
-        # data-parallel: the grow + leaf-value lookup run inside a
-        # shard_map region (rows sharded, per-level histogram psum inside
-        # grow_tree_fused); the [L]-sized tree comes out replicated, the
-        # per-row delta row-sharded (ref composition:
+        # distributed modes on the fast path (data/voting — feature
+        # keeps the sync driver, its contract is bit-equality with the
+        # serial model): the grow + leaf-value lookup run inside a
+        # shard_map region (rows sharded, per-level histogram psum /
+        # vote exchange inside grow_tree_fused); the [L]-sized tree
+        # comes out replicated, the per-row delta row-sharded. Under a
+        # multi-process layout the SAME shard_map spans the global
+        # ICI/DCN mesh — the collectives cross processes inside the
+        # jit, so the megastep scan composes unchanged (ref:
         # data_parallel_tree_learner.cpp:185 reduces the FAST engine's
-        # histograms — the flagship kernel stays in play on the mesh)
-        par = self.parallel_mode == "data"
+        # histograms — the flagship kernel stays in play on the pod)
+        mode = self.parallel_mode
+        par = mode in ("data", "voting")
         if par:
             from jax.sharding import PartitionSpec as P
             axis = self.axis_name
+            top_k = int(self.config.top_k) if mode == "voting" else 0
 
             def grow_one(bins_T, gh_T, fm_pad):
                 tree, row_leaf = grow_tree_fused(
@@ -2611,7 +2702,8 @@ class GBDT:
                     bundle_col_bins=self.fused_bundle_col_bins,
                     bundle_cfg=self.fused_bundle_cfg,
                     interpret=interp, psum_axis=axis,
-                    mono_mode=getattr(self, "mono_mode", "basic"))
+                    mono_mode=getattr(self, "mono_mode", "basic"),
+                    parallel_mode=mode, top_k=top_k)
                 delta = table_lookup(row_leaf[None, :],
                                      tree.leaf_value * shrink,
                                      interpret=interp)[0]
@@ -2862,7 +2954,8 @@ class GBDT:
         else:
             grad_in, hess_in = self._get_gradients()
             grad_in, hess_in = self._bagging(self.iter, grad_in, hess_in)
-        if self._fast_step_fn is None:
+        fresh_step = self._fast_step_fn is None
+        if fresh_step:
             self._fast_step_fn = self._make_fast_step()
         F_oh = self.fused_f_oh
         if float(self.config.feature_fraction) >= 1.0:
@@ -2875,9 +2968,12 @@ class GBDT:
                 jnp.zeros((F_oh,), bool).at[:self.train_data.num_features]
                 .set(self._feature_mask()) for _ in range(k)])
         self.telemetry.inc("train.dispatches")
-        self.scores, trees = self._fast_step_fn(
-            self.fused_bins_T, self.scores, grad_in, hess_in,
-            self.bag_weight, fm_pads)
+        with self._maybe_record_collectives(fresh_step) as rec:
+            self.scores, trees = self._fast_step_fn(
+                self.fused_bins_T, self.scores, grad_in, hess_in,
+                self.bag_weight, fm_pads)
+        if rec is not None:
+            self._coll_per_iter = rec.profile
         return self._finish_fast_iter(trees, init_scores)
 
     def _finish_fast_iter(self, trees, init_scores):
@@ -3030,6 +3126,10 @@ class GBDT:
             # rounding)
             self._epi_carry = None
             scores = self.scores
+            # replay bins: the replicated copy single-process, the
+            # row-sharded global matrix under multi-process (the
+            # rank-local bins_dev cannot route the [k, Np] score carry)
+            replay_bins = self._train_bins_replay()
             for conv_i in range(stop_i + 1, len(converted)):
                 if es_cut is not None and conv_i > es_cut:
                     continue   # frozen tail: contributed nothing
@@ -3037,7 +3137,7 @@ class GBDT:
                 for tid, (_, dt, grew) in enumerate(iter_models):
                     if grew:
                         scores = self._add_tree_to_score(
-                            scores, self.bins_dev, dt, tid, scale=-1.0,
+                            scores, replay_bins, dt, tid, scale=-1.0,
                             bundle=self._train_bundle())
                         for vi in range(len(self.valid_scores)):
                             self.valid_scores[vi] = \
@@ -3079,6 +3179,31 @@ class GBDT:
         self._replay_drained_eval(flat_metrics, base_iter, len(flat),
                                   stop_i, es_cut)
         tel = self.telemetry
+        if tel.enabled and flat and self.parallel_mode != "serial":
+            # measured in-trace collective traffic of the drained batch:
+            # per-iteration (count, bytes) recorded from the scan's /
+            # fast step's STATIC traced shapes at compile time
+            # (ops/collectives.py) — the traced program runs its full
+            # static level schedule for every iteration, frozen or not,
+            # so the batch payload is per-iteration x iterations
+            meas = getattr(self, "_coll_per_iter", None)
+            if meas is not None:
+                tel.collective("psum_" + self.parallel_mode,
+                               meas[0] * len(flat), meas[1] * len(flat))
+        if tel.enabled and flat and self._health is not None \
+                and self._health_at_drain():
+            # drain-boundary health audit (multi-chip megastep): the
+            # model list just settled and every rank drains at the same
+            # iteration (SPMD), so the hash allgather pairs here with
+            # zero extra device dispatches. One audit per drain window
+            # that crossed a period boundary.
+            # exceptions propagate: a one-sided bail would desync every
+            # later host collective on the mesh (same contract as the
+            # sync driver's multi-process handler re-raising)
+            period = self._health.period
+            if period > 0 and any((base_iter + i + 1) % period == 0
+                                  for i in range(len(flat))):
+                self._health.check(self.iter - 1, self.models, {})
         if tel.enabled and flat and self._tel_granularity() == "batch":
             # batch-granularity record: one megastep/pipelined batch of
             # `len(flat)` iterations, wall time measured first-dispatch
@@ -3274,6 +3399,9 @@ class GBDT:
         reason = self._megastep_static_reason()
         if reason is not None:
             return False, reason
+        reason = self._mp_valid_agreement_reason()
+        if reason is not None:
+            return False, reason
         from ..metric.traced import build_plan
         plan, err = build_plan(self, include_training)
         if plan is None:
@@ -3284,6 +3412,36 @@ class GBDT:
         self._es_carry = None
         self._es_finished = False
         return True, None
+
+    def _mp_valid_agreement_reason(self) -> Optional[str]:
+        """Multi-process on-device eval requires IDENTICAL validation
+        data on every rank: the traced metrics read each rank's LOCAL
+        valid arrays inside the SPMD program, and divergent values would
+        freeze the early-stop latch at different iterations per rank —
+        silent model divergence with no collective to catch it. One
+        host allgather of a per-rank digest at precheck (not per
+        iteration) enforces the contract; None = agreed or not
+        applicable. SPMD: every rank runs the same precheck, so the
+        collective pairs."""
+        if getattr(self, "mp", None) is None or not self.valid_data:
+            return None
+        import hashlib
+        h = hashlib.sha256()
+        for vd in self.valid_data:
+            h.update(np.ascontiguousarray(
+                np.asarray(vd.bins)).tobytes())
+            md = vd.metadata
+            for arr in ((md.label, md.weight, md.init_score)
+                        if md is not None else ()):
+                if arr is not None:
+                    h.update(np.ascontiguousarray(
+                        np.asarray(arr, np.float64)).tobytes())
+        digest = np.frombuffer(h.digest(), np.uint8).copy()
+        allg = np.asarray(self.mp._allgather(digest)) \
+            .reshape(self.mp.process_count, -1)
+        if not bool((allg == allg[0]).all()):
+            return "engine:multiproc_divergent_valid_data"
+        return None
 
     def _megastep_static_reason(self) -> Optional[str]:
         """Megastep blockers beyond fast-path eligibility that are fixed
@@ -3407,7 +3565,8 @@ class GBDT:
         # (profile_dir / jax.profiler traces); free when no trace is on
         t_call0 = time.perf_counter() if fresh_fn else 0.0
         with jax.profiler.StepTraceAnnotation("megastep",
-                                              step_num=self.iter):
+                                              step_num=self.iter), \
+                self._maybe_record_collectives(fresh_fn) as coll_rec:
             if plan is None:
                 scores, vscores, trees_B = fn(
                     self.fused_bins_T, self.scores,
@@ -3425,6 +3584,10 @@ class GBDT:
                     tuple(self.valid_bins), tuple(self.valid_scores),
                     operands, self.bag_weight, fm_pads, iters_B,
                     self._plan_ops, self._es_carry)
+        if coll_rec is not None:
+            # the scan traces its body ONCE regardless of chunk length,
+            # so the recorded totals are the per-iteration schedule
+            self._coll_per_iter = coll_rec.profile
         if fresh_fn and self.telemetry.enabled:
             # the first call of a new chunk signature traces + compiles
             # synchronously before the async dispatch returns, so its
@@ -3874,20 +4037,32 @@ class GBDT:
         memory, per-class leaf counts, split-gain distribution stats."""
         tel = self.telemetry
         if self.parallel_mode != "serial":
-            # analytic estimate of the in-jit psum payloads this
-            # iteration's trees exchanged; each learner's profile is
-            # documented in parallel/ next to the shard_map it models
-            from ..parallel import collective_profile
-            for nl in nl_per_class:
-                if nl > 1:
-                    cnt, nbytes = collective_profile(
-                        self.parallel_mode, num_leaves=nl,
-                        num_features=self.train_data.num_features,
-                        max_bins=self.max_bins,
-                        top_k=int(self.config.top_k),
-                        leafwise=self.grow_policy == "leafwise")
-                    tel.collective("psum_" + self.parallel_mode,
-                                   cnt, nbytes)
+            # MEASURED in-jit psum payloads: (count, bytes) recorded
+            # from the grower's traced static shapes at its first call
+            # (ops/collectives.py), applied once per dispatched grow —
+            # the traced program runs its full static level schedule
+            # whether or not a tree dried up. Falls back to the analytic
+            # per-learner profile only before any grower has traced
+            # (cannot happen on this record path: _grow ran first).
+            k = self.num_tree_per_iteration
+            n_grown = (sum(1 for t in range(k) if self.class_need_train[t])
+                       if self.train_data.num_features > 0 else 0)
+            if self._coll_per_grow is not None and n_grown:
+                cnt, nbytes = self._coll_per_grow
+                tel.collective("psum_" + self.parallel_mode,
+                               cnt * n_grown, nbytes * n_grown)
+            else:
+                from ..parallel import collective_profile
+                for nl in nl_per_class:
+                    if nl > 1:
+                        cnt, nbytes = collective_profile(
+                            self.parallel_mode, num_leaves=nl,
+                            num_features=self.train_data.num_features,
+                            max_bins=self.max_bins,
+                            top_k=int(self.config.top_k),
+                            leafwise=self.grow_policy == "leafwise")
+                        tel.collective("psum_" + self.parallel_mode,
+                                       cnt, nbytes)
         extra = {"num_leaves": nl_per_class,
                  "bag_cnt": int(self.bag_cnt),
                  "engine": ("fused" if self.use_fused else

@@ -312,6 +312,15 @@ _PARAMS: List[_Param] = [
        desc="max boosting iterations fused into one megastep dispatch "
             "(capped by the pipeline drain batch, the num_iterations "
             "horizon and the current bagging round's window)"),
+    _p("tpu_mp_megastep", bool, True,
+       desc="let multi-process (multi-chip pod) training ride the "
+            "dispatch-amortized fast path and megastep: the shard_map-"
+            "wrapped fused growers run inside the scan over the global "
+            "ICI/DCN mesh, split sync and the voting exchange stay "
+            "in-trace XLA collectives, and host collectives (health "
+            "audit, checkpoints) fire only at drain boundaries. Off = "
+            "multi-process runs evict to the synchronous per-iteration "
+            "driver (pre-round-12 behavior, A/B switch)"),
     _p("tpu_traced_eval", bool, True,
        desc="evaluate the built-in metrics ON DEVICE inside the "
             "megastep scan (metric/traced.py) so lgb.train with eval "

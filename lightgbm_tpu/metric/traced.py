@@ -25,6 +25,19 @@ tables, per-slot rank positions, sum of weights) are precomputed on
 host exactly like the host metrics do, so the traced forms match the
 reference semantics bin-for-bin where the math is discrete (error
 counts, rank positions) and to float tolerance elsewhere.
+
+Multi-process (multi-chip megastep, round 12): the training-score carry
+is ROW-SHARDED over the global mesh, so a training metric's reductions
+are partitioned by GSPMD and finished with the compiler's own
+cross-chip psum — every rank sees the identical scalar. Valid-set
+arrays are REPLICATED per rank and must be identical on every rank
+(the driver enforces this with one digest allgather at precheck —
+`engine:multiproc_divergent_valid_data`); the metric values, and
+therefore the scan-native early-stop latch, are then identical on
+every rank by construction, with no per-iteration collective needed.
+The metric operands come from objects re-inited with the GLOBAL
+metadata (MultiProcLayout.global_metadata), so label statistics and
+weight sums are pod-wide, with pad rows carrying zero weight.
 """
 from __future__ import annotations
 

@@ -38,6 +38,7 @@ from ..ops.fused_level import (NCH_PRECISE, build_route_table,
                                max_slot_cap, route_pass, table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output, per_feature_gains_cm)
+from ..ops.collectives import record_psum
 from .learner import (FeatureMeta, NEG_INF, _masked_gain, _masked_scatter,
                       merge_best_over_shards, meta_is_cat,
                       mono_child_bounds, mono_inter_level_update,
@@ -241,7 +242,7 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         # global one (a psum would multiply by the shard count); voting:
         # the root is always a full exchange like the XLA growers
         if psum_axis is not None and parallel_mode != "feature":
-            hist0 = jax.lax.psum(hist0, psum_axis)
+            hist0 = record_psum(hist0, psum_axis)
     g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
     if use_bundles:
         v = bundle_plane_views(jnp.stack([g0, h0, c0], axis=-1),
@@ -441,7 +442,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                 bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=k_B,
                 f_oh=k_foh, nch=nch, interpret=interpret)
             if psum_axis is not None and not vote_live and not feat_par:
-                hist = jax.lax.psum(hist, psum_axis)
+                hist = record_psum(hist, psum_axis)
 
             # ---- voting exchange: rank local per-feature gains on the
             # smaller-child planes, psum the votes, and sum only the
@@ -474,7 +475,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                 W_vote = min(f_oh, 2 * top_k)
                 kth = jnp.sort(gains_loc, axis=1)[:, f_oh - k_v][:, None]
                 votes = (gains_loc >= kth) & jnp.isfinite(gains_loc)
-                votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)
+                votes = record_psum(votes.astype(jnp.int32), psum_axis)
                 score_f = jnp.sum(votes, axis=0)
                 _, w_idx = jax.lax.top_k(score_f, W_vote)
                 lvl_valid = jnp.zeros((f_oh,), bool).at[w_idx].set(True)
@@ -485,7 +486,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                     # differently than psum-then-decode — documented,
                     # bundles+voting only)
                     stack = jnp.stack([lg, lh, lc], axis=-1)
-                    sub = jax.lax.psum(jnp.take(stack, w_idx, axis=1),
+                    sub = record_psum(jnp.take(stack, w_idx, axis=1),
                                        psum_axis)
                     stack = jnp.zeros_like(stack).at[:, w_idx].set(sub)
                     sm_g, sm_h, sm_c = (stack[..., 0], stack[..., 1],
@@ -496,7 +497,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                     # — bit-identical to the data-parallel path when
                     # every column wins (top_k >= F)
                     hr = hist.reshape(k_foh, k_B, -1)
-                    sub = jax.lax.psum(jnp.take(hr, w_idx, axis=0),
+                    sub = record_psum(jnp.take(hr, w_idx, axis=0),
                                        psum_axis)
                     hr = jnp.zeros_like(hr).at[w_idx].set(sub)
                     hist = hr.reshape(k_foh * k_B, -1)

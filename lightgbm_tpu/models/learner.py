@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import build_histograms
+from ..ops.collectives import record_pmax, record_psum
 from ..ops.split import (BestSplit, SplitParams, best_numerical_split,
                          best_numerical_split_cm, best_split_cm,
                          calculate_leaf_output, leaf_gain)
@@ -418,7 +419,7 @@ def merge_best_over_shards(bs: BestSplit, axis: str,
     SplitInfo allreduce-max, expressed as pmax + winner-shard pick).
     Local feature indices are globalized with ``f_offset`` first."""
     g = bs.gain
-    gmax = jax.lax.pmax(g, axis)
+    gmax = record_pmax(g, axis)
     idx = jax.lax.axis_index(axis)
     big = jnp.int32(1 << 30)
     # earliest shard wins ties (matches the reference's rank order)
@@ -429,8 +430,8 @@ def merge_best_over_shards(bs: BestSplit, axis: str,
         m = mine if a.ndim == 1 else mine[:, None]
         z = jnp.where(m, a, jnp.zeros_like(a))
         if a.dtype == jnp.bool_:
-            return jax.lax.psum(z.astype(jnp.int32), axis) > 0
-        return jax.lax.psum(z, axis)
+            return record_psum(z.astype(jnp.int32), axis) > 0
+        return record_psum(z, axis)
 
     feat_g = jnp.where(bs.feature >= 0,
                        bs.feature + jnp.int32(f_offset), -1)
@@ -513,7 +514,7 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     B = max_bins
 
     def _psum(h):
-        return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
+        return record_psum(h, psum_axis) if psum_axis is not None else h
 
     # voting-parallel under LEAF-WISE growth (ref:
     # voting_parallel_tree_learner.cpp:151-184 — the reference's voting
@@ -545,14 +546,14 @@ def grow_tree_leafwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         k = min(top_k, F)
         kth = jnp.sort(gains, axis=1)[:, F - k][:, None]
         votes = (gains >= kth) & jnp.isfinite(gains)
-        votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)[0]
+        votes = record_psum(votes.astype(jnp.int32), psum_axis)[0]
         _, w_idx = jax.lax.top_k(votes, W_vote)
         if n_forced > 0:
             # forced-split features must always carry GLOBAL sums: the
             # forced gather reads the pool regardless of the vote
             # (duplicates in w_idx are harmless — same values re-set)
             w_idx = jnp.concatenate([w_idx, forced_feat])
-        sub = jax.lax.psum(jnp.take(hist_local[0], w_idx, axis=0),
+        sub = record_psum(jnp.take(hist_local[0], w_idx, axis=0),
                            psum_axis)
         hist2 = jnp.zeros_like(hist_local[0]).at[w_idx].set(sub)
         valid = jnp.zeros((F,), bool).at[w_idx].set(True)
@@ -1051,7 +1052,7 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
     W = min(F, 2 * top_k)
 
     def _psum(h):
-        return jax.lax.psum(h, psum_axis) if psum_axis is not None else h
+        return record_psum(h, psum_axis) if psum_axis is not None else h
 
     def _exchange(hist, parent_out):
         """Level histogram exchange -> (globally-valid hist, valid [F])."""
@@ -1072,10 +1073,10 @@ def grow_tree_depthwise(bins: jax.Array, gh: jax.Array, meta: FeatureMeta,
         k = min(top_k, F)
         kth = jnp.sort(gains, axis=1)[:, F - k][:, None]
         votes = (gains >= kth) & jnp.isfinite(gains)
-        votes = jax.lax.psum(votes.astype(jnp.int32), psum_axis)
+        votes = record_psum(votes.astype(jnp.int32), psum_axis)
         score_f = jnp.sum(votes, axis=0)                     # [F]
         _, w_idx = jax.lax.top_k(score_f, W)
-        sub = jax.lax.psum(jnp.take(hist, w_idx, axis=1), psum_axis)
+        sub = record_psum(jnp.take(hist, w_idx, axis=1), psum_axis)
         hist2 = jnp.zeros_like(hist).at[:, w_idx].set(sub)
         valid = jnp.zeros((F,), bool).at[w_idx].set(True)
         return hist2, valid

@@ -75,7 +75,12 @@ class HealthAuditor:
     registry it reports into); ``check`` must be called from the
     synchronous path on EVERY rank at the same iteration — the driver
     guarantees that by gating on ``(it + 1) % period`` of the shared
-    config.
+    config. Under the multi-chip megastep (round 12) the audit moves to
+    DRAIN boundaries instead of evicting the fast path: every rank
+    drains at the same iteration (SPMD), the model list is already
+    host-synced there, and the hash allgather pairs with the drain's
+    one sync — section times are empty on that path, so the straggler
+    skew check reads only drain wall times.
     """
 
     def __init__(self, telemetry, period: int,

@@ -1,0 +1,81 @@
+"""Trace-time measurement of in-jit collective payloads.
+
+The telemetry registry used to report the distributed growers' traffic
+from per-learner ANALYTIC estimates (``collective_profile``: num_leaves
+x histogram bytes). Those models drift from the lowered program — the
+fused grower's level schedule is static (level_caps), the voting
+exchange sums packed hi/lo channels, padding widths differ from the
+logical feature count. This module measures instead: every ``psum`` /
+``pmax`` the tree learners issue routes through :func:`record_psum` /
+:func:`record_pmax`, and while a :class:`CollectiveTrace` is active the
+wrapper accumulates the STATIC per-shard payload (aval size x itemsize)
+of each collective at trace time. Tracing happens exactly once per jit
+signature, so the driver activates a recorder around the FIRST call of
+each fresh grower/megastep function and caches the totals — the
+recorded figures are the real shapes XLA lowers, not a wire model
+(XLA may still fuse or reduce-scatter under the hood, the same caveat
+the estimates carried).
+
+Per-shard shapes ARE the reduced-tensor shapes (the recorder runs
+inside shard_map bodies), matching the reference's convention of
+counting the exchanged histogram payload
+(data_parallel_tree_learner.cpp:155-189).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class CollectiveTrace:
+    """Context manager accumulating (count, bytes) of every collective
+    traced while active. Nesting is not supported (the driver records
+    one fresh function at a time); re-entering replaces the active
+    recorder for its scope and restores the outer one on exit."""
+
+    _active: Optional["CollectiveTrace"] = None
+
+    def __init__(self):
+        self.count = 0
+        self.bytes = 0
+        self._outer: Optional["CollectiveTrace"] = None
+
+    def __enter__(self) -> "CollectiveTrace":
+        self._outer = CollectiveTrace._active
+        CollectiveTrace._active = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        CollectiveTrace._active = self._outer
+        self._outer = None
+        return None
+
+    @property
+    def profile(self):
+        return self.count, self.bytes
+
+    def _add(self, tree) -> None:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            a = leaf if hasattr(leaf, "dtype") else jnp.asarray(leaf)
+            self.count += 1
+            self.bytes += int(a.size) * int(a.dtype.itemsize)
+
+
+def _record(x) -> None:
+    rec = CollectiveTrace._active
+    if rec is not None:
+        rec._add(x)
+
+
+def record_psum(x, axis_name):
+    """``jax.lax.psum`` with trace-time payload accounting."""
+    _record(x)
+    return jax.lax.psum(x, axis_name)
+
+
+def record_pmax(x, axis_name):
+    """``jax.lax.pmax`` with trace-time payload accounting."""
+    _record(x)
+    return jax.lax.pmax(x, axis_name)

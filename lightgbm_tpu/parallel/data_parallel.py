@@ -74,6 +74,12 @@ def collective_profile(num_leaves: int, num_features: int, max_bins: int,
     """(count, bytes) estimate of one tree's in-jit histogram allreduce
     traffic under data-parallel growth, for the telemetry registry.
 
+    Since round 12 the registry records MEASURED traffic instead (every
+    grower psum/pmax routes through ops/collectives.record_psum, whose
+    trace-time recorder captures the real lowered shapes at the first
+    call of each fresh jit) — this analytic model remains only as the
+    documented fallback for paths that never traced a grower.
+
     The exchange is the reference's reduce-scatter of [F, B, 3] f32
     histograms (data_parallel_tree_learner.cpp:155-189), collapsed here
     into one ``psum`` per histogrammed node: leaf-wise growth histograms

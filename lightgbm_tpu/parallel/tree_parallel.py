@@ -126,7 +126,9 @@ def voting_collective_profile(num_leaves: int, num_features: int,
     per histogrammed node, a [F] int32 vote psum plus the 2*top_k
     winning features' [B, 3] f32 histogram columns
     (voting_parallel_tree_learner.cpp:151-184 GlobalVoting +
-    CopyLocalHistogram)."""
+    CopyLocalHistogram). Fallback only since round 12 — see
+    data_parallel.collective_profile on the measured recorder that
+    supersedes these estimates on every traced-grower path."""
     node_hists = max(1, int(num_leaves))
     per_node = (int(num_features) * 4
                 + 2 * int(top_k) * int(max_bins) * 3 * 4)

@@ -150,11 +150,18 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # - ingest_model_mismatch: 0.0 while the streamed/cached model
     #   serializes byte-equal to the monolithic text load (the ingest
     #   bit-identity contract); zero-to-nonzero always flags.
+    # - mp_dispatches_per_iter (bench.py --micro multiproc leg): the
+    #   2-process megastep over the gloo mesh — the multi-chip fast
+    #   path pays EXACTLY the single-device dispatch schedule
+    #   (mp_dispatches_per_iter == dispatches_per_iter, 0.125 at
+    #   defaults); an eviction back to the per-iteration sync driver
+    #   moves it to >= 3.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
                  "ingest_dispatches_per_iter", "ingest_chunks",
                  "ingest_max_live_chunks", "ingest_model_mismatch",
+                 "mp_dispatches_per_iter",
                  "dispatches_per_request", "compiles_per_1k_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):

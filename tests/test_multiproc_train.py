@@ -37,6 +37,16 @@ _WORKER = textwrap.dedent("""
     params = json.loads(sys.argv[7])
     test_mode = params.pop("__test_mode", None)
     rounds = params.pop("num_iterations", None) or 10
+    # __evict: one opaque user callback — the DOCUMENTED megastep
+    # eviction that keeps the serialized parameter block byte-identical
+    # (same pairing as tests/test_traced_eval._train_pair)
+    evict = params.pop("__evict", False)
+    # __tel: telemetry to a cwd-RELATIVE path (the launcher gives every
+    # rank its own cwd, so the serialized telemetry_out strings — and
+    # hence the model strings — stay byte-comparable across ranks)
+    tel = params.pop("__tel", None)
+    if tel:
+        params["telemetry_out"] = tel
     ds = lgb.Dataset(path, params={"label_column": 0, "verbose": -1,
                                    "max_bin": 63})
     valid_path = params.pop("__valid", None)
@@ -64,7 +74,9 @@ _WORKER = textwrap.dedent("""
             kw["valid_sets"] = [vds]
         if es_rounds:
             params = dict(params, early_stopping_round=es_rounds)
-        bst = lgb.train(dict(params, num_iterations=rounds), ds, **kw)
+        cbs = [(lambda env: None)] if evict else []
+        bst = lgb.train(dict(params, num_iterations=rounds), ds,
+                        callbacks=cbs, **kw)
         if test_mode == "rollback":
             bst.rollback_one_iter()
     g = bst._gbdt
@@ -72,15 +84,39 @@ _WORKER = textwrap.dedent("""
     pred = bst.predict(test[:, 1:])
     evals = [(d, nm, float(v)) for (d, nm, v, _)
              in (g.eval_metrics() if g.training_metrics else [])]
+    dpi = None
+    megasteps = 0
+    evictions = []
+    health_checks = []
+    if tel:
+        c = bst.telemetry().get("counters", {})
+        iters = max(1, int(c.get("iterations", rounds)))
+        dpi = float(c.get("train.dispatches", 0)) / iters
+        rank = jax.process_index()
+        tel_file = tel if rank == 0 else tel + ".rank%d" % rank
+        for line in open(tel_file):
+            r = json.loads(line)
+            if r.get("event") == "megastep":
+                megasteps += 1
+            elif r.get("event") == "megastep_evicted":
+                evictions.append(r.get("feature"))
+            elif r.get("event") == "health_check":
+                health_checks.append((r.get("iter"), r.get("ok")))
     report = {
         "rank": jax.process_index(),
         "evals": evals,
         "num_local_rows": int(ds._inner.num_data),
         "parallel_mode": g.parallel_mode,
         "use_fused": bool(getattr(g, "use_fused", False)),
+        "fast_path": bool(g._fast_path_ok()),
         "mp_active": g.mp is not None,
         "total_real": int(g.mp.total_real) if g.mp is not None else -1,
-        "num_trees": len(g.models),
+        "num_trees": bst.num_trees(),
+        "best_iteration": bst.best_iteration,
+        "dispatches_per_iter": dpi,
+        "megastep_batches": megasteps,
+        "evictions": evictions,
+        "health_checks": health_checks,
         "model": bst.model_to_string(),
         "pred": [float(v) for v in pred],
     }
@@ -103,13 +139,22 @@ def _launch(tmp_path, train, test_file, params, nproc=2):
     # CPU backends (process_count stays 1)
     env["PYTHONPATH"] = repo_root
     env.pop("XLA_FLAGS", None)
+    # per-rank working directories: cwd-relative telemetry paths stay
+    # byte-identical in the serialized params while each rank writes its
+    # own file (a shared path would race)
+    cwds = []
+    for i in range(nproc):
+        d = tmp_path / f"rank{i}_cwd"
+        d.mkdir(exist_ok=True)
+        cwds.append(str(d))
     procs = [subprocess.Popen(
         [sys.executable, str(script), coord, str(nproc), str(i),
          str(train), str(test_file), str(outs[i]), json.dumps(params)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        env=env, cwd=cwds[i], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
         for i in range(nproc)]
     for p in procs:
-        out, err = p.communicate(timeout=600)
+        out, err = p.communicate(timeout=1200)
         assert p.returncode == 0, err.decode()[-3000:]
     return [json.loads(o.read_text()) for o in outs]
 
@@ -415,6 +460,132 @@ def test_two_process_efb(tmp_path):
     assert reports[0]["model"] == reports[1]["model"]
     auc = _auc(y[n:], np.asarray(reports[0]["pred"]))
     assert auc > 0.85, auc
+
+
+def _megastep_files(tmp_path, n=2000, F=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n + 500, F)
+    y = (X[:, 0] + X[:, 1] * 1.5 > 1.0).astype(np.float64)
+    train = tmp_path / "train.csv"
+    valid = tmp_path / "valid.csv"
+    np.savetxt(train, np.column_stack([y[:n], X[:n]]), delimiter=",",
+               fmt="%.6f")
+    np.savetxt(valid, np.column_stack([y[n:], X[n:]]), delimiter=",",
+               fmt="%.6f")
+    return train, valid
+
+
+def _megastep_params(valid, tree_learner="data", **extra):
+    """The ISSUE 12 acceptance config: fused megastep, bagging +
+    feature_fraction + early stopping + a valid set, multi-process."""
+    p = {"objective": "binary", "num_leaves": 15, "num_iterations": 20,
+         "learning_rate": 0.2, "tree_learner": tree_learner,
+         "tpu_engine": "fused", "tpu_megastep": True, "verbose": -1,
+         "bagging_fraction": 0.8, "bagging_freq": 2,
+         "feature_fraction": 0.8, "metric": "binary_logloss",
+         # training metric: its traced reduction runs over the ROW-
+         # SHARDED score carry inside the scan (GSPMD finishes the sum
+         # across chips), the strongest sharded-eval composition
+         "is_provide_training_metric": True,
+         "__valid": str(valid), "__early_stopping": 3,
+         "__tel": "tel.jsonl"}
+    p.update(extra)
+    return p
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("learner", ["data", "voting"])
+def test_two_process_megastep_bit_identity(tmp_path, learner):
+    """ISSUE 12 acceptance: the 2-process multi-chip megastep (shard_map
+    growers inside the scan, in-trace collectives, on-device eval +
+    scan-native early stop) serializes BYTE-EQUAL to the per-iteration
+    driver — the same documented pairing every fast-path PR has held
+    (an opaque user callback evicts the megastep while keeping the
+    serialized parameter block identical), under bagging +
+    feature_fraction + early stopping, for data AND voting modes."""
+    train, valid = _megastep_files(tmp_path)
+    extra = {"top_k": 3} if learner == "voting" else {}
+    params = _megastep_params(valid, tree_learner=learner, **extra)
+    mega = _launch(tmp_path, train, valid, params)
+    evicted = _launch(tmp_path, train, valid, dict(params, __evict=True))
+
+    for r in mega + evicted:
+        assert r["mp_active"] and r["use_fused"] and r["fast_path"]
+        assert r["parallel_mode"] == learner
+    # the megastep actually engaged and amortized dispatches (one
+    # dispatch per bagging-bounded chunk, NOT >=3 per iteration)
+    assert mega[0]["megastep_batches"] >= 1, mega[0]
+    assert mega[0]["dispatches_per_iter"] < 1.0, mega[0]
+    # SPMD: every rank emits the identical model in both runs
+    assert mega[0]["model"] == mega[1]["model"]
+    assert evicted[0]["model"] == evicted[1]["model"]
+    # THE contract: fused chunk == per-iteration trajectory, byte-equal,
+    # including where early stopping latched
+    assert mega[0]["best_iteration"] == evicted[0]["best_iteration"]
+    assert mega[0]["model"] == evicted[0]["model"]
+    assert np.allclose(mega[0]["pred"], evicted[0]["pred"])
+    # final host-side training metrics agree across ranks and runs
+    # (byte-equal models => identical evals)
+    assert mega[0]["evals"] == mega[1]["evals"] == evicted[0]["evals"]
+    assert mega[0]["evals"], "training metric did not evaluate"
+
+
+@pytest.mark.slow
+def test_two_process_megastep_health_audit_at_drain(tmp_path):
+    """Tentpole (d): under the multi-chip megastep the HealthAuditor
+    moves to drain boundaries instead of evicting to the sync driver
+    (its hash allgather pairs with the drain's host sync, costing zero
+    extra dispatches). health_check_period=2 with one 8-iteration chunk
+    -> the run stays on the fast path and exactly ONE audit fires at
+    the drain (iteration 7), healthy on both ranks."""
+    train, valid = _megastep_files(tmp_path, n=1500)
+    params = {"objective": "binary", "num_leaves": 15,
+              "num_iterations": 8, "learning_rate": 0.2,
+              "tree_learner": "data", "tpu_engine": "fused",
+              "tpu_megastep": True, "verbose": -1,
+              "health_check_period": 2, "__tel": "tel.jsonl"}
+    reports = _launch(tmp_path, train, valid, params)
+    for r in reports:
+        assert r["mp_active"] and r["use_fused"] and r["fast_path"]
+        assert r["megastep_batches"] >= 1
+        assert r["dispatches_per_iter"] < 1.0, r
+        # one drain-boundary audit, healthy, identical on both ranks
+        assert r["health_checks"] == [[7, True]], r["health_checks"]
+    assert reports[0]["model"] == reports[1]["model"]
+
+
+@pytest.mark.slow
+def test_two_process_mp_megastep_off_evicts_to_sync_driver(tmp_path):
+    """The A/B switch: tpu_mp_megastep=false restores the pre-round-12
+    sync eviction — a structured `megastep_evicted` event names the
+    config key, the run pays per-iteration dispatches, and the model
+    matches the megastep run's tree structure with float-level score
+    drift only (the documented f32-vs-f64 shrinkage rounding between
+    the in-jit and host score updates, test_fast_pipeline contract)."""
+    train, valid = _megastep_files(tmp_path)
+    # 8 iterations: long enough for several bagging-bounded chunks,
+    # short enough that the ulp-level score drift between the two
+    # drivers cannot flip a split choice (structure equality holds)
+    params = _megastep_params(valid, num_iterations=8)
+    mega = _launch(tmp_path, train, valid, params)
+    sync = _launch(tmp_path, train, valid,
+                   dict(params, tpu_mp_megastep=False))
+    assert not sync[0]["fast_path"]
+    assert "config:tpu_mp_megastep=false" in sync[0]["evictions"], \
+        sync[0]["evictions"]
+    assert sync[0]["megastep_batches"] == 0
+    # per-iteration sync driver: gradients + grow + score update + valid
+    assert sync[0]["dispatches_per_iter"] >= 3.0, sync[0]
+    assert mega[0]["dispatches_per_iter"] < 1.0, mega[0]
+    # both drivers run the SAME shard_map grower: identical tree
+    # structure, score trajectories differ only by shrinkage rounding
+    assert sync[0]["model"] == sync[1]["model"]
+    import re
+    counts_m = re.findall(r"leaf_count=([\d ]+)", mega[0]["model"])
+    counts_s = re.findall(r"leaf_count=([\d ]+)", sync[0]["model"])
+    assert counts_m == counts_s and len(counts_m) > 0
+    assert np.abs(np.asarray(mega[0]["pred"])
+                  - np.asarray(sync[0]["pred"])).max() < 1e-4
 
 
 def test_two_process_valid_early_stop_weights_large_leaves(tmp_path):

@@ -219,3 +219,21 @@ def test_voting_ranks_categorical_splits(data):
     assert bst._gbdt.parallel_mode == "voting"
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(yc, bst.predict(Xc)) > 0.9
+
+
+def test_fast_path_reasons_distribution_modes(data):
+    """Round 12: data AND voting ride the fast path on the fused engine
+    (no eviction reason); feature-parallel keeps its serial-bit-equality
+    contract on the sync driver and names itself as the reason."""
+    X, y = data
+    Xs, ys = X[:512], y[:512]
+
+    def reason(extra):
+        ds = lgb.Dataset(Xs, label=ys, params={"verbose": -1})
+        b = lgb.Booster(params=dict(BASE, tpu_engine="fused", **extra),
+                        train_set=ds)
+        return b._gbdt._fast_path_reason()
+
+    assert reason({"tree_learner": "data"}) is None
+    assert reason({"tree_learner": "voting", "top_k": 3}) is None
+    assert reason({"tree_learner": "feature"}) == "tree_learner:feature"

@@ -161,6 +161,45 @@ def test_divergence_auto_repaired_not_just_logged(tmp_path):
     assert fault_marks and fault_marks[0]["kind"] == "diverge"
 
 
+@pytest.mark.chaos
+def test_multiproc_megastep_mid_chunk_crash_resume_byte_identity(tmp_path):
+    """ISSUE 12 chaos leg: a 2-process MULTI-CHIP MEGASTEP run (fused
+    interpret, shard_map growers inside the scan, bagging-bounded
+    chunks of 2, drain-boundary checkpoints every 4 iterations) with a
+    rank crash whose trigger iteration (5) lands MID-chunk — not on a
+    drain/checkpoint boundary. The launcher must respawn from the
+    newest consistent drain-boundary checkpoint (iteration 4), replay
+    the chunk interior deterministically (bagging streams restored from
+    the checkpoint), and emit the BYTE-IDENTICAL model of an uninjected
+    run."""
+    from lightgbm_tpu.parallel import train_distributed
+    train = _csv(tmp_path)
+    ck = tmp_path / "ck"
+    tel = tmp_path / "tel.jsonl"
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.2, "tree_learner": "data",
+              "tpu_engine": "fused", "tpu_megastep": True, "verbose": -1,
+              "bagging_fraction": 0.8, "bagging_freq": 2,
+              "telemetry_out": str(tel),
+              "checkpoint_dir": str(ck), "checkpoint_period": 4}
+    dsp = {"label_column": 0, "verbose": -1, "max_bin": 63}
+    ref = train_distributed(dict(params), str(train), num_processes=2,
+                            num_boost_round=12, dataset_params=dsp,
+                            timeout=900)
+    ref_str = ref.model_to_string(num_iteration=-1)
+    # the reference run actually rode the megastep (vacuity guard)
+    recs = [json.loads(line) for line in open(tel)]
+    assert any(r["event"] == "megastep" for r in recs), \
+        sorted({r["event"] for r in recs})
+    shutil.rmtree(ck)
+    tel.unlink()
+    bst = train_distributed(
+        dict(params), str(train), num_processes=2, num_boost_round=12,
+        dataset_params=dsp, timeout=900,
+        fault_env={"LIGHTGBM_TPU_FAULTS": "crash@5:rank=1"})
+    assert bst.model_to_string(num_iteration=-1) == ref_str
+
+
 def test_megastep_resume_bit_identity(tmp_path):
     """Drain-boundary checkpoints on the fused interpret megastep with
     the on-device-eval consumer (valid set + early stopping + logging):
