@@ -822,6 +822,129 @@ def run_serve() -> None:
     _RESULT["latency_ms"] = s2.get("latency_ms")
     _phase("serve_open_ok")
     svc.close()
+
+    # ---- overload leg: open-loop offered load >> capacity ----------
+    # The ROADMAP-mandated acceptance numbers: with a bounded queue, a
+    # deadline and a dispatch gate that makes capacity << offered load
+    # DETERMINISTIC on any runner, the service must shed/reject the
+    # excess with structured errors, keep the queue at its bound and
+    # leave ZERO futures unresolved.  shed_ratio is exact by
+    # construction (the queue fills to its request bound, the gate
+    # outlasts every queued deadline, so exactly the bound sheds);
+    # reject_ratio varies only by the first batch's coalesce count.
+    import threading as _threading
+
+    from lightgbm_tpu.serve import (ServeDeadlineExceeded, ServeError,
+                                    ServeRejected)
+    q_bound = int(os.environ.get("SERVE_OVERLOAD_QUEUE", 24))
+    n_offered = int(os.environ.get("SERVE_OVERLOAD_REQUESTS", 240))
+    svc3 = PredictionService({"m0": models["m0"]}, max_batch_rows=64,
+                             max_delay_ms=0.5, min_bucket_rows=16,
+                             batch_events=False,
+                             max_queue_requests=q_bound,
+                             default_deadline_ms=250.0)
+    svc3.warmup()
+    real_dispatch = svc3.batcher._dispatch
+    gate = _threading.Event()
+
+    def gated(mid, Xg):
+        gate.wait(5.0)
+        return real_dispatch(mid, Xg)
+    svc3.batcher._dispatch = gated
+    rng_o = np.random.RandomState(11)
+    reqs_o = [rng_o.rand(8, n_feat).astype(np.float32)
+              for _ in range(n_offered)]
+    done_lat = {}
+    futs_o, rejected = [], 0
+    for Xq in reqs_o:
+        try:
+            fut = svc3.submit("m0", Xq)
+            t_sub = time.perf_counter()
+            # keyed by the future itself: rejections interleave with
+            # admissions, so positional indices would mispair latencies
+            fut.add_done_callback(
+                lambda f, t=t_sub:
+                done_lat.__setitem__(id(f), time.perf_counter() - t))
+            futs_o.append(fut)
+        except ServeRejected:
+            rejected += 1
+    time.sleep(0.6)   # queued deadlines (250 ms) all expire
+    gate.set()
+    served = shed = unresolved = 0
+    for fut in futs_o:
+        try:
+            fut.result(timeout=60)
+            served += 1
+        except ServeDeadlineExceeded:
+            shed += 1
+        except ServeError:
+            shed += 1        # structured either way; bucket with shed
+        except Exception:
+            unresolved += 1
+    snap3 = svc3.tel.snapshot()
+    peak = int(snap3.get("gauges", {}).get("serve.queue_peak_requests",
+                                           0))
+    lat_ok = sorted(1000.0 * done_lat[id(f)] for f in futs_o
+                    if f.exception() is None and id(f) in done_lat)
+    _RESULT["shed_ratio"] = round(shed / n_offered, 6)
+    _RESULT["reject_ratio"] = round(rejected / n_offered, 6)
+    _RESULT["overload_p99_ms"] = round(
+        lat_ok[min(len(lat_ok) - 1,
+                   int(0.99 * (len(lat_ok) - 1) + 0.5))], 3) \
+        if lat_ok else None
+    _RESULT["overload_unresolved"] = unresolved
+    _RESULT["overload_queue_overflow"] = max(0, peak - q_bound)
+    _RESULT["overload_served"] = served
+    svc3.close(drain_timeout_s=10)
+    _phase("serve_overload_ok")
+
+    # ---- rollover-under-load leg -----------------------------------
+    # Continuous closed-loop traffic across a rollover(): the swap is
+    # one dict assignment under the residency lock, so the dropped-
+    # request count is deterministically ZERO (gated in serve-chaos CI
+    # and by bench_compare).
+    svc4 = PredictionService({"m": models["m0"]}, max_batch_rows=256,
+                             max_delay_ms=0.5, min_bucket_rows=16,
+                             batch_events=False)
+    svc4.warmup()
+    stop_t = _threading.Event()
+    roll_failures, roll_served = [], [0]
+
+    def _traffic(seed):
+        rt = np.random.RandomState(seed)
+        while not stop_t.is_set():
+            try:
+                svc4.predict("m", rt.rand(4, n_feat).astype(np.float32),
+                             timeout=60)
+                roll_served[0] += 1
+            except Exception as e:   # any failure IS the regression
+                roll_failures.append(repr(e))
+    traffic_threads = [_threading.Thread(target=_traffic, args=(21 + i,),
+                                         daemon=True) for i in range(2)]
+    for th in traffic_threads:
+        th.start()
+    time.sleep(0.2)
+    # the candidate must be a DIFFERENT model state so the hash-changed
+    # gate is meaningful even under SERVE_MODELS=1 (a one-tree-trimmed
+    # copy — no retraining cost)
+    if n_models > 1:
+        roll_to = models["m1"]
+    else:
+        m0 = models["m0"]
+        roll_to = lgb.Booster(model_str=m0.model_to_string(
+            num_iteration=max(1, m0.num_trees() - 1)))
+    roll_rep = svc4.rollover("m", roll_to)
+    time.sleep(0.2)
+    stop_t.set()
+    for th in traffic_threads:
+        th.join(timeout=30)
+    svc4.close(drain_timeout_s=30)
+    _RESULT["rollover_dropped_requests"] = len(roll_failures)
+    _RESULT["rollover_requests_served"] = roll_served[0]
+    _RESULT["rollover_hash_changed"] = float(
+        roll_rep["promoted"]
+        and roll_rep["old_hash"] != roll_rep["new_hash"])
+    _phase("serve_rollover_ok")
     _emit()
 
 

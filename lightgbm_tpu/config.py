@@ -407,6 +407,40 @@ _PARAMS: List[_Param] = [
             "(mem.d<id>.* gauges, the exporter's HBM-headroom series) "
             "at megastep drain and serving dispatch boundaries; "
             "backends without allocator stats (CPU) degrade to a no-op"),
+    # ---- Serving admission control (docs/Serving.md) ----
+    _p("serve_max_queue_rows", int, 0, ("serve_queue_rows",),
+       check=(">=", 0),
+       desc="admission control: max TOTAL rows queued in the "
+            "PredictionService micro-batcher; a submit that would "
+            "overflow raises a structured ServeRejected (reason, "
+            "retry_after_ms hint from the measured drain rate) "
+            "synchronously instead of growing the backlog without "
+            "bound. 0 = unbounded (the pre-overload-hardening "
+            "behavior). PredictionService(max_queue_rows=) overrides"),
+    _p("serve_max_queue_requests", int, 0, ("serve_queue_requests",),
+       check=(">=", 0),
+       desc="admission control: max queued REQUESTS in the "
+            "micro-batcher (companion bound to serve_max_queue_rows "
+            "for single-row traffic). 0 = unbounded. "
+            "PredictionService(max_queue_requests=) overrides"),
+    _p("serve_default_deadline_ms", float, 0.0, ("serve_deadline_ms",),
+       check=(">=", 0.0),
+       desc="service-level default request deadline: a queued request "
+            "older than this is SHED AT DEQUEUE with "
+            "ServeDeadlineExceeded — before any device work is spent "
+            "on it, never after. 0 = no deadline; submit(deadline_ms=) "
+            "overrides per request. "
+            "PredictionService(default_deadline_ms=) overrides"),
+    _p("serve_target_p99_ms", float, 0.0, ("serve_p99_target_ms",),
+       check=(">=", 0.0),
+       desc="arm the adaptive admission controller: drives the "
+            "micro-batcher's max_delay_ms, its batch-row cap (bucket "
+            "selection — smaller warmed power-of-two buckets under "
+            "pressure, zero fresh compiles) and a shed watermark under "
+            "the hard queue cap from the live serve.latency_ms p99 "
+            "ring, with consecutive-evaluation hysteresis so it "
+            "cannot flap. 0 = off (serving behavior unchanged). "
+            "PredictionService(target_p99_ms=) overrides"),
     # ---- Resilience (docs/Reliability.md) ----
     _p("checkpoint_dir", str, "", ("checkpoint_path",),
        desc="directory for resumable training checkpoints "
@@ -466,6 +500,15 @@ _PARAMS: List[_Param] = [
 ]
 
 _BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}
+
+
+def param_default(name: str) -> Any:
+    """Registered default of one parameter — the single source of truth
+    for constructor knobs that mirror config keys (PredictionService's
+    serve_* admission-control defaults) without paying a full Config
+    construction (and its global log-level side effect) per lookup."""
+    return _BY_NAME[name].default
+
 PARAM_ALIASES: Dict[str, str] = {}
 for _param in _PARAMS:
     for _a in _param.aliases:

@@ -21,7 +21,12 @@ Exposition (docs/Observability.md §10):
 Every series carries ``rank`` and ``run_id`` labels.  Endpoints:
 ``/metrics`` (the local registry; on rank 0 the fleet counter series —
 fed by the health auditor's existing allgather, zero new collectives —
-are appended with their origin rank's label), ``/healthz`` (liveness).
+are appended with their origin rank's label), ``/healthz`` (liveness)
+and ``/readyz`` (readiness: 503 until the owner's ``ready_check``
+passes — a PredictionService is ready only after ``warmup()`` compiled
+its buckets and flips unready during a rollover swap window, so
+external load balancers can drain correctly; exporters without a check
+report ready).
 
 Port discipline: under the multiproc launcher each rank binds
 ``metrics_port + rank``.  A port already in use degrades to an
@@ -159,6 +164,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/readyz":
+            # readiness is distinct from liveness: a serving process is
+            # alive the moment the exporter binds, but an external load
+            # balancer must not route to it until warmup() compiled the
+            # buckets — and must drain it during a rollover swap window.
+            # Exporters without a ready_check (training) report ready.
+            chk = self.exporter.ready_check
+            try:
+                ok, reason = (True, "ready") if chk is None else chk()
+            except Exception as e:    # a probe bug reads as unready
+                ok, reason = False, f"ready_check failed: {e}"
+            body = (str(reason) + "\n").encode("utf-8")
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_error(404)
 
@@ -170,11 +192,15 @@ class MetricsExporter:
     """Daemon-thread OpenMetrics endpoint over one Telemetry registry."""
 
     def __init__(self, telemetry, port: int, host: str = "127.0.0.1",
-                 extra_labels: Optional[Dict[str, Any]] = None):
+                 extra_labels: Optional[Dict[str, Any]] = None,
+                 ready_check=None):
         self.telemetry = telemetry
         self.requested_port = int(port)
         self.host = host
         self.extra_labels = dict(extra_labels or {})
+        # () -> (ok, reason) readiness probe behind GET /readyz; None =
+        # always ready (liveness == readiness, the training exporter)
+        self.ready_check = ready_check
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

@@ -44,7 +44,7 @@ def mint_trace_id() -> str:
 def begin_batch(model_id: str) -> Dict[str, Any]:
     ctx = {"model_id": str(model_id), "bucket": None,
            "dispatch_ms": 0.0, "dispatches": 0, "compiles": 0,
-           "degraded": False}
+           "degraded": False, "model_version": None}
     _tls.batch = ctx
     return ctx
 
@@ -53,10 +53,26 @@ def current() -> Optional[Dict[str, Any]]:
     return getattr(_tls, "batch", None)
 
 
+def begin_shadow() -> None:
+    """Suppress annotate() while a rollover candidate scores mirrored
+    traffic on the worker thread: the shadow engine's dispatch facts
+    (dispatch_ms, bucket, model_version) must not overwrite the LIVE
+    request's context — the live response came from the serving
+    engine, and its trace must say so."""
+    _tls.shadow = True
+
+
+def end_shadow() -> None:
+    _tls.shadow = False
+
+
 def annotate(**attrs: Any) -> None:
     """Merge engine-side facts into the open batch context (no-op when
     no batch is open — the engine also serves ``Booster.predict`` style
-    direct calls that carry no request identity)."""
+    direct calls that carry no request identity — or while a shadow
+    engine is scoring mirrored traffic)."""
+    if getattr(_tls, "shadow", False):
+        return
     ctx = current()
     if ctx is None:
         return
@@ -88,6 +104,13 @@ def emit_access(tel, req, ctx: Dict[str, Any], queue_ms: float,
     extra = {}
     if ctx.get("error"):
         extra["error"] = str(ctx["error"])   # failed requests trace too
+    if ctx.get("model_version"):
+        # rollover attribution: which packed model state produced THIS
+        # response (the rollover-under-load test's exactly-one-version
+        # contract reads this field)
+        extra["model_version"] = str(ctx["model_version"])
+    if ctx.get("shadow_divergence") is not None:
+        extra["shadow_divergence"] = float(ctx["shadow_divergence"])
     tel.inc("serve.access_records")
     tel.event("serve_access", trace_id=req.trace_id,
               model_id=req.model_id, rows=int(req.rows),

@@ -20,6 +20,22 @@ specs::
                         and skip the manifest — simulates a crash
                         mid-write; the selector must skip it
 
+Serve-plane faults (the unit is the micro-batcher's BATCH sequence
+number, not a training iteration; hooks fire from inside the worker's
+dispatch try-block, so an injected error resolves the batch's futures
+exactly like a real dispatch failure — serving chaos CI's triggers):
+
+    serve_slow_dispatch@2:ms=300   sleep 300 ms before dispatching
+                        batch 2 (default 250) — an overloaded/throttled
+                        device; deadline shedding must absorb the spike
+    serve_dispatch_error@3         raise ServeFaultError in batch 3's
+                        dispatch: the batch's futures must resolve with
+                        the error and the NEXT batch must serve fine
+    serve_wedge_worker@2           sleep "forever" inside batch 2's
+                        dispatch: close() must detect the wedged
+                        worker, fail queued+in-flight futures with
+                        ServeWorkerWedged and emit serve_worker_wedged
+
 Every fault fires at most once per *run lineage*: when
 ``LIGHTGBM_TPU_FAULT_STATE`` names a directory, a marker file records
 the firing so a respawned process (same env, fresh pid) does not
@@ -40,13 +56,23 @@ FAULT_STATE_ENV = "LIGHTGBM_TPU_FAULT_STATE"
 CRASH_EXIT_CODE = 43
 
 
-class Fault:
-    __slots__ = ("kind", "iteration", "rank")
+class ServeFaultError(RuntimeError):
+    """Injected serving dispatch failure (``serve_dispatch_error``):
+    raised inside the micro-batcher's dispatch try-block so the batch's
+    futures resolve with it exactly like a real device failure."""
 
-    def __init__(self, kind: str, iteration: int, rank: int = -1):
+
+class Fault:
+    __slots__ = ("kind", "iteration", "rank", "mods")
+
+    def __init__(self, kind: str, iteration: int, rank: int = -1,
+                 mods: Optional[Dict[str, str]] = None):
         self.kind = kind
         self.iteration = int(iteration)
         self.rank = int(rank)
+        # generic key=value modifiers past rank= (serve_slow_dispatch's
+        # ms=, future knobs) — parsed once, read by the hooks
+        self.mods: Dict[str, str] = dict(mods or {})
 
     def key(self) -> str:
         return f"{self.kind}@{self.iteration}.rank{self.rank}"
@@ -62,13 +88,17 @@ def parse_faults(spec: str) -> List[Fault]:
             head, *mods = part.split(":")
             kind, it = head.split("@", 1)
             rank = -1
+            extra: Dict[str, str] = {}
             for m in mods:
                 if m.startswith("rank="):
                     rank = int(m[5:])
-            faults.append(Fault(kind.strip(), int(it), rank))
+                elif "=" in m:
+                    mk, mv = m.split("=", 1)
+                    extra[mk.strip()] = mv.strip()
+            faults.append(Fault(kind.strip(), int(it), rank, extra))
         except (ValueError, IndexError):
             log.warning("ignoring malformed fault spec %r "
-                        "(expected kind@iteration[:rank=R])", part)
+                        "(expected kind@iteration[:rank=R][:k=v])", part)
     return faults
 
 
@@ -180,6 +210,56 @@ def maybe_diverge(gbdt, iteration: int) -> None:
     if f is not None:
         from . import recovery
         recovery.inject_divergence(gbdt, int(iteration))
+
+
+def _serve_event(telemetry, kind: str, batch: int, **attrs) -> None:
+    """Best-effort fault_injected event: the injection itself must not
+    depend on a healthy telemetry sink."""
+    if telemetry is None:
+        return
+    try:
+        telemetry.event("fault_injected", kind=kind, batch=batch, **attrs)
+    except Exception:
+        pass
+
+
+def on_serve_batch(telemetry, batch_index: int) -> None:
+    """Serve-plane fault hook, called by the micro-batcher INSIDE its
+    dispatch try-block once per micro-batch (``batch_index`` is the
+    1-based batch sequence number; ``at_or_after`` so a spec's index
+    cannot be jumped over by coalescing).  May sleep
+    (``serve_slow_dispatch``, ``ms=`` modifier, default 250), sleep
+    forever (``serve_wedge_worker`` — close() must detect the wedge),
+    or raise :class:`ServeFaultError` (``serve_dispatch_error`` — the
+    batch's futures resolve with it; the worker must survive)."""
+    reg = registry_from_env()
+    if not reg:
+        return
+    rank = int(getattr(telemetry, "rank", 0) or 0) \
+        if telemetry is not None else 0
+    f = reg.due("serve_slow_dispatch", batch_index, rank,
+                at_or_after=True)
+    if f is not None:
+        ms = float(f.mods.get("ms", 250.0))
+        log.warning("fault injection: slow serve dispatch (%g ms) at "
+                    "batch %d", ms, batch_index)
+        _serve_event(telemetry, "serve_slow_dispatch", batch_index, ms=ms)
+        time.sleep(ms / 1000.0)
+    f = reg.due("serve_wedge_worker", batch_index, rank,
+                at_or_after=True)
+    if f is not None:
+        log.warning("fault injection: wedging serve worker at batch %d",
+                    batch_index)
+        _serve_event(telemetry, "serve_wedge_worker", batch_index)
+        time.sleep(10 ** 7)
+    f = reg.due("serve_dispatch_error", batch_index, rank,
+                at_or_after=True)
+    if f is not None:
+        log.warning("fault injection: serve dispatch error at batch %d",
+                    batch_index)
+        _serve_event(telemetry, "serve_dispatch_error", batch_index)
+        raise ServeFaultError(
+            f"injected serve_dispatch_error at batch {batch_index}")
 
 
 def torn_checkpoint_due(iteration: int, rank: int) -> bool:

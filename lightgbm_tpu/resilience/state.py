@@ -336,6 +336,76 @@ def restore_into_booster(booster, path: str) -> Dict[str, Any]:
     return payload
 
 
+def booster_from_checkpoint(path: str, rank: int = 0):
+    """Standalone (prediction/serving-only) ``Booster`` from a
+    resilience checkpoint — the train→serve rollover source.
+
+    Accepts a concrete ``ckpt_<n>`` directory or a checkpoint root
+    (newest checkpoint with a valid ``rank{rank}`` manifest; the model
+    is replicated across ranks, so rank 0's trees ARE the full model).
+    Trees restore f64-binary-exact (:func:`trees_from_arrays`) and are
+    hash-verified against the manifest; objective / num_class /
+    averaging come from the checkpoint's sanity block so
+    finalize-prediction semantics match the training run.  No training
+    dataset is attached — serving packs it through the raw device
+    predictor, exactly like a model-file booster.
+    """
+    import os
+
+    from ..basic import Booster
+    from ..objective import create_objective_from_string
+    from .checkpoint import _read_manifest, list_checkpoints, load_rank
+
+    cdir = str(path)
+
+    def _has_rank(d: str) -> bool:
+        return _read_manifest(
+            os.path.join(d, f"rank{rank}.json")) is not None
+
+    if not (os.path.isdir(cdir) and _has_rank(cdir)):
+        sel = next((p for _, p in list_checkpoints(cdir)
+                    if _has_rank(p)), None) if os.path.isdir(cdir) \
+            else None
+        if sel is None:
+            raise FileNotFoundError(
+                f"no checkpoint with a valid rank{rank} manifest under "
+                f"{path!r}")
+        cdir = sel
+    payload, arrays = load_rank(cdir, rank)
+    models = trees_from_arrays(payload["trees_meta"], arrays)
+    want = payload.get("model_hash", "")
+    got = model_state_hash(models, rank=-1)
+    if want and got != want:
+        raise ValueError(
+            f"checkpoint {cdir!r}: restored model hash {got[:16]} does "
+            f"not match the manifest's {want[:16]} — torn or mismatched "
+            "checkpoint")
+    sanity = payload.get("sanity") or {}
+    b = Booster()
+    b.models = models
+    b.num_tree_per_iteration = max(1, int(payload.get("k", 1)))
+    b.num_class = max(1, int(sanity.get("num_class") or 1))
+    # rf averages its trees; every other boosting mode sums
+    b.average_output = payload.get("boosting") == "rf"
+    max_feat = 0
+    for ht in models:
+        sf = np.asarray(ht.split_feature)
+        if sf.size:
+            max_feat = max(max_feat, int(sf.max()))
+    b.max_feature_idx = max_feat
+    obj = str(sanity.get("objective") or "none")
+    if b.num_class > 1 and "num_class" not in obj:
+        obj = f"{obj} num_class:{b.num_class}"
+    b._objective_str = obj
+    b.objective = create_objective_from_string(obj)
+    b.best_iteration = -1
+    b._model_version += 1
+    log.info("rollover source: checkpoint %s (iteration %s, %d trees, "
+             "hash %s)", cdir, payload.get("iteration"), len(models),
+             got[:16])
+    return b
+
+
 def callback_states(callbacks: List) -> List[Dict[str, Any]]:
     """Serializable state of every stateful callback (those exposing
     ``_cb_state``), tagged by kind + position."""

@@ -22,11 +22,24 @@ Layers (docs/Serving.md):
   under a bytes budget with LRU eviction and pin/unpin;
 - :class:`PredictionService` (service.py) — the public facade:
   ``PredictionService(boosters_or_paths).predict(model_id, X)``.
+
+Overload hardening (docs/Serving.md "Overload & rollover"): bounded
+queues with structured :class:`ServeRejected` admission refusals,
+per-request deadlines shed at dequeue (:class:`ServeDeadlineExceeded`),
+an adaptive p99-driven :class:`AdmissionController`, client
+:class:`RetryPolicy` (shed/reject only, never compute errors),
+zero-downtime ``PredictionService.rollover`` with optional shadow
+scoring, and wedged-worker detection (:class:`ServeWorkerWedged`).
 """
+from .admission import AdmissionController
 from .batcher import MicroBatcher
 from .engine import ServingEngine
+from .errors import (RetryPolicy, ServeClosed, ServeDeadlineExceeded,
+                     ServeError, ServeRejected, ServeWorkerWedged)
 from .residency import ResidencyManager
 from .service import PredictionService
 
 __all__ = ["PredictionService", "ServingEngine", "MicroBatcher",
-           "ResidencyManager"]
+           "ResidencyManager", "AdmissionController", "RetryPolicy",
+           "ServeError", "ServeRejected", "ServeDeadlineExceeded",
+           "ServeClosed", "ServeWorkerWedged"]

@@ -1,4 +1,4 @@
-"""Micro-batching request queue.
+"""Micro-batching request queue with admission control.
 
 Per-request dispatch is what makes naive serving slow: every request
 pays a host→device→host round trip.  The batcher coalesces concurrent
@@ -17,9 +17,33 @@ of the training megastep's dispatch amortization:
   micro-batch when the batch fits one bucket), and each requester's
   slice resolves its future.
 
+Overload hardening (docs/Serving.md "Overload & rollover"):
+
+- **bounded queue** — ``max_queue_rows`` / ``max_queue_requests`` cap
+  the backlog; a submit that would overflow raises a structured
+  :class:`~.errors.ServeRejected` synchronously, carrying a
+  ``retry_after_ms`` hint derived from the measured drain rate.  The
+  adaptive controller (admission.py) can lower the effective bound
+  below the hard cap via ``shed_watermark_rows``;
+- **deadlines** — ``submit(deadline_ms=)`` (or the service-level
+  ``default_deadline_ms``) stamps each request; expired requests are
+  SHED AT DEQUEUE with :class:`~.errors.ServeDeadlineExceeded` —
+  before any device work is spent on them, never after;
+- **bounded drain + wedge detection** — ``close(drain_timeout_s=)``
+  sheds whatever a timed-out drain leaves with structured
+  ``ServeClosed`` errors, and a worker that does not exit (stuck inside
+  a device dispatch) is detected: queued AND in-flight futures are
+  failed with :class:`~.errors.ServeWorkerWedged` and a
+  ``serve_worker_wedged`` event fires instead of silently leaking
+  unresolved futures;
+- **fault hooks** — every batch consults the ``LIGHTGBM_TPU_FAULTS``
+  registry (``serve_slow_dispatch`` / ``serve_dispatch_error`` /
+  ``serve_wedge_worker``), the chaos CI's trigger points.
+
 Failures resolve the affected futures with the exception — a poisoned
-request cannot wedge the queue.  Telemetry: queue-depth gauge,
-batch-size and latency distributions, ``serve_batch`` events.
+request cannot wedge the queue.  Telemetry: queue-depth/rows gauges
+(+ peak watermarks), batch-size and latency distributions,
+``serve.rejected``/``serve.shed`` counters, ``serve_batch`` events.
 """
 from __future__ import annotations
 
@@ -27,19 +51,29 @@ import collections
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs import reqtrace
+from .errors import (ServeClosed, ServeDeadlineExceeded, ServeRejected,
+                     ServeWorkerWedged)
+
+# grace after an aborted drain before the worker is declared wedged:
+# long enough for a healthy worker to notice the abort flag (it checks
+# between batches, and a batch is bounded by max_delay + one dispatch)
+_WEDGE_GRACE_S = 5.0
+# serve_rejected events are rate-limited (the counter is exact; the
+# event ring must not be flooded by an open-loop rejection storm)
+_REJECT_EVENT_PERIOD_S = 0.5
 
 
 class _Request:
     __slots__ = ("model_id", "X", "rows", "cols", "future", "t_submit",
-                 "sparse", "trace_id", "w_submit")
+                 "sparse", "trace_id", "w_submit", "deadline")
 
     def __init__(self, model_id: str, X, rows: int, sparse: bool,
-                 wall_now: float):
+                 wall_now: float, deadline_ms: Optional[float] = None):
         self.model_id = model_id
         self.X = X
         self.rows = rows
@@ -54,6 +88,9 @@ class _Request:
         self.trace_id = reqtrace.mint_trace_id()
         self.future.trace_id = self.trace_id
         self.w_submit = wall_now
+        # absolute shed deadline on the worker's clock; None = never
+        self.deadline = (None if not deadline_ms or deadline_ms <= 0
+                         else self.t_submit + float(deadline_ms) / 1000.0)
 
 
 def _resolve(future: Future, result=None, exc=None) -> None:
@@ -75,22 +112,77 @@ class MicroBatcher:
     def __init__(self, dispatch: Callable[[str, Any], np.ndarray],
                  max_batch_rows: int = 8192, max_delay_ms: float = 2.0,
                  telemetry=None, batch_events: bool = True,
-                 memory_watermarks: bool = True):
+                 memory_watermarks: bool = True,
+                 max_queue_rows: int = 0, max_queue_requests: int = 0,
+                 default_deadline_ms: float = 0.0):
         self._dispatch = dispatch
         self.max_batch_rows = int(max_batch_rows)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.tel = telemetry
         self.batch_events = batch_events
         self.memory_watermarks = bool(memory_watermarks)
+        # admission control (0 = unbounded, the pre-hardening contract)
+        self.max_queue_rows = max(0, int(max_queue_rows or 0))
+        self.max_queue_requests = max(0, int(max_queue_requests or 0))
+        self.default_deadline_ms = max(0.0, float(default_deadline_ms
+                                                  or 0.0))
+        # adaptive lever (admission.AdmissionController): a row bound
+        # UNDER the hard cap; None = inactive
+        self.shed_watermark_rows: Optional[int] = None
+        # post-batch hook (the admission controller's step); best-effort
+        self.on_batch_done: Optional[Callable[[], None]] = None
         self._q: collections.deque = collections.deque()
+        self._q_rows = 0
         self._cv = threading.Condition()
         self._stop = False
+        self._abort_drain = False
+        self._wedged = False
+        self._inflight: List[_Request] = []
+        self._batch_seq = 0
+        # measured drain rate (EWMA over completed batches) feeding the
+        # retry_after_ms hint on rejections
+        self._ewma_batch_ms: Optional[float] = None
+        self._ewma_batch_rows: Optional[float] = None
+        self._last_reject_event = 0.0
+        self._faults = None   # lazy: resilience.faults module
         self._worker = threading.Thread(
             target=self._loop, name="lgbm-serve-batcher", daemon=True)
         self._worker.start()
 
+    # ------------------------------------------------------- admission
+    def _retry_after_ms(self) -> float:
+        """Backlog / measured drain rate — the hint a rejected client
+        should wait before resubmitting.  Before any batch completed,
+        fall back to twice the coalescing delay."""
+        if self._ewma_batch_ms and self._ewma_batch_rows:
+            rate = self._ewma_batch_rows / self._ewma_batch_ms  # rows/ms
+            if rate > 0:
+                return min(10_000.0, max(1.0, self._q_rows / rate))
+        return max(1.0, self.max_delay_s * 2000.0)
+
+    def _admission_reason(self, rows: int) -> Optional[str]:
+        """Why this submit must be rejected, or None.  Caller holds the
+        lock.  A single oversized request against an EMPTY queue always
+        admits (it could otherwise never be served; the engine chunks
+        it), matching the max_batch_rows oversized-single semantics."""
+        if self.max_queue_requests \
+                and len(self._q) + 1 > self.max_queue_requests:
+            return "queue_requests"
+        # effective row bound: the hard cap tightened by the adaptive
+        # watermark (either may be unset)
+        cap = self.max_queue_rows
+        wm = self.shed_watermark_rows
+        eff = min(cap, wm) if (cap and wm is not None) \
+            else (wm if wm is not None else cap)
+        if eff and self._q_rows + rows > eff and (self._q or rows <= eff):
+            return "shed_watermark" \
+                if wm is not None and eff != self.max_queue_rows \
+                else "queue_rows"
+        return None
+
     # ------------------------------------------------------------------
-    def submit(self, model_id: str, X) -> Future:
+    def submit(self, model_id: str, X,
+               deadline_ms: Optional[float] = None) -> Future:
         from ..basic import _is_scipy_sparse
         sparse = _is_scipy_sparse(X)
         if not sparse:
@@ -104,70 +196,164 @@ class MicroBatcher:
                 X = X.astype(np.float64)
         wall = (self.tel.wall_now() if self.tel is not None
                 else time.time())
-        req = _Request(model_id, X, int(X.shape[0]), sparse, wall)
+        eff_deadline = (self.default_deadline_ms
+                        if deadline_ms is None else float(deadline_ms))
+        req = _Request(model_id, X, int(X.shape[0]), sparse, wall,
+                       deadline_ms=eff_deadline)
+        reject: Optional[ServeRejected] = None
         with self._cv:
-            if self._stop:
-                req.future.set_exception(
-                    RuntimeError("MicroBatcher is closed"))
-                self._emit_failed(req, "MicroBatcherClosed")
+            if self._stop or self._wedged:
+                exc = ServeWorkerWedged(
+                    "MicroBatcher worker is wedged", model_id=model_id) \
+                    if self._wedged else ServeClosed(
+                        "MicroBatcher is closed", model_id=model_id)
+                req.future.set_exception(exc)
+                self._emit_failed(req, type(exc).__name__)
                 return req.future
-            self._q.append(req)
-            depth = len(self._q)
-            self._cv.notify()
+            reason = self._admission_reason(req.rows)
+            if reason is None:
+                self._q.append(req)
+                self._q_rows += req.rows
+                depth, qrows = len(self._q), self._q_rows
+                self._cv.notify()
+            else:
+                reject = ServeRejected(
+                    f"serving queue full ({reason}); retry after "
+                    f"~{self._retry_after_ms():.0f} ms",
+                    reason=reason,
+                    retry_after_ms=self._retry_after_ms(),
+                    queue_rows=self._q_rows,
+                    queue_requests=len(self._q), model_id=model_id)
+        if reject is not None:
+            # telemetry OUTSIDE the queue lock: a JSONL sink write must
+            # never serialize submitters against the worker
+            if self.tel is not None:
+                self.tel.inc("serve.rejected")
+                self.tel.inc("serve.rejected_rows", req.rows)
+                now = time.perf_counter()
+                if now - self._last_reject_event > _REJECT_EVENT_PERIOD_S:
+                    self._last_reject_event = now
+                    self._record(lambda: self.tel.event(
+                        "serve_rejected", **reject.details()))
+            raise reject
         if self.tel is not None:
             self.tel.gauge("serve.queue_depth", depth)
+            self.tel.gauge("serve.queue_rows", qrows)
+            self.tel.gauge_max("serve.queue_peak_requests", depth)
+            self.tel.gauge_max("serve.queue_peak_rows", qrows)
             self.tel.inc("serve.requests")
             self.tel.inc("serve.rows", req.rows)
         return req.future
 
+    # ------------------------------------------------------- deadlines
+    @staticmethod
+    def _expired(req: _Request, now: float) -> bool:
+        return req.deadline is not None and now >= req.deadline
+
+    def _shed(self, reqs: List[_Request]) -> None:
+        """Fail expired requests BEFORE any device work is spent on
+        them: structured error, counter, one serve_access record each
+        (error="ServeDeadlineExceeded") — shed requests trace too."""
+        now = time.perf_counter()
+        for r in reqs:
+            waited_ms = (now - r.t_submit) * 1000.0
+            deadline_ms = 0.0 if r.deadline is None else \
+                (r.deadline - r.t_submit) * 1000.0
+            _resolve(r.future, exc=ServeDeadlineExceeded(
+                f"deadline of {deadline_ms:.1f} ms passed after "
+                f"{waited_ms:.1f} ms in queue (shed before dispatch)",
+                retry_after_ms=self._retry_after_ms(),
+                deadline_ms=round(deadline_ms, 3),
+                waited_ms=round(waited_ms, 3),
+                model_id=r.model_id, trace_id=r.trace_id))
+            if self.tel is not None:
+                self.tel.inc("serve.shed")
+                self.tel.inc("serve.shed_rows", r.rows)
+            self._emit_failed(r, "ServeDeadlineExceeded")
+
     # ------------------------------------------------------------------
-    def _pull_same_model(self, model_id: str, cols: int,
-                         budget: int) -> List[_Request]:
+    def _pull_same_model(self, model_id: str, cols: int, budget: int
+                         ) -> Tuple[List[_Request], List[_Request]]:
         """Remove queued DENSE requests for ``model_id`` with the SAME
         column count (a width mismatch must fail only its own request,
         not its batch neighbors' np.concatenate), up to ``budget`` rows,
-        preserving arrival order.  Caller holds the lock."""
-        got, keep = [], collections.deque()
+        preserving arrival order.  Expired requests of ANY model are
+        also removed and returned separately for shedding (emission
+        happens outside the lock).  Caller holds the lock."""
+        got, expired, keep = [], [], collections.deque()
+        now = time.perf_counter()
         while self._q:
             r = self._q.popleft()
-            if (r.model_id == model_id and not r.sparse
+            if self._expired(r, now):
+                self._q_rows -= r.rows
+                expired.append(r)
+            elif (r.model_id == model_id and not r.sparse
                     and r.cols == cols and r.rows <= budget):
                 # strict budget: a batch never exceeds max_batch_rows,
                 # so one micro-batch is one bucketed device dispatch
                 # (an oversized SINGLE request still chunks in the
                 # engine, but never drags neighbors past the cap)
+                self._q_rows -= r.rows
                 got.append(r)
                 budget -= r.rows
             else:
                 keep.append(r)
         self._q = keep
-        return got
+        return got, expired
+
+    def _drain_queue_locked(self) -> List[_Request]:
+        drop = list(self._q)
+        self._q.clear()
+        self._q_rows = 0
+        return drop
 
     def _loop(self) -> None:
         while True:
+            drop: Optional[List[_Request]] = None
             with self._cv:
-                while not self._q and not self._stop:
+                while not self._q and not self._stop \
+                        and not self._abort_drain:
                     self._cv.wait()
-                if not self._q and self._stop:
+                if self._abort_drain:
+                    drop = self._drain_queue_locked()
+                elif not self._q and self._stop:
                     return
-                first = self._q.popleft()
+                else:
+                    first = self._q.popleft()
+                    self._q_rows -= first.rows
+            if drop is not None:
+                # bounded drain expired: shutdown must shed the
+                # remaining queue with structured errors, not block
+                exc = ServeClosed("MicroBatcher drain timed out; "
+                                  "request shed at shutdown",
+                                  reason="drain_timeout")
+                for r in drop:
+                    _resolve(r.future, exc=exc)
+                    self._emit_failed(r, "DrainTimeout")
+                return
+            now = time.perf_counter()
+            if self._expired(first, now):
+                self._shed([first])
+                continue
             batch = [first]
             rows = first.rows
             if not first.sparse:
                 deadline = first.t_submit + self.max_delay_s
                 while rows < self.max_batch_rows:
                     with self._cv:
-                        more = self._pull_same_model(
+                        more, expired = self._pull_same_model(
                             first.model_id, first.cols,
                             self.max_batch_rows - rows)
-                        if not more:
+                        if not more and not expired:
                             remaining = deadline - time.perf_counter()
                             if remaining <= 0:
                                 break
                             self._cv.wait(remaining)
-                            more = self._pull_same_model(
+                            more, expired = self._pull_same_model(
                                 first.model_id, first.cols,
                                 self.max_batch_rows - rows)
+                    if expired:
+                        self._shed(expired)
                     if more:
                         batch.extend(more)
                         rows += sum(r.rows for r in more)
@@ -177,9 +363,9 @@ class MicroBatcher:
 
     def _emit_failed(self, req: "_Request", error: str) -> None:
         """serve_access for a request that never reached a dispatch
-        (submit-after-stop, close(drain=False)) — the exactly-one-
-        record-per-request contract covers the failure paths an
-        operator actually debugs."""
+        (submit-after-stop, shed deadline, drain timeout, wedged
+        worker) — the exactly-one-record-per-request contract covers
+        the failure paths an operator actually debugs."""
         if self.tel is None:
             return
 
@@ -203,12 +389,27 @@ class MicroBatcher:
         except Exception:
             pass
 
+    def _fault_hook(self, seq: int) -> None:
+        """Serve-plane fault injection (resilience/faults.py): may
+        sleep (serve_slow_dispatch), sleep forever (serve_wedge_worker)
+        or raise (serve_dispatch_error — resolved into the batch's
+        futures like any dispatch failure)."""
+        if self._faults is None:
+            from ..resilience import faults
+            self._faults = faults
+        self._faults.on_serve_batch(self.tel, seq)
+
     def _run_batch(self, model_id: str, batch: List[_Request],
                    rows: int) -> None:
         # re-gauge on drain too: submit-only updates would leave an
         # idle service reporting its last (peak) backlog forever
-        self._record(lambda: self.tel.gauge("serve.queue_depth",
-                                            len(self._q)))
+        self._record(lambda: (self.tel.gauge("serve.queue_depth",
+                                             len(self._q)),
+                              self.tel.gauge("serve.queue_rows",
+                                             self._q_rows)))
+        self._batch_seq += 1
+        seq = self._batch_seq
+        self._inflight = batch
         t0 = time.perf_counter()
         wait_ms = (t0 - batch[0].t_submit) * 1000.0
         # request-scoped batch context: the engine annotates bucket /
@@ -216,6 +417,7 @@ class MicroBatcher:
         # the batcher knowing its internals (obs/reqtrace.py)
         reqtrace.begin_batch(model_id)
         try:
+            self._fault_hook(seq)
             X = batch[0].X if len(batch) == 1 else np.concatenate(
                 [r.X for r in batch], axis=0)
             out = self._dispatch(model_id, X)
@@ -226,6 +428,7 @@ class MicroBatcher:
                          else time.time())
             for r in batch:
                 _resolve(r.future, exc=exc)
+            self._inflight = []
 
             def _error_telemetry():
                 self.tel.inc("serve.batch_errors")
@@ -241,6 +444,8 @@ class MicroBatcher:
                         batch_ms=(time.perf_counter() - t0) * 1000.0,
                         done_wall=done_wall)
             self._record(_error_telemetry)
+            self._record(lambda: self.on_batch_done and
+                         self.on_batch_done())
             return
         ctx = reqtrace.end_batch()
         done = time.perf_counter()
@@ -250,11 +455,20 @@ class MicroBatcher:
         for r in batch:
             _resolve(r.future, result=out[c0:c0 + r.rows])
             c0 += r.rows
+        self._inflight = []
+        batch_ms = (done - t0) * 1000.0
+        # drain-rate EWMA feeding the rejection retry_after hint (plain
+        # attributes: worker-written, submitter-read, GIL-atomic)
+        a = 0.2
+        self._ewma_batch_ms = batch_ms if self._ewma_batch_ms is None \
+            else (1 - a) * self._ewma_batch_ms + a * batch_ms
+        self._ewma_batch_rows = float(rows) \
+            if self._ewma_batch_rows is None \
+            else (1 - a) * self._ewma_batch_rows + a * rows
 
         def _batch_telemetry():
             self.tel.inc("serve.batches")
             self.tel.dist("serve.batch_rows", rows)
-            batch_ms = (done - t0) * 1000.0
             for r in batch:
                 self.tel.dist("serve.latency_ms",
                               (done - r.t_submit) * 1000.0)
@@ -277,21 +491,66 @@ class MicroBatcher:
                 memory_watermarks(self.tel, where="serve")
 
         self._record(_batch_telemetry)
+        # adaptive admission: evaluate AFTER the batch's latency samples
+        # landed in the dist ring (time-gated inside the controller)
+        self._record(lambda: self.on_batch_done and self.on_batch_done())
 
     # ------------------------------------------------------------------
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True,
+              drain_timeout_s: Optional[float] = None) -> None:
         """Stop the worker.  ``drain=True`` serves what is already
-        queued first; ``drain=False`` fails queued requests."""
+        queued first, bounded by ``drain_timeout_s`` (default 30 s):
+        when the bound expires, the remaining queue is shed with
+        structured ``ServeClosed`` errors instead of blocking shutdown
+        indefinitely.  ``drain=False`` fails queued requests
+        immediately.  A worker that does not exit even after the
+        aborted drain (stuck inside a device dispatch) is declared
+        WEDGED: queued + in-flight futures are failed with
+        ``ServeWorkerWedged`` and a ``serve_worker_wedged`` event fires
+        — never a silent leak of unresolved futures."""
         with self._cv:
             self._stop = True
             dropped = []
             if not drain:
-                while self._q:
-                    r = self._q.popleft()
+                dropped = self._drain_queue_locked()
+                for r in dropped:
                     _resolve(r.future,
-                             exc=RuntimeError("MicroBatcher closed"))
-                    dropped.append(r)
+                             exc=ServeClosed("MicroBatcher closed",
+                                             model_id=r.model_id))
             self._cv.notify_all()
         for r in dropped:
             self._emit_failed(r, "MicroBatcherClosed")
-        self._worker.join(timeout=30)
+        timeout = 30.0 if drain_timeout_s is None \
+            else max(0.0, float(drain_timeout_s))
+        self._worker.join(timeout=timeout)
+        if not self._worker.is_alive():
+            return
+        # bounded drain expired: tell the worker to stop serving the
+        # backlog and shed it (structured errors) on its way out
+        with self._cv:
+            self._abort_drain = True
+            self._cv.notify_all()
+        self._worker.join(timeout=_WEDGE_GRACE_S)
+        if not self._worker.is_alive():
+            return
+        # the worker ignored the abort: it is wedged inside a dispatch
+        # (hung device, injected serve_wedge_worker).  Fail everything
+        # it will never serve — _resolve is race-tolerant, so if the
+        # worker ever does come back its own delivery no-ops.
+        self._wedged = True
+        with self._cv:
+            drop = self._drain_queue_locked()
+        inflight = list(self._inflight)
+        exc = ServeWorkerWedged(
+            "serving worker did not exit within the close timeout "
+            "(wedged inside a dispatch); queued and in-flight requests "
+            "failed", queued=len(drop), inflight=len(inflight))
+        for r in drop + inflight:
+            _resolve(r.future, exc=exc)
+            self._emit_failed(r, "ServeWorkerWedged")
+        if self.tel is not None:
+            self._record(lambda: self.tel.event(
+                "serve_worker_wedged", queued=len(drop),
+                inflight=len(inflight),
+                drain_timeout_s=timeout))
+            self._record(lambda: self.tel.inc("serve.worker_wedged"))

@@ -61,6 +61,12 @@ class ServingEngine:
         self.model_id = model_id
         self.tel = telemetry
         booster._drain()
+        # version identity: every response is attributable to exactly
+        # one packed model state (serve_access model_version field, the
+        # serve_rollover old/new hashes).  rank=-1 skips the health
+        # fault salt — this must describe the REAL state.
+        from ..obs.health import model_state_hash
+        self.model_hash = model_state_hash(booster.models, rank=-1)
         self.k = max(1, booster.num_tree_per_iteration)
         total_iter = len(booster.models) // self.k
         if num_iteration is None:
@@ -246,6 +252,7 @@ class ServingEngine:
         """Raw scores [k, n] float64 over trees [lo, hi)."""
         if not self.device_ok:
             return self._host_predict_raw(X)
+        reqtrace.annotate(model_version=self.model_hash[:16])
         sparse_in = _is_sparse(X)
         if sparse_in:
             X = X.tocsr()
@@ -273,6 +280,7 @@ class ServingEngine:
         per-chunk sparse densify)."""
         from ..basic import host_walk_raw
         t0 = time.perf_counter()
+        reqtrace.annotate(model_version=self.model_hash[:16])
         out = host_walk_raw(self.booster.models, X, self.lo, self.hi,
                             self.k)
         n = X.shape[0]
@@ -303,6 +311,7 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {"model_id": self.model_id, "variant": self.variant,
+                    "model_hash": self.model_hash[:16],
                     "device": self.device_ok,
                     "degraded_reason": self.degraded_reason,
                     "trees": self.hi - self.lo,

@@ -118,6 +118,36 @@ class ResidencyManager:
             self.tel.gauge("serve.resident_models", len(self._engines))
             self.tel.gauge("serve.resident_bytes", self.resident_bytes)
 
+    # ------------------------------------------------------- rollover
+    def build_candidate(self, model_id: str, booster) -> ServingEngine:
+        """Engine for a rollover candidate, built OUTSIDE the resident
+        table and WITHOUT the lock held (packing + warmup are the slow
+        part and must not stall live dispatches) — install it with
+        :meth:`swap`."""
+        return self._factory(booster, model_id=model_id,
+                             telemetry=self.tel, **self._knobs)
+
+    def swap(self, model_id: str, booster, engine: ServingEngine
+             ) -> Optional[ServingEngine]:
+        """Atomically replace ``model_id``'s booster + engine (the
+        rollover promotion).  The swap is one dict assignment under the
+        residency lock: a dispatch already in flight keeps resolving
+        against the OLD engine object it holds, every dispatch that
+        dequeues after the swap gets the new one — so each request
+        resolves against exactly one consistent model version.  Pin
+        state is preserved; returns the old engine (dropped by the
+        caller once its event is emitted)."""
+        with self._lock:
+            if model_id not in self._boosters:
+                raise KeyError(f"unknown model_id: {model_id!r}")
+            old = self._engines.pop(model_id, None)
+            self._boosters[model_id] = booster
+            self._engines[model_id] = engine
+            self._builds[model_id] = self._builds.get(model_id, 0) + 1
+            self._evict_to_budget(keep=model_id)
+            self._update_gauges()
+            return old
+
     # ------------------------------------------------------------------
     def pin(self, model_id: str) -> None:
         """Exempt from eviction (and make resident now)."""

@@ -10,29 +10,58 @@ north star's "millions of users" half needs::
         max_batch_rows=8192, max_delay_ms=2.0,
         device_budget_bytes=256 << 20, telemetry_out="serve.jsonl",
         metrics_port=9200,                # live OpenMetrics endpoint
-        trace_out="serve_trace.json")     # per-request Perfetto spans
+        trace_out="serve_trace.json",     # per-request Perfetto spans
+        max_queue_rows=65536,             # admission control (bounded
+        default_deadline_ms=250.0,        #  queue + dequeue shedding)
+        target_p99_ms=50.0,               # adaptive controller
+        retry_policy=lgb.serve.RetryPolicy())
     svc.warmup()                          # AOT-compile every bucket
-    y = svc.predict("churn", X)           # sync (submit + wait)
-    fut = svc.submit("rank", X2)          # future form (.trace_id set)
+    y = svc.predict("churn", X)           # sync (submit + wait + retry)
+    fut = svc.submit("rank", X2, deadline_ms=100)   # future form
+    svc.rollover("churn", "churn_v2.txt", shadow_requests=100)
     svc.stats()                           # latency p50/p95/p99, counters
-    svc.close()
+    svc.close(drain_timeout_s=10)
 
 Models may be live ``Booster`` objects (binned device routing through
-their training BinMappers) or model-file paths / model strings (raw
-device routing — no training dataset needed).  A model the device path
-cannot represent serves through the host walk with a structured
+their training BinMappers), model-file paths / model strings (raw
+device routing — no training dataset needed), or a resilience
+CHECKPOINT directory (``resilience.state.booster_from_checkpoint`` —
+the train→serve rollover source).  A model the device path cannot
+represent serves through the host walk with a structured
 ``serve_degradation`` event, never an error.
+
+Overload & rollover (docs/Serving.md):
+
+- admission control / deadlines / adaptive shedding live in the
+  micro-batcher (batcher.py) and the controller (admission.py); every
+  knob defaults OFF so an un-configured service behaves exactly like
+  the pre-hardening one (``dispatches_per_request == 1.0``,
+  ``compiles_per_1k_requests == 0`` contracts untouched);
+- ``predict`` retries shed/rejected requests under a
+  :class:`~.errors.RetryPolicy` (never compute errors);
+- :meth:`rollover` hot-swaps a new model version into residency with
+  zero dropped requests: pack + warm OFF the serving thread, optional
+  shadow scoring of mirrored traffic, then one atomic swap under the
+  residency lock — in-flight batches finish on the old engine, every
+  later dispatch gets the new one (``serve_rollover`` event with
+  old/new model hashes);
+- ``/readyz`` on the metrics exporter reports ready only after
+  ``warmup()`` and flips unready during the rollover swap window.
 """
 from __future__ import annotations
 
 import os
+import threading
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
-from ..obs import Telemetry
+from ..config import param_default
+from ..obs import Telemetry, reqtrace
+from .admission import AdmissionController
 from .batcher import MicroBatcher
+from .errors import RetryPolicy
 from .residency import ResidencyManager
 
 
@@ -42,13 +71,21 @@ def _as_booster(spec):
         return spec
     if isinstance(spec, (str, os.PathLike)):
         text = str(spec)
+        if os.path.isdir(text):
+            # a directory is a resilience checkpoint (root or concrete
+            # ckpt_<n>): the train→serve rollover source — trees restore
+            # f64-binary-exact and hash-verified into a standalone
+            # serving booster (raw device routing)
+            from ..resilience.state import booster_from_checkpoint
+            return booster_from_checkpoint(text)
         if os.path.exists(text):
             return Booster(model_file=text)
         if text.startswith("tree\n") or "\ntree\n" in text[:200]:
             return Booster(model_str=text)
         raise FileNotFoundError(f"model file not found: {text}")
     raise TypeError(f"cannot serve {type(spec).__name__}; expected "
-                    "Booster, model-file path or model string")
+                    "Booster, model-file path, model string or "
+                    "checkpoint directory")
 
 
 class PredictionService:
@@ -66,7 +103,12 @@ class PredictionService:
                  batch_events: bool = True,
                  metrics_port: int = 0,
                  trace_out: str = "",
-                 memory_watermarks: bool = True):
+                 memory_watermarks: bool = True,
+                 max_queue_rows: Optional[int] = None,
+                 max_queue_requests: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 target_p99_ms: Optional[float] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -75,6 +117,19 @@ class PredictionService:
             specs = {"default": boosters_or_paths}
         if not specs:
             raise ValueError("PredictionService needs at least one model")
+
+        # admission-control knobs default from the config registry (the
+        # single source of truth docs/Parameters.md renders); all 0 =
+        # off = the pre-overload-hardening serving contract
+        if max_queue_rows is None:
+            max_queue_rows = param_default("serve_max_queue_rows")
+        if max_queue_requests is None:
+            max_queue_requests = param_default("serve_max_queue_requests")
+        if default_deadline_ms is None:
+            default_deadline_ms = param_default("serve_default_deadline_ms")
+        if target_p99_ms is None:
+            target_p99_ms = param_default("serve_target_p99_ms")
+        self.retry_policy = retry_policy
 
         self.raw_score = bool(raw_score)
         self.tel = Telemetry(enabled=True)
@@ -85,14 +140,20 @@ class PredictionService:
         self._trace_out = str(trace_out or "")
         if self._trace_out:
             self.tel.enable(trace=True)
+        self._closed = False
+        self._warmed = False
+        self._rollover_swapping = False
+        self._rollover_lock = threading.Lock()
+        self._shadow: Dict[str, Dict[str, Any]] = {}
         # live OpenMetrics endpoint over the serving registry
         # (obs/export.py; rank offset matters when a serving process
-        # rides inside a multi-rank job)
+        # rides inside a multi-rank job).  /readyz consults _readiness.
         self._metrics = None
         if int(metrics_port or 0) > 0:
             from ..obs.export import MetricsExporter
             self._metrics = MetricsExporter(
-                self.tel, int(metrics_port) + self.tel.rank)
+                self.tel, int(metrics_port) + self.tel.rank,
+                ready_check=self._readiness)
             self._metrics.start()
         self.residency = ResidencyManager(
             budget_bytes=device_budget_bytes, telemetry=self.tel,
@@ -105,12 +166,26 @@ class PredictionService:
             self._dispatch_batch, max_batch_rows=max_batch_rows,
             max_delay_ms=max_delay_ms, telemetry=self.tel,
             batch_events=batch_events,
-            memory_watermarks=memory_watermarks)
-        self._closed = False
+            memory_watermarks=memory_watermarks,
+            max_queue_rows=int(max_queue_rows or 0),
+            max_queue_requests=int(max_queue_requests or 0),
+            default_deadline_ms=float(default_deadline_ms or 0.0))
+        # adaptive admission: armed only by a nonzero p99 target; runs
+        # on the worker thread via the post-batch hook
+        self.admission: Optional[AdmissionController] = None
+        if float(target_p99_ms or 0.0) > 0:
+            self.admission = AdmissionController(
+                self.batcher, self.tel, float(target_p99_ms))
+            self.batcher.on_batch_done = self.admission.step
         self.tel.event("serve_start", models=list(specs),
                        max_batch_rows=int(max_batch_rows),
                        max_delay_ms=float(max_delay_ms),
-                       budget_bytes=device_budget_bytes)
+                       budget_bytes=device_budget_bytes,
+                       max_queue_rows=int(max_queue_rows or 0),
+                       max_queue_requests=int(max_queue_requests or 0),
+                       default_deadline_ms=float(default_deadline_ms
+                                                 or 0.0),
+                       target_p99_ms=float(target_p99_ms or 0.0))
 
     # ------------------------------------------------------------------
     @property
@@ -119,39 +194,108 @@ class PredictionService:
         was not set)."""
         return None if self._metrics is None else self._metrics.url
 
+    def _readiness(self) -> Tuple[bool, str]:
+        """GET /readyz probe: ready only once ``warmup()`` compiled the
+        configured buckets, and unready again during a rollover swap
+        window / after close — external load balancers drain on 503."""
+        if self._closed:
+            return False, "closed"
+        if getattr(self.batcher, "_wedged", False):
+            return False, "worker_wedged"
+        if self._rollover_swapping:
+            return False, "rollover_swap"
+        if not self._warmed:
+            return False, "warmup_pending"
+        return True, "ready"
+
     def _dispatch_batch(self, model_id: str, X) -> np.ndarray:
-        return self.residency.get(model_id).predict(
-            X, raw_score=self.raw_score)
+        eng = self.residency.get(model_id)
+        out = eng.predict(X, raw_score=self.raw_score)
+        st = self._shadow.get(model_id)
+        if st is not None and st["remaining"] > 0:
+            self._score_shadow(st, model_id, X, out)
+        return out
+
+    def _score_shadow(self, st: Dict[str, Any], model_id: str, X,
+                      out: np.ndarray) -> None:
+        """Score a rollover candidate on mirrored live traffic and
+        report divergence through the request-trace plane.  Runs on the
+        worker thread AFTER the live response is computed; a shadow
+        failure must never fail live traffic."""
+        try:
+            reqtrace.begin_shadow()
+            try:
+                sout = st["engine"].predict(X, raw_score=self.raw_score)
+            finally:
+                reqtrace.end_shadow()
+            div = 0.0
+            if np.asarray(out).size:
+                div = float(np.max(np.abs(
+                    np.asarray(sout, np.float64)
+                    - np.asarray(out, np.float64))))
+            st["max_divergence"] = max(st["max_divergence"], div)
+            st["requests"] += 1
+            st["remaining"] -= 1
+            reqtrace.annotate(shadow_divergence=round(div, 9))
+            self.tel.event("serve_shadow", model_id=model_id,
+                           divergence=round(div, 9),
+                           remaining=int(st["remaining"]),
+                           candidate_hash=st["engine"].model_hash[:16])
+            if st["remaining"] <= 0:
+                st["done"].set()
+        except Exception as e:
+            st["error"] = repr(e)
+            st["done"].set()
 
     # ------------------------------------------------------------------
     def model_ids(self) -> List[str]:
         return self.residency.model_ids()
 
-    def submit(self, model_id: str, X) -> Future:
+    def submit(self, model_id: str, X,
+               deadline_ms: Optional[float] = None) -> Future:
         """Future form: enqueue and return immediately.  The returned
         future carries ``future.trace_id`` — the request's identity in
         every ``serve_access`` JSONL record and Perfetto serve-track
-        span (docs/Serving.md)."""
+        span (docs/Serving.md).  ``deadline_ms`` overrides the
+        service-level default: a request still queued past its deadline
+        is shed before dispatch with ``ServeDeadlineExceeded``.  Raises
+        ``ServeRejected`` synchronously when admission control refuses
+        the request (bounded queue / shed watermark)."""
         if self._closed:
             raise RuntimeError("PredictionService is closed")
         model_id = str(model_id)
         if not self.residency.has(model_id):
             raise KeyError(f"unknown model_id: {model_id!r}")
-        return self.batcher.submit(model_id, X)
+        return self.batcher.submit(model_id, X, deadline_ms=deadline_ms)
 
     def predict(self, model_id: str, X,
-                timeout: Optional[float] = None) -> np.ndarray:
-        """Sync form: ``submit`` + wait for the micro-batched result."""
-        return self.submit(model_id, X).result(timeout=timeout)
+                timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None,
+                retry: Optional[RetryPolicy] = None) -> np.ndarray:
+        """Sync form: ``submit`` + wait for the micro-batched result.
+        When a :class:`RetryPolicy` is supplied (or set service-wide),
+        shed/rejected requests are resubmitted under capped exponential
+        backoff; compute errors surface immediately, never retried."""
+        policy = self.retry_policy if retry is None else retry
+
+        def _once():
+            return self.submit(model_id, X,
+                               deadline_ms=deadline_ms).result(
+                                   timeout=timeout)
+        if policy is None:
+            return _once()
+        return policy.call(_once, telemetry=self.tel)
 
     def warmup(self, buckets: Optional[List[int]] = None,
                model_ids: Optional[List[str]] = None) -> Dict[str, Any]:
         """Pack + AOT-compile every model (or ``model_ids``) for every
         bucket size (or ``buckets``): after this, steady-state serving
-        does zero XLA compiles."""
+        does zero XLA compiles — and ``/readyz`` starts reporting
+        ready."""
         out = {}
         for mid in (model_ids or self.model_ids()):
             out[str(mid)] = self.residency.get(str(mid)).warmup(buckets)
+        self._warmed = True
         return out
 
     def refresh(self, model_id: str) -> None:
@@ -160,6 +304,100 @@ class PredictionService:
         they do not track later updates."""
         self.residency.evict(str(model_id))
         self.residency.get(str(model_id))
+
+    # ------------------------------------------------------- rollover
+    def rollover(self, model_id: str, new_source,
+                 warm: bool = True,
+                 shadow_requests: int = 0,
+                 shadow_timeout_s: float = 30.0,
+                 shadow_abort_threshold: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """Zero-downtime model rollover: load a candidate from a
+        booster / model file / model string / resilience checkpoint
+        directory, pack + warm its buckets OFF the serving thread,
+        optionally score it on mirrored live traffic (shadow mode),
+        then promote it with ONE atomic swap under the residency lock —
+        in-flight and queued requests all resolve against a consistent
+        version, zero dropped.
+
+        Shadow mode: ``shadow_requests`` mirrored micro-batches are
+        scored on the candidate (divergence = max abs difference vs the
+        live response, reported per batch through ``serve_shadow``
+        events and the ``shadow_divergence`` field of the live
+        requests' ``serve_access`` records).  With
+        ``shadow_abort_threshold`` set, the rollover is ABORTED —
+        old model keeps serving — when the observed divergence exceeds
+        it or the shadow could not complete within
+        ``shadow_timeout_s``.
+
+        Returns a report: ``promoted``, ``old_hash``/``new_hash``,
+        ``shadow`` stats.  Emits a ``serve_rollover`` event carrying
+        both hashes on promotion."""
+        if self._closed:
+            raise RuntimeError("PredictionService is closed")
+        model_id = str(model_id)
+        if not self.residency.has(model_id):
+            raise KeyError(f"unknown model_id: {model_id!r}")
+        with self._rollover_lock:
+            booster = _as_booster(new_source)
+            old_eng = self.residency.get(model_id)
+            old_hash = old_eng.model_hash
+            # pack + warm on THIS thread: the serving worker keeps
+            # dispatching against the old engine the whole time
+            cand = self.residency.build_candidate(model_id, booster)
+            if warm:
+                cand.warmup()
+            report: Dict[str, Any] = {
+                "model_id": model_id, "promoted": False,
+                "old_hash": old_hash[:16],
+                "new_hash": cand.model_hash[:16], "shadow": None}
+            if isinstance(new_source, (str, os.PathLike)):
+                source_kind = "checkpoint" \
+                    if os.path.isdir(str(new_source)) else "file"
+            else:
+                source_kind = type(new_source).__name__
+            if int(shadow_requests) > 0:
+                st = {"engine": cand, "remaining": int(shadow_requests),
+                      "requests": 0, "max_divergence": 0.0,
+                      "done": threading.Event()}
+                self._shadow[model_id] = st
+                completed = st["done"].wait(float(shadow_timeout_s))
+                self._shadow.pop(model_id, None)
+                shadow_rep = {
+                    "requests": int(st["requests"]),
+                    "max_divergence": float(st["max_divergence"]),
+                    "completed": bool(completed and "error" not in st)}
+                if "error" in st:
+                    shadow_rep["error"] = st["error"]
+                report["shadow"] = shadow_rep
+                if shadow_abort_threshold is not None and (
+                        not shadow_rep["completed"]
+                        or shadow_rep["max_divergence"]
+                        > float(shadow_abort_threshold)):
+                    self.tel.inc("serve.rollover_aborts")
+                    self.tel.event(
+                        "serve_rollover_aborted", model_id=model_id,
+                        old_hash=old_hash[:16],
+                        new_hash=cand.model_hash[:16],
+                        **{f"shadow_{k}": v
+                           for k, v in shadow_rep.items()})
+                    return report
+            # the swap window: /readyz flips unready so external load
+            # balancers drain; the swap itself is one dict assignment
+            self._rollover_swapping = True
+            try:
+                self.residency.swap(model_id, booster, cand)
+            finally:
+                self._rollover_swapping = False
+            self.tel.inc("serve.rollovers")
+            self.tel.event("serve_rollover", model_id=model_id,
+                           old_hash=old_hash[:16],
+                           new_hash=cand.model_hash[:16],
+                           source=source_kind,
+                           warmed=bool(warm),
+                           shadow=report["shadow"])
+            report["promoted"] = True
+            return report
 
     def pin(self, model_id: str) -> None:
         self.residency.pin(str(model_id))
@@ -170,8 +408,8 @@ class PredictionService:
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """Operator view: request/batch/dispatch/compile counters, the
-        latency and batch-size distributions (p50/p95/p99) and residency
-        state.  ``dispatches_per_request`` and
+        latency and batch-size distributions (p50/p95/p99), admission
+        and residency state.  ``dispatches_per_request`` and
         ``compiles_per_1k_requests`` are the two deterministic numbers
         ``bench.py --serve`` gates on."""
         snap = self.tel.snapshot()
@@ -189,13 +427,21 @@ class PredictionService:
             "rebuilds": int(c.get("serve.rebuilds", 0)),
             "degradations": int(c.get("serve.degradations", 0)),
             "host_rows": int(c.get("serve.host_rows", 0)),
+            "rejected": int(c.get("serve.rejected", 0)),
+            "shed": int(c.get("serve.shed", 0)),
+            "retries": int(c.get("serve.retries", 0)),
+            "rollovers": int(c.get("serve.rollovers", 0)),
             "queue_depth": snap.get("gauges", {}).get(
                 "serve.queue_depth", 0),
+            "queue_peak_requests": snap.get("gauges", {}).get(
+                "serve.queue_peak_requests", 0),
             "latency_ms": snap.get("dists", {}).get(
                 "serve.latency_ms"),
             "batch_rows": snap.get("dists", {}).get("serve.batch_rows"),
             "residency": self.residency.stats(),
         }
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
         if requests > 0:
             # steady-state rates: warmup's deliberate dispatches/compiles
             # must not read as a bucketing or recompile regression
@@ -208,15 +454,20 @@ class PredictionService:
         return out
 
     # ------------------------------------------------------------------
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True,
+              drain_timeout_s: Optional[float] = None) -> None:
         """Stop the worker (serving queued requests first when
-        ``drain``), emit the final ``serve_stats`` event and flush."""
+        ``drain``, bounded by ``drain_timeout_s`` — under overload the
+        remaining queue is shed with structured errors rather than
+        blocking shutdown indefinitely), emit the final ``serve_stats``
+        event and flush."""
         if self._closed:
             return
         self._closed = True
-        self.batcher.close(drain=drain)
+        self.batcher.close(drain=drain, drain_timeout_s=drain_timeout_s)
         final = self.stats()
         final.pop("residency", None)
+        final.pop("admission", None)
         self.tel.event("serve_stats", **final)
         if self._trace_out:
             from ..obs import trace as trace_mod

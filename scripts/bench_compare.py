@@ -156,13 +156,28 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   (mp_dispatches_per_iter == dispatches_per_iter, 0.125 at
     #   defaults); an eviction back to the per-iteration sync driver
     #   moves it to >= 3.
+    # - shed_ratio / reject_ratio (bench.py --serve overload leg): the
+    #   gated open-loop overload makes both near-exact by construction
+    #   (the queue fills to its request bound, the gate outlasts every
+    #   queued deadline) — a drift means admission control or deadline
+    #   shedding changed shape;
+    # - overload_unresolved / overload_queue_overflow: MUST stay 0 —
+    #   an unresolved future is a leak, a queue past its bound is the
+    #   unbounded-backlog failure this whole plane exists to prevent;
+    #   zero-to-nonzero always flags;
+    # - rollover_dropped_requests: MUST stay 0 — the atomic-swap
+    #   rollover contract (continuous traffic, zero dropped);
+    #   zero-to-nonzero always flags.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
                  "ingest_dispatches_per_iter", "ingest_chunks",
                  "ingest_max_live_chunks", "ingest_model_mismatch",
                  "mp_dispatches_per_iter",
-                 "dispatches_per_request", "compiles_per_1k_requests"):
+                 "dispatches_per_request", "compiles_per_1k_requests",
+                 "shed_ratio", "reject_ratio", "overload_unresolved",
+                 "overload_queue_overflow",
+                 "rollover_dropped_requests"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue

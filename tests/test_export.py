@@ -316,7 +316,10 @@ def test_serve_access_on_closed_batcher():
            if e["event"] == "serve_access"]
     assert len(acc) == 1
     assert acc[0]["trace_id"] == fut.trace_id
-    assert acc[0]["error"] == "MicroBatcherClosed"
+    # since the overload hardening the submit-after-close failure is
+    # the structured ServeClosed (a ServeError subclass of the
+    # RuntimeError asserted above)
+    assert acc[0]["error"] == "ServeClosed"
 
 
 # --------------------------------------------- per-device memory stats

@@ -1,0 +1,460 @@
+"""Overload-hardened serving: admission control, deadline shedding,
+retry policy, zero-downtime rollover, wedge detection, /readyz.
+
+Tier-1 (fast) coverage of the serving plane's overload features
+(lightgbm_tpu/serve/): every knob defaults OFF, so the companion
+contract — the pre-hardening behavior of an un-configured service —
+stays covered by tests/test_serve.py unchanged.  The open-loop
+acceptance runs (offered load > capacity, rollover under continuous
+traffic) live in tests/test_serve_chaos.py (``-m chaos``).
+
+Dispatch throttling in these tests is a wrapped ``batcher._dispatch``
+holding a gate/sleep — deterministic on any runner, no reliance on the
+CPU being slow.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.resilience import faults as faults_mod
+from lightgbm_tpu.serve import (PredictionService, RetryPolicy,
+                                ServeClosed, ServeDeadlineExceeded,
+                                ServeRejected, ServeWorkerWedged)
+from lightgbm_tpu.serve import batcher as batcher_mod
+from lightgbm_tpu.serve.admission import AdmissionController
+
+TOL = dict(rtol=1e-5, atol=1e-6)
+F = 8
+
+
+def _train(seed=0, n=400, rounds=5, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, F).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.2, "verbose": -1, "min_data_in_leaf": 5}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def bst():
+    return _train(seed=0)
+
+
+@pytest.fixture(scope="module")
+def bst2():
+    return _train(seed=0, rounds=7, learning_rate=0.35)
+
+
+def _svc(bst, **kw):
+    kw.setdefault("max_batch_rows", 64)
+    kw.setdefault("min_bucket_rows", 16)
+    kw.setdefault("max_delay_ms", 0.5)
+    kw.setdefault("batch_events", False)
+    return PredictionService({"m": bst}, **kw)
+
+
+def _gate_dispatch(svc, hold_s=2.0):
+    """Replace the service's dispatch with one that blocks on a gate —
+    the deterministic way to pile up a backlog."""
+    real = svc.batcher._dispatch
+    gate = threading.Event()
+
+    def slow(mid, X):
+        gate.wait(hold_s)
+        return real(mid, X)
+    svc.batcher._dispatch = slow
+    return gate, real
+
+
+def _events(svc, name):
+    return [e for e in svc.tel._events if e.get("event") == name]
+
+
+# ------------------------------------------------------ admission
+def test_reject_structured_and_queue_bounded(bst):
+    svc = _svc(bst, max_queue_requests=4)
+    svc.warmup()
+    gate, _ = _gate_dispatch(svc)
+    futs, rejects = [], []
+    try:
+        for _ in range(25):
+            try:
+                futs.append(svc.submit("m", np.zeros((2, F), np.float32)))
+            except ServeRejected as exc:
+                rejects.append(exc)
+        # the queue never grew past the bound (first batch in flight
+        # holds up to the coalesce budget; the QUEUE stays <= 4)
+        assert len(svc.batcher._q) <= 4
+        assert rejects, "open-loop burst over a 4-deep queue must reject"
+        exc = rejects[0]
+        assert exc.reason in ("queue_requests", "queue_rows")
+        assert exc.retry_after_ms > 0
+        d = exc.details()
+        assert d["error"] == "ServeRejected" and "queue_requests" in d
+    finally:
+        gate.set()
+    for f in futs:
+        f.result(timeout=30)          # everything admitted is served
+    s = svc.stats()
+    assert s["rejected"] == len(rejects)
+    assert s["queue_peak_requests"] <= 4
+    assert _events(svc, "serve_rejected"), "structured reject event"
+    svc.close()
+
+
+def test_oversized_single_request_admits_when_queue_empty(bst):
+    # a request larger than the row bound must still serve (the engine
+    # chunks it) — admission only refuses it when it would pile onto an
+    # existing backlog
+    svc = _svc(bst, max_queue_rows=8)
+    svc.warmup()
+    out = svc.predict("m", np.random.RandomState(3)
+                      .rand(32, F).astype(np.float32))
+    assert out.shape == (32,)
+    assert svc.stats()["rejected"] == 0
+    svc.close()
+
+
+def test_deadline_shed_at_dequeue_before_device_work(bst):
+    svc = _svc(bst)
+    svc.warmup()
+    d0 = svc.stats()["dispatches"]
+    gate, _ = _gate_dispatch(svc)
+    # first request occupies the worker; the rest queue behind it with
+    # a deadline shorter than the gate hold
+    f0 = svc.submit("m", np.zeros((1, F), np.float32))
+    time.sleep(0.05)
+    late = [svc.submit("m", np.zeros((1, F), np.float32),
+                       deadline_ms=100.0) for _ in range(3)]
+    time.sleep(0.3)                    # all three expire while queued
+    gate.set()
+    f0.result(timeout=30)
+    sheds = 0
+    for f in late:
+        with pytest.raises(ServeDeadlineExceeded) as ei:
+            f.result(timeout=30)
+        sheds += 1
+        assert ei.value.fields["waited_ms"] >= 100.0
+        assert ei.value.fields["deadline_ms"] == pytest.approx(100.0)
+    s = svc.stats()
+    assert s["shed"] == sheds == 3
+    # shed BEFORE dispatch: no device work was spent on them
+    assert s["dispatches"] - d0 == 1
+    errs = [e for e in _events(svc, "serve_access")
+            if e.get("error") == "ServeDeadlineExceeded"]
+    assert len(errs) == 3              # shed requests trace too
+    svc.close()
+
+
+def test_service_default_deadline_applies(bst):
+    svc = _svc(bst, default_deadline_ms=80.0)
+    svc.warmup()
+    gate, _ = _gate_dispatch(svc)
+    svc.submit("m", np.zeros((1, F), np.float32))
+    time.sleep(0.05)
+    f = svc.submit("m", np.zeros((1, F), np.float32))   # inherits 80ms
+    time.sleep(0.2)
+    gate.set()
+    with pytest.raises(ServeDeadlineExceeded):
+        f.result(timeout=30)
+    svc.close()
+
+
+# -------------------------------------------------------- retry
+def test_retry_policy_retries_shed_and_reject_only(bst):
+    svc = _svc(bst, max_queue_requests=1)
+    svc.warmup()
+    gate, real = _gate_dispatch(svc)
+    # saturate: one in flight + full queue
+    svc.submit("m", np.zeros((1, F), np.float32))
+    time.sleep(0.05)
+    svc.submit("m", np.zeros((1, F), np.float32))
+    t = threading.Timer(0.3, gate.set)
+    t.start()
+    # the retried predict keeps hitting ServeRejected until the gate
+    # opens and the backlog drains, then succeeds
+    pol = RetryPolicy(max_attempts=40, base_backoff_ms=25,
+                      max_backoff_ms=100)
+    out = svc.predict("m", np.zeros((2, F), np.float32), retry=pol)
+    assert out.shape == (2,)
+    assert svc.stats()["retries"] > 0
+    t.cancel()
+
+    # compute errors are NEVER retried: a poisoned dispatch raises
+    # through predict once, with no retry counter movement
+    calls = []
+
+    def boom(mid, X):
+        calls.append(1)
+        raise ValueError("poisoned")
+    svc.batcher._dispatch = boom
+    r0 = svc.stats()["retries"]
+    with pytest.raises(ValueError):
+        svc.predict("m", np.zeros((1, F), np.float32), retry=pol)
+    assert len(calls) == 1
+    assert svc.stats()["retries"] == r0
+    svc.batcher._dispatch = real
+    svc.close()
+
+
+def test_retry_policy_backoff_honors_server_hint():
+    pol = RetryPolicy(max_attempts=3, base_backoff_ms=10,
+                      backoff_multiplier=2.0, max_backoff_ms=500)
+    assert pol.backoff_ms(0) == 10
+    assert pol.backoff_ms(1) == 20
+    hint = ServeRejected("x", reason="queue_rows", retry_after_ms=120.0)
+    assert pol.backoff_ms(0, hint) == 120.0     # server knows better
+    big = ServeRejected("x", reason="queue_rows", retry_after_ms=9000.0)
+    assert pol.backoff_ms(0, big) == 500        # but capped
+    assert pol.should_retry(hint, 0) and not pol.should_retry(hint, 2)
+    assert not pol.should_retry(ValueError("compute"), 0)
+
+
+# --------------------------------------------- adaptive controller
+def test_admission_controller_hysteresis_no_flap(bst):
+    svc = _svc(bst, target_p99_ms=50.0, max_queue_rows=1024)
+    try:
+        ctl = svc.admission
+        assert ctl is not None and ctl.level == 0
+        b = svc.batcher
+        base_delay, base_rows = b.max_delay_s, b.max_batch_rows
+        # a single spike (or an alternating signal) must NOT move it
+        ctl.step(force=True, p99_ms=500.0)
+        ctl.step(force=True, p99_ms=10.0)
+        ctl.step(force=True, p99_ms=500.0)
+        ctl.step(force=True, p99_ms=60.0)   # dead band resets streaks
+        assert ctl.level == 0 and b.shed_watermark_rows is None
+        # three CONSECUTIVE over-target evaluations escalate
+        for _ in range(3):
+            ctl.step(force=True, p99_ms=500.0)
+        assert ctl.level == 1
+        assert b.max_delay_s == pytest.approx(base_delay / 2)
+        assert b.max_batch_rows == base_rows // 2
+        assert b.shed_watermark_rows == 512
+        for _ in range(3):
+            ctl.step(force=True, p99_ms=500.0)
+        assert ctl.level == 2 and b.shed_watermark_rows == 256
+        # recovery needs consecutive UNDER recover_ratio*target evals
+        for _ in range(3):
+            ctl.step(force=True, p99_ms=10.0)
+        assert ctl.level == 1
+        for _ in range(3):
+            ctl.step(force=True, p99_ms=10.0)
+        assert ctl.level == 0
+        assert b.max_delay_s == pytest.approx(base_delay)
+        assert b.max_batch_rows == base_rows
+        assert b.shed_watermark_rows is None
+        evs = _events(svc, "serve_admission")
+        assert len(evs) == 4 and {e["direction"] for e in evs} == \
+            {"shed", "recover"}
+    finally:
+        svc.close()
+
+
+def test_admission_watermark_rejects_under_hard_cap(bst):
+    svc = _svc(bst, target_p99_ms=50.0, max_queue_rows=1024)
+    svc.warmup()
+    gate, _ = _gate_dispatch(svc)
+    try:
+        for _ in range(3):
+            svc.admission.step(force=True, p99_ms=500.0)
+        assert svc.batcher.shed_watermark_rows == 512
+        svc.submit("m", np.zeros((1, F), np.float32))
+        time.sleep(0.05)               # in flight, holds the worker
+        svc.submit("m", np.zeros((1, F), np.float32))   # queued
+        with pytest.raises(ServeRejected) as ei:
+            # 600 rows onto the backlog clears the 1024 hard cap but
+            # not the level-1 watermark (512)
+            svc.submit("m", np.zeros((600, F), np.float32))
+        assert ei.value.reason == "shed_watermark"
+    finally:
+        gate.set()
+        svc.close()
+
+
+# -------------------------------------------- bounded drain / wedge
+def test_close_drain_timeout_sheds_structured(bst):
+    svc = _svc(bst)
+    svc.warmup()
+    gate, _ = _gate_dispatch(svc, hold_s=1.5)
+    f0 = svc.submit("m", np.zeros((1, F), np.float32))
+    time.sleep(0.05)
+    queued = [svc.submit("m", np.zeros((1, F), np.float32))
+              for _ in range(4)]
+    t0 = time.perf_counter()
+    svc.close(drain_timeout_s=0.2)     # cannot drain through the gate
+    assert time.perf_counter() - t0 < 10.0
+    gate.set()
+    f0.result(timeout=30)              # the in-flight batch completed
+    for f in queued:                   # the backlog was shed, not leaked
+        with pytest.raises(ServeClosed):
+            f.result(timeout=30)
+
+
+def test_wedged_worker_detected_and_reported(bst, monkeypatch):
+    monkeypatch.setenv(faults_mod.FAULTS_ENV, "serve_wedge_worker@1")
+    monkeypatch.setattr(batcher_mod, "_WEDGE_GRACE_S", 0.3)
+    faults_mod._CACHE.clear()
+    svc = _svc(bst)
+    svc.warmup()
+    f1 = svc.submit("m", np.zeros((1, F), np.float32))
+    time.sleep(0.2)                    # worker wedges inside batch 1
+    f2 = svc.submit("m", np.zeros((1, F), np.float32))
+    svc.close(drain_timeout_s=0.2)
+    for f in (f1, f2):                 # in-flight AND queued both fail
+        with pytest.raises(ServeWorkerWedged):
+            f.result(timeout=5)
+    ev = _events(svc, "serve_worker_wedged")
+    assert ev and ev[0]["queued"] == 1 and ev[0]["inflight"] == 1
+
+
+def test_dispatch_error_fault_resolves_batch_and_recovers(bst,
+                                                          monkeypatch):
+    monkeypatch.setenv(faults_mod.FAULTS_ENV, "serve_dispatch_error@1")
+    faults_mod._CACHE.clear()
+    svc = _svc(bst)
+    svc.warmup()
+    with pytest.raises(faults_mod.ServeFaultError):
+        svc.predict("m", np.zeros((1, F), np.float32))
+    # the worker survived: the NEXT request serves normally
+    out = svc.predict("m", np.zeros((1, F), np.float32))
+    assert out.shape == (1,)
+    assert svc.stats()["batches"] >= 1
+    svc.close()
+
+
+# ------------------------------------------------------- rollover
+def test_rollover_swaps_atomically_with_hashes(bst, bst2):
+    svc = _svc(bst)
+    svc.warmup()
+    before = svc.predict("m", np.zeros((3, F), np.float32))
+    rep = svc.rollover("m", bst2)
+    assert rep["promoted"] and rep["old_hash"] != rep["new_hash"]
+    after = svc.predict("m", np.zeros((3, F), np.float32))
+    np.testing.assert_allclose(
+        after, bst2.predict(np.zeros((3, F), np.float64)), **TOL)
+    assert not np.allclose(before, after)
+    ev = _events(svc, "serve_rollover")
+    assert ev and ev[0]["old_hash"] == rep["old_hash"] \
+        and ev[0]["new_hash"] == rep["new_hash"]
+    assert svc.stats()["rollovers"] == 1
+    svc.close()
+
+
+def test_rollover_from_resilience_checkpoint(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    b = _train(seed=2, rounds=6, checkpoint_dir=ckdir,
+               checkpoint_period=2)
+    other = _train(seed=3, rounds=4)
+    svc = _svc(other)
+    svc.warmup()
+    rep = svc.rollover("m", ckdir)     # checkpoint root -> residency
+    assert rep["promoted"]
+    X = np.random.RandomState(5).rand(40, F).astype(np.float32)
+    np.testing.assert_allclose(svc.predict("m", X), b.predict(X), **TOL)
+    assert _events(svc, "serve_rollover")[0]["source"] == "checkpoint"
+    svc.close()
+
+
+def test_rollover_shadow_reports_divergence_and_abort(bst, bst2):
+    svc = _svc(bst)
+    svc.warmup()
+    stop = threading.Event()
+    fails = []
+
+    def traffic():
+        r = np.random.RandomState(11)
+        while not stop.is_set():
+            try:
+                svc.predict("m", r.rand(2, F).astype(np.float32))
+            except Exception as e:     # pragma: no cover
+                fails.append(repr(e))
+    th = threading.Thread(target=traffic, daemon=True)
+    th.start()
+    try:
+        rep = svc.rollover("m", bst2, shadow_requests=4,
+                           shadow_timeout_s=15.0)
+        assert rep["promoted"] and rep["shadow"]["completed"]
+        assert rep["shadow"]["requests"] >= 4
+        assert rep["shadow"]["max_divergence"] > 0
+        assert _events(svc, "serve_shadow")
+        # abort path: a zero tolerance against a diverging candidate
+        # keeps the CURRENT model serving
+        rep2 = svc.rollover("m", bst, shadow_requests=3,
+                            shadow_timeout_s=15.0,
+                            shadow_abort_threshold=0.0)
+        assert not rep2["promoted"]
+        assert _events(svc, "serve_rollover_aborted")
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not fails
+    X = np.zeros((3, F), np.float32)
+    np.testing.assert_allclose(svc.predict("m", X),
+                               bst2.predict(np.zeros((3, F))), **TOL)
+    svc.close()
+
+
+def test_rollover_responses_attributable_to_one_version(bst, bst2):
+    svc = _svc(bst)
+    svc.warmup()
+    h_old = svc.residency.get("m").model_hash[:16]
+    for _ in range(3):
+        svc.predict("m", np.zeros((2, F), np.float32))
+    svc.rollover("m", bst2)
+    h_new = svc.residency.get("m").model_hash[:16]
+    for _ in range(3):
+        svc.predict("m", np.zeros((2, F), np.float32))
+    acc = [e for e in _events(svc, "serve_access")
+           if "model_version" in e]
+    assert len(acc) >= 6
+    seen = {e["model_version"] for e in acc}
+    assert seen == {h_old, h_new}
+    svc.close()
+
+
+# --------------------------------------------------------- readyz
+def test_readyz_gates_on_warmup_and_close(bst):
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.parallel.launcher import _free_port
+    svc = _svc(bst, metrics_port=_free_port())
+
+    def probe():
+        url = svc.metrics_url.replace("/metrics", "/readyz")
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return r.status, r.read().decode().strip()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode().strip()
+    code, reason = probe()
+    assert code == 503 and reason == "warmup_pending"
+    svc.warmup()
+    code, reason = probe()
+    assert code == 200 and reason == "ready"
+    # the training-style exporter (no ready_check) stays ready
+    from lightgbm_tpu.obs.export import MetricsExporter
+    assert MetricsExporter(svc.tel, 0).ready_check is None
+    svc.close()
+
+
+def test_idle_overload_knobs_keep_serving_contract(bst):
+    # all knobs off (defaults): the deterministic serving contract the
+    # bench gates on must be untouched by the overload machinery
+    svc = _svc(bst)
+    svc.warmup()
+    rng = np.random.RandomState(7)
+    for s in (1, 5, 17, 33):
+        svc.predict("m", rng.rand(s, F).astype(np.float32))
+    s = svc.stats()
+    assert s["dispatches_per_request"] == 1.0
+    assert s["compiles_per_1k_requests"] == 0.0
+    assert s["rejected"] == 0 and s["shed"] == 0
+    svc.close()
