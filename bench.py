@@ -484,6 +484,58 @@ def run_micro() -> None:
             mx.stop()
     _emit()   # the obs-leg counters are on stdout now
 
+    # ---- histogram-plane leg: quantized gradients + gain screening +
+    # adaptive per-feature bins (ROADMAP item 4). Two trainings on a
+    # MIXED-CARDINALITY dataset (half the features carry 8 distinct
+    # values — the shape adaptive bins exist for): an f32 full-plane
+    # baseline and the three-cut configuration. Deterministic gates:
+    # `hist_dispatches_per_iter` == dispatches_per_iter EXACTLY (the
+    # cuts ride the megastep, never evict it), `hist_bytes_per_iter`
+    # (the driver's analytic byte model of what the histogram kernels
+    # read/build/keep per iteration — layout arithmetic, zero noise)
+    # must show >= 2x reduction vs `hist_bytes_per_iter_f32`, plus
+    # `hist_quant_bits` and `screening_active_features`.
+    n_hf = 12
+    rng_h = np.random.RandomState(5)
+    Xh = rng_h.rand(n_rows, n_hf).astype(np.float32)
+    Xh[:, n_hf // 2:] = np.floor(Xh[:, n_hf // 2:] * 8.0) / 8.0
+    yh = (Xh @ rng_h.randn(n_hf).astype(np.float32) > 0) \
+        .astype(np.float32)
+    tel_hb = tel_path + ".histbase"
+    dsh = lgb.Dataset(Xh, label=yh, params={"max_bin": 63, "verbose": -1})
+    bsth0 = lgb.train(dict(params, telemetry_out=tel_hb), dsh,
+                      num_boost_round=n_iters)
+    gh0 = bsth0.telemetry().get("gauges", {})
+    _RESULT["hist_bytes_per_iter_f32"] = float(
+        gh0.get("hist.bytes_per_iter", 0.0))
+    tel_hc = tel_path + ".histcut"
+    cut_params = dict(params, telemetry_out=tel_hc,
+                      tpu_quantized_grad=16, tpu_gain_screening=True,
+                      tpu_screening_warmup=2,
+                      tpu_screening_explore_period=4,
+                      tpu_adaptive_bins=True)
+    dsh2 = lgb.Dataset(Xh, label=yh, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bsth = lgb.train(cut_params, dsh2, num_boost_round=n_iters)
+    hist_wall = time.perf_counter() - t0
+    _phase("micro_hist_train_ok")
+    snap_h = bsth.telemetry()
+    ch = snap_h.get("counters", {})
+    gh = snap_h.get("gauges", {})
+    hist_iters = max(1, int(ch.get("iterations", n_iters)))
+    _RESULT["hist_sec_per_iter"] = round(hist_wall / hist_iters, 5)
+    _RESULT["hist_dispatches_per_iter"] = round(
+        float(ch.get("train.dispatches", 0)) / hist_iters, 4)
+    _RESULT["hist_bytes_per_iter"] = float(
+        gh.get("hist.bytes_per_iter", 0.0))
+    _RESULT["hist_quant_bits"] = float(gh.get("hist.quant_bits", 0.0))
+    _RESULT["screening_active_features"] = float(
+        gh.get("screening.active_features", 0.0))
+    _RESULT["hist_bytes_ratio"] = round(
+        _RESULT["hist_bytes_per_iter_f32"]
+        / max(1.0, _RESULT["hist_bytes_per_iter"]), 4)
+    _emit()   # the histogram-plane counters are on stdout now
+
     # ---- ingest leg: chunked streaming ingest + binary dataset cache
     # (lightgbm_tpu/ingest/). Deterministic gates: `ingest_chunks`
     # (two streaming passes x ceil(rows/chunk)),
@@ -585,7 +637,8 @@ def run_micro() -> None:
         _RESULT["mp_iterations_kept"] = mp_iters
     except Exception as e:
         print(f"multiproc leg failed: {e}", file=sys.stderr)
-    for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ing):
+    for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ing, tel_hb,
+              tel_hc):
         try:
             os.remove(p)
         except OSError:

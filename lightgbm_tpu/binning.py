@@ -248,6 +248,17 @@ def find_bin_with_forced(distinct_values: List[float], counts: List[int],
     return bounds
 
 
+def effective_bin_counts(mappers: Sequence["BinMapper"]) -> np.ndarray:
+    """Per-feature EFFECTIVE bin counts (NaN/zero bins included) — what
+    the adaptive per-feature kernel layout
+    (``ops/layout.packed_feature_layout``, ``tpu_adaptive_bins``) sizes
+    each feature's slab from, instead of padding every feature to the
+    global pow2 ``max_bin``.  The single emission point: dataset
+    finalization routes through here, so the layout and the split-scan
+    ``num_bin_per_feat`` can never disagree."""
+    return np.array([max(1, int(m.num_bin)) for m in mappers], np.int32)
+
+
 class BinMapper:
     """Per-feature value→bin mapping (ref: include/LightGBM/bin.h:61)."""
 

@@ -145,6 +145,33 @@ def _round_up_pow2(n: int) -> int:
     return 1 << max(1, (n - 1).bit_length())
 
 
+def _screening_mask_fn(ema: jax.Array, explore, F: int,
+                       keep_k: int) -> jax.Array:
+    """EMA-FS screening mask [F_oh]: keep the top ``keep_k`` REAL
+    features by gain EMA (ties kept), or everything on an exploration
+    round.  Pure/traced — shared by the sync driver's cached mask and
+    the fast paths' in-scan mask so the two cannot drift.  A dataset
+    whose features were all pre-filtered (F == 0 — e.g. a
+    min_data_in_leaf past the row count) has nothing to screen."""
+    if F <= 0 or keep_k >= F:
+        return jnp.ones(ema.shape, bool)
+    kth = jnp.sort(ema[:F])[F - keep_k]
+    return (ema >= kth) | explore
+
+
+def _tree_gain_vec(split_feature: jax.Array, split_gain: jax.Array,
+                   F_oh: int) -> jax.Array:
+    """Realized per-feature split gains of one iteration's trees
+    ([k, L-1] or [L-1] node arrays) — what feeds the gain EMA.  The
+    frontier grower materializes split_gain per node; unused nodes
+    carry feature -1 / gain 0 and contribute nothing."""
+    sf = split_feature.reshape(-1)
+    sg = split_gain.reshape(-1).astype(jnp.float32)
+    ok = (sf >= 0) & jnp.isfinite(sg) & (sg > 0)
+    return jnp.zeros((F_oh,), jnp.float32) \
+        .at[jnp.clip(sf, 0, F_oh - 1)].add(jnp.where(ok, sg, 0.0))
+
+
 class GBDT:
     """Gradient Boosting Decision Tree driver (ref: src/boosting/gbdt.h:35)."""
 
@@ -204,6 +231,16 @@ class GBDT:
         self._epi_fns = None
         self._epi_carry = None
         self._epi_ops = None
+        # histogram-plane cuts (ROADMAP item 4): quantized gradient
+        # histograms, adaptive per-feature bins, EMA-FS gain screening
+        self.quant_bits = 0
+        self.use_adaptive_bins = False
+        self.use_screening = False
+        self.fused_packed = None
+        self._gain_ema_dev = None      # [F_oh] f32 gain EMA (screening)
+        self._iter_gain_acc = None     # sync driver: per-iteration gains
+        self._screen_mask_cache = None
+        self._hist_stats = None
         # distribution axis (ref: tree_learner.cpp:17-49 factory matrix)
         self.parallel_mode = "serial"
         self.mesh = None
@@ -1310,7 +1347,15 @@ class GBDT:
             f_oh = self.fused_f_oh
             n_sh = self.n_shards
 
-            def per_shard(bins_T, gh_T, fm_pad, *nm):
+            quant = self.quant_bits
+
+            def per_shard(bins_T, gh_T, fm_pad, *rest):
+                ri = 0
+                scales = None
+                if quant:
+                    scales = rest[0]
+                    ri = 1
+                nm = rest[ri:]
                 fsm = None
                 if mode == "feature":
                     # this shard owns an equal contiguous block of the
@@ -1335,15 +1380,19 @@ class GBDT:
                     interpret=interp, psum_axis=axis,
                     mono_mode=getattr(self, "mono_mode", "basic"),
                     parallel_mode=mode, top_k=top_k,
-                    feature_shard_mask=fsm)
+                    feature_shard_mask=fsm,
+                    quant_bits=quant, packed=self.fused_packed,
+                    mask_onehot=self._mask_onehot(), gh_scales=scales)
+            q_specs = (P(),) if quant else ()
             if mode == "feature":
                 # rows replicated on every shard; records merge in-jit,
                 # every shard emits the identical tree and row_leaf
-                in_specs = (P(), P(), P()) + ((P(),) if use_nm else ())
+                in_specs = (P(), P(), P()) + q_specs \
+                    + ((P(),) if use_nm else ())
                 out_specs = (P(), P())
             else:
-                in_specs = (P(None, axis), P(None, axis), P()) + \
-                    ((P(),) if use_nm else ())
+                in_specs = (P(None, axis), P(None, axis), P()) + q_specs \
+                    + ((P(),) if use_nm else ())
                 out_specs = (P(), P(axis))
             # the packed gh block is rebuilt every call — donate it so
             # the sharded operand recycles its per-device buffers
@@ -1458,7 +1507,7 @@ class GBDT:
         with CollectiveTrace() as rec:
             yield rec
 
-    def _grow_parallel(self, gh):
+    def _grow_parallel(self, gh, tid: int = 0):
         """Sync-path tree growth through the mesh (driver semantics of
         ref: data_parallel_tree_learner.cpp:126-276 — local histograms,
         global sums, replicated split decisions). ``gh`` is [n, 3]
@@ -1471,20 +1520,34 @@ class GBDT:
             extra.append(self._node_masks_padded() if self.use_fused
                          else self._node_masks_for_iter())
         if self.use_fused:
-            from ..ops.fused_level import pack_gh
+            from ..ops.fused_level import pack_gh, pack_gh_quant
             pad = self.fused_Rp - n
-            gh_T = pack_gh(jnp.pad(gh[:, 0], (0, pad)),
-                           jnp.pad(gh[:, 1], (0, pad)),
-                           jnp.pad(gh[:, 2], (0, pad)), self.fused_nch)
+            g_p = jnp.pad(gh[:, 0], (0, pad))
+            h_p = jnp.pad(gh[:, 1], (0, pad))
+            w_p = jnp.pad(gh[:, 2], (0, pad))
+            qextra = ()
+            if self.quant_bits:
+                # the max-abs scale reduces over the GLOBAL (sharded)
+                # operand, so every shard quantizes on the same grid
+                gh_T, scales = pack_gh_quant(
+                    g_p, h_p, w_p, self.quant_bits,
+                    self._quant_seed(self.iter, tid))
+                qextra = (scales,)
+            else:
+                gh_T = pack_gh(g_p, h_p, w_p, self.fused_nch)
             fm_pad = jnp.zeros((self.fused_f_oh,), bool) \
                 .at[:fm.shape[0]].set(fm)
+            smask = self._screen_mask_for_iter()
+            if smask is not None:
+                fm_pad = fm_pad & smask
             fresh = "fused_sync" not in self._par_fns
             fn = self._get_par_fn("fused_sync")
             with self._maybe_record_collectives(fresh) as rec:
                 tree, row_leaf = fn(self.fused_bins_T, gh_T, fm_pad,
-                                    *extra)
+                                    *qextra, *extra)
             if rec is not None:
                 self._coll_per_grow = rec.profile
+            self._note_tree_gains(tree)
             return tree, row_leaf[:n]
         if self.use_cegb:
             extra.append(jnp.asarray(self.cegb_used))
@@ -1681,18 +1744,61 @@ class GBDT:
             self.use_cegb = False
         if self.grow_policy != "depthwise":
             self.use_fused = self.use_frontier = False
+        # ---- histogram-plane cuts (ROADMAP item 4). Each gates
+        # independently; all three are fused-engine features — other
+        # engines degrade with a structured event and train unchanged.
+        qb = int(getattr(config, "tpu_quantized_grad", 0) or 0)
+        if qb not in (0, 8, 16):
+            log.fatal("tpu_quantized_grad must be 0, 8 or 16; got %s", qb)
+        if qb and not self.use_fused:
+            log.info("tpu_quantized_grad requires the fused engine; "
+                     "training with f32 histograms")
+            self.telemetry.degrade("quantized_grad_needs_fused",
+                                   requested=qb)
+            qb = 0
+        self.quant_bits = qb
+        adaptive = bool(getattr(config, "tpu_adaptive_bins", False))
+        if adaptive and not self.use_fused:
+            self.telemetry.degrade("adaptive_bins_needs_fused")
+            adaptive = False
+        if adaptive and getattr(self, "use_bundles", False):
+            # EFB already owns the packed flat axis (bundle columns)
+            log.info("tpu_adaptive_bins is subsumed by feature bundling; "
+                     "keeping the bundle layout")
+            self.telemetry.degrade("adaptive_bins_with_efb")
+            adaptive = False
+        if adaptive and self.parallel_mode == "voting":
+            # the voting exchange slices the flat axis per LOGICAL
+            # feature (reshape(F, B)) — incompatible with class packing
+            self.telemetry.degrade("adaptive_bins_with_voting")
+            adaptive = False
+        self.use_adaptive_bins = adaptive
+        scr = bool(getattr(config, "tpu_gain_screening", False))
+        if scr and not self.use_fused:
+            self.telemetry.degrade("gain_screening_needs_fused")
+            scr = False
+        self.use_screening = scr
+        self._screen_mask_cache = None
+        self._iter_gain_acc = None
         if self.use_fused:
             if not hasattr(self, "fused_bins_T") \
                     or getattr(self, "_fused_built_mode", None) \
-                    != self.parallel_mode:
-                # (re)build: the row padding and mesh placement of the
-                # transposed matrix depend on the parallel mode
+                    != (self.parallel_mode, self.use_adaptive_bins):
+                # (re)build: the row padding, mesh placement and packing
+                # of the transposed matrix depend on the parallel mode
+                # and the adaptive layout
                 self._init_fused(self.train_data)
             else:
                 from ..ops.fused_level import NCH_FAST, NCH_PRECISE
                 self.fused_nch = (NCH_FAST
                                   if config.tpu_hist_precision == "bf16"
                                   else NCH_PRECISE)
+            if self.quant_bits:
+                # quantized channel layout overrides tpu_hist_precision:
+                # 8 -> (g, h, w) int8; 16 -> int8 hi/lo split (5 ch)
+                from ..ops.quantize import QNCH
+                self.fused_nch = QNCH[self.quant_bits]
+            self._publish_hist_gauges()
         elif self.use_frontier and not hasattr(self, "bins_i32_dev"):
             self._init_frontier(self.train_data)
 
@@ -1723,6 +1829,19 @@ class GBDT:
         F = train_data.num_features
         F_oh, Bp = feature_layout(F, self.max_bins)
         R = self.num_data
+        # adaptive per-feature bin widths (tpu_adaptive_bins): pack each
+        # feature's slab at ITS pow2 width instead of the global Bp; the
+        # bin matrix rows are permuted into width-class order so the
+        # kernel builds each class with one bulk repeat+compare
+        self.fused_packed = None
+        feat_order = None
+        if getattr(self, "use_adaptive_bins", False) \
+                and not getattr(self, "use_bundles", False) and F > 0:
+            from ..ops.layout import packed_feature_layout
+            self.fused_packed = packed_feature_layout(
+                np.asarray(train_data.num_bin_per_feat), self.max_bins,
+                f_oh=F_oh)
+            feat_order = np.asarray(self.fused_packed.feat_order, np.int64)
         # row-sharded modes (data/voting) need kernel-tile-aligned local
         # rows per shard; 2048 = the widest shallow-pass tile
         # (default_tile_rows cap), so shallow levels can actually run at
@@ -1773,8 +1892,11 @@ class GBDT:
         elif self.mp is not None:
             Fp = max(F_oh, 8)
             dtype = jnp.int8 if Bp <= 128 else jnp.int16
+            rows_np = np.asarray(self.train_data.bins)
+            if feat_order is not None:
+                rows_np = rows_np[:, feat_order]
             self.fused_bins_T = self._mp_fused_bins_T(
-                np.asarray(self.train_data.bins), Fp, Rp, Bp)
+                rows_np, Fp, Rp, Bp)
             self.fused_bundle_cols = 0
             self.fused_bundle_col_bins = 0
             self.fused_bundle_cfg = None
@@ -1787,9 +1909,15 @@ class GBDT:
             # transpose + pad ON DEVICE from the already-uploaded bin
             # matrix: a second 300+ MB host transpose + host->device
             # transfer through the remote tunnel costs ~10 s at Higgs scale
+            src = self.bins_dev.T.astype(dtype)
+            if feat_order is not None:
+                # width-class permutation of the feature rows (adaptive
+                # layout; the logical order is recovered at plane decode)
+                src = jnp.take(src, jnp.asarray(feat_order, jnp.int32),
+                               axis=0)
             self.fused_bins_T = (
                 jnp.zeros((Fp, Rp), dtype)
-                .at[:F, :R].set(self.bins_dev.T.astype(dtype)))
+                .at[:F, :R].set(src))
             self.fused_bundle_cols = 0
             self.fused_bundle_col_bins = 0
             self.fused_bundle_cfg = None
@@ -1812,9 +1940,16 @@ class GBDT:
         self.fused_f_oh = F_oh
         self.fused_Bp = Bp
         self.fused_Rp = Rp
-        self._fused_built_mode = self.parallel_mode
+        self._fused_built_mode = (self.parallel_mode,
+                                  bool(self.use_adaptive_bins))
         self.fused_nch = (NCH_FAST if self.config.tpu_hist_precision == "bf16"
                           else NCH_PRECISE)
+        # the gain EMA is sized to the padded feature axis; keep a live
+        # EMA across reset_config (continued training) unless the shape
+        # moved
+        if self._gain_ema_dev is None \
+                or self._gain_ema_dev.shape[0] != F_oh:
+            self._gain_ema_dev = jnp.zeros((F_oh,), jnp.float32)
         nb = np.zeros(F_oh, np.int32)
         nb[:F] = np.asarray(self.meta.num_bin)
         mt = np.zeros(F_oh, np.int32)
@@ -2076,20 +2211,30 @@ class GBDT:
         return add
 
     # ------------------------------------------------------------------
-    def _grow(self, gh):
+    def _grow(self, gh, tid: int = 0):
         if self.parallel_mode != "serial":
-            return self._grow_parallel(gh)
+            return self._grow_parallel(gh, tid)
         fm = self._feature_mask()
         if self.use_fused:
             from ..models.frontier2 import grow_tree_fused
-            from ..ops.fused_level import pack_gh
+            from ..ops.fused_level import pack_gh, pack_gh_quant
             n = self.num_data
             pad = self.fused_Rp - n
-            gh_T = pack_gh(jnp.pad(gh[:, 0], (0, pad)),
-                           jnp.pad(gh[:, 1], (0, pad)),
-                           jnp.pad(gh[:, 2], (0, pad)), self.fused_nch)
+            g_p = jnp.pad(gh[:, 0], (0, pad))
+            h_p = jnp.pad(gh[:, 1], (0, pad))
+            w_p = jnp.pad(gh[:, 2], (0, pad))
+            scales = None
+            if self.quant_bits:
+                gh_T, scales = pack_gh_quant(
+                    g_p, h_p, w_p, self.quant_bits,
+                    self._quant_seed(self.iter, tid))
+            else:
+                gh_T = pack_gh(g_p, h_p, w_p, self.fused_nch)
             fm_pad = jnp.zeros((self.fused_f_oh,), bool) \
                 .at[:fm.shape[0]].set(fm)
+            smask = self._screen_mask_for_iter()
+            if smask is not None:
+                fm_pad = fm_pad & smask
             tree, row_leaf = grow_tree_fused(
                 self.fused_bins_T, gh_T, self.fused_meta, fm_pad,
                 self.params, self.max_leaves, self.fused_Bp,
@@ -2104,7 +2249,10 @@ class GBDT:
                 bundle_col_bins=self.fused_bundle_col_bins,
                 bundle_cfg=self.fused_bundle_cfg,
                 interpret=self.fused_interpret,
-                mono_mode=getattr(self, "mono_mode", "basic"))
+                mono_mode=getattr(self, "mono_mode", "basic"),
+                quant_bits=self.quant_bits, packed=self.fused_packed,
+                mask_onehot=self._mask_onehot(), gh_scales=scales)
+            self._note_tree_gains(tree)
             return tree, row_leaf[:n]
         if self.use_frontier:
             from ..models.frontier import grow_tree_frontier
@@ -2207,6 +2355,146 @@ class GBDT:
         mask = np.zeros(F, bool)
         mask[chosen] = True
         return mask if mp else jnp.asarray(mask)
+
+    # ---------------------------------------- histogram-plane cuts
+    def _mask_onehot(self) -> bool:
+        """Screened-out features' one-hot slabs are zeroed in the fused
+        kernel (bundle columns interleave logical features, so EFB runs
+        keep the full build and screen at the split scan only)."""
+        return bool(self.use_screening) \
+            and not getattr(self, "fused_bundle_cols", 0)
+
+    def _screening_keep_k(self) -> int:
+        F = self.train_data.num_features
+        ratio = float(self.config.tpu_screening_keep_ratio)
+        return max(1, min(F, int(round(F * ratio))))
+
+    def _screening_explore(self, it: int) -> bool:
+        """Exploration rounds keep the full feature set eligible so a
+        feature useless early but decisive late re-enters the mask."""
+        cfg = self.config
+        if it < int(cfg.tpu_screening_warmup):
+            return True
+        p = int(cfg.tpu_screening_explore_period)
+        return p > 0 and it % p == 0
+
+    def _ensure_gain_ema(self):
+        F_oh = self.fused_f_oh
+        if self._gain_ema_dev is None \
+                or self._gain_ema_dev.shape[0] != F_oh:
+            self._gain_ema_dev = jnp.zeros((F_oh,), jnp.float32)
+        return self._gain_ema_dev
+
+    def _screen_mask_for_iter(self):
+        """Sync driver's screening mask (device [F_oh] bool), cached per
+        iteration so all k class trees share one mask like the fast
+        paths do. None = screening off."""
+        if not self.use_screening:
+            return None
+        cached = self._screen_mask_cache
+        if cached is not None and cached[0] == self.iter:
+            return cached[1]
+        m = _screening_mask_fn(
+            self._ensure_gain_ema(),
+            jnp.asarray(self._screening_explore(self.iter)),
+            self.train_data.num_features, self._screening_keep_k())
+        self._screen_mask_cache = (self.iter, m)
+        return m
+
+    def _note_tree_gains(self, tree) -> None:
+        """Sync driver: accumulate one tree's realized split gains; the
+        EMA applies once per iteration (_finish_screen_iter) so the
+        update order matches the fast paths' once-per-iteration form."""
+        if not self.use_screening:
+            return
+        g = _tree_gain_vec(tree.split_feature, tree.split_gain,
+                           self.fused_f_oh)
+        acc = self._iter_gain_acc
+        self._iter_gain_acc = g if acc is None else acc + g
+
+    def _finish_screen_iter(self) -> None:
+        if not self.use_screening or self._iter_gain_acc is None:
+            return
+        a = jnp.float32(float(self.config.tpu_screening_ema_alpha))
+        self._gain_ema_dev = (a * self._ensure_gain_ema()
+                              + (1.0 - a) * self._iter_gain_acc)
+        self._iter_gain_acc = None
+        self._screen_mask_cache = None
+
+    def _quant_seed(self, it: int, tid: int = 0) -> np.uint32:
+        """Stochastic-rounding dither seed: one stream per (iteration,
+        class tree), shared by the sync driver / pipelined fast path /
+        megastep so all drivers quantize on the same dither streams
+        (identical reruns and checkpoint resumes are byte-identical;
+        ACROSS drivers ulp-level score differences can still flip
+        rounds at the dither threshold — docs/Performance.md
+        'Histogram plane')."""
+        return np.uint32((it * self.num_tree_per_iteration + tid)
+                         & 0xFFFFFFFF)
+
+    def _megastep_aux(self, chunk: int):
+        """Per-chunk screening/quantization scan operands: the EMA
+        carry, the per-iteration exploration flags, and the per-
+        iteration dither seed base (xs)."""
+        k = self.num_tree_per_iteration
+        ema0 = self._ensure_gain_ema() if self.use_screening else None
+        explore_B = None
+        if self.use_screening:
+            explore_B = jnp.asarray(
+                [self._screening_explore(self.iter + b)
+                 for b in range(chunk)])
+        seeds_B = None
+        if self.quant_bits:
+            seeds_B = jnp.asarray(
+                (np.arange(self.iter, self.iter + chunk,
+                           dtype=np.int64) * k) & 0xFFFFFFFF,
+                dtype=jnp.uint32)
+        return ema0, explore_B, seeds_B
+
+    def _hist_plane_stats(self) -> Dict[str, int]:
+        """Deterministic byte model of the histogram plane under the
+        CURRENT layout/quantization (ops/layout.hist_plane_bytes): what
+        the bench records as hist_bytes_per_iter and the exporter
+        scrapes as hist.bytes_per_level."""
+        from ..models.frontier2 import level_caps
+        from ..ops.fused_level import default_tile_rows, max_slot_cap
+        from ..ops.layout import hist_plane_bytes
+        kF = self.fused_bundle_cols or self.fused_f_oh
+        kB = (self.fused_bundle_col_bins if self.fused_bundle_cols
+              else self.fused_Bp)
+        fb_padded = kF * kB
+        fb = (self.fused_packed.fb if self.fused_packed is not None
+              else fb_padded)
+        nch = self.fused_nch
+        caps = level_caps(self.max_leaves, int(self.config.max_depth),
+                          int(self.config.tpu_extra_levels),
+                          slot_cap=max_slot_cap(fb_padded, nch))
+        sp_max = max([8] + [max(8, c) for c in caps])
+        tile = min(self.fused_Rp,
+                   default_tile_rows(sp_max, fb_padded, nch,
+                                     wide_bins=kB > 256))
+        per_level = hist_plane_bytes(fb, nch, sp_max, self.fused_Rp,
+                                     tile, self.quant_bits)
+        n_levels = len(caps) + 1   # + the root pass
+        return {"bytes_per_level": per_level,
+                "bytes_per_iter": per_level * n_levels
+                * self.num_tree_per_iteration,
+                "fb": fb, "fb_padded": fb_padded, "levels": n_levels}
+
+    def _publish_hist_gauges(self) -> None:
+        if not self.use_fused:
+            return
+        try:
+            self._hist_stats = st = self._hist_plane_stats()
+        except Exception as e:   # a gauge must never kill training
+            log.debug("hist plane stats failed: %s", e)
+            return
+        tel = self.telemetry
+        tel.gauge("hist.bytes_per_level", float(st["bytes_per_level"]))
+        tel.gauge("hist.bytes_per_iter", float(st["bytes_per_iter"]))
+        tel.gauge("hist.quant_bits", float(self.quant_bits))
+        tel.gauge("hist.fb", float(st["fb"]))
+        tel.gauge("hist.fb_padded", float(st["fb_padded"]))
 
     # ------------------------------------------------------------------
     def _to_host_tree(self, tree: TreeArrays, shrinkage: float) -> Tuple[
@@ -2685,12 +2973,23 @@ class GBDT:
         # histograms — the flagship kernel stays in play on the pod)
         mode = self.parallel_mode
         par = mode in ("data", "voting")
+        quant = self.quant_bits
+        screening = self.use_screening
+        mask_oh = self._mask_onehot()
+        packed = self.fused_packed
+        if quant:
+            from ..ops.fused_level import pack_gh_quant
+        if screening:
+            alpha = jnp.float32(float(self.config.tpu_screening_ema_alpha))
+            keep_k = self._screening_keep_k()
+            F_real = self.train_data.num_features
+        F_oh = self.fused_f_oh
         if par:
             from jax.sharding import PartitionSpec as P
             axis = self.axis_name
             top_k = int(self.config.top_k) if mode == "voting" else 0
 
-            def grow_one(bins_T, gh_T, fm_pad):
+            def grow_one(bins_T, gh_T, fm_pad, *qrest):
                 tree, row_leaf = grow_tree_fused(
                     bins_T, gh_T, self.fused_meta, fm_pad,
                     self.params, self.max_leaves, self.fused_Bp,
@@ -2703,26 +3002,47 @@ class GBDT:
                     bundle_cfg=self.fused_bundle_cfg,
                     interpret=interp, psum_axis=axis,
                     mono_mode=getattr(self, "mono_mode", "basic"),
-                    parallel_mode=mode, top_k=top_k)
+                    parallel_mode=mode, top_k=top_k,
+                    quant_bits=quant, packed=packed,
+                    mask_onehot=mask_oh,
+                    gh_scales=qrest[0] if quant else None)
                 delta = table_lookup(row_leaf[None, :],
                                      tree.leaf_value * shrink,
                                      interpret=interp)[0]
                 return tree, delta
             grow_one_sharded = _shard_map(
                 grow_one, mesh=self.mesh,
-                in_specs=(P(None, axis), P(None, axis), P()),
+                in_specs=(P(None, axis), P(None, axis), P())
+                + ((P(),) if quant else ()),
                 out_specs=(P(), P(axis)), check_vma=False)
 
-        def grow_k_trees(bins_T, scores, grad, hess, bag_weight, fm_pads):
+        def grow_k_trees(bins_T, scores, grad, hess, bag_weight, fm_pads,
+                         ema=None, explore=None, seed=None):
+            smask = None
+            if screening:
+                # EMA-FS screening (arxiv 2606.26337): one in-trace
+                # top-k mask per iteration over the gain-EMA carry,
+                # composed with the feature_fraction masks; exploration
+                # rounds keep the mask fully open
+                smask = _screening_mask_fn(ema, explore, F_real, keep_k)
             trees = []
             for tid in range(k):
-                gh_T = pack_gh(
-                    jnp.pad(grad[tid] * bag_weight, (0, pad)),
-                    jnp.pad(hess[tid] * bag_weight, (0, pad)),
-                    jnp.pad(bag_weight, (0, pad)), self.fused_nch)
+                fm_t = fm_pads[tid] & smask if screening \
+                    else fm_pads[tid]
+                g_p = jnp.pad(grad[tid] * bag_weight, (0, pad))
+                h_p = jnp.pad(hess[tid] * bag_weight, (0, pad))
+                w_p = jnp.pad(bag_weight, (0, pad))
+                scales = None
+                if quant:
+                    gh_T, scales = pack_gh_quant(
+                        g_p, h_p, w_p, quant,
+                        seed + jnp.uint32(tid))
+                else:
+                    gh_T = pack_gh(g_p, h_p, w_p, self.fused_nch)
                 if par:
-                    tree, delta = grow_one_sharded(bins_T, gh_T,
-                                                   fm_pads[tid])
+                    args = (bins_T, gh_T, fm_t) \
+                        + ((scales,) if quant else ())
+                    tree, delta = grow_one_sharded(*args)
                     # a dried-up class (no split found) contributes
                     # NOTHING: the sync path appends a zero constant tree
                     # for it (gbdt.cpp:421-437 beyond the first
@@ -2730,7 +3050,7 @@ class GBDT:
                     delta = jnp.where(tree.num_leaves > 1, delta[:n], 0.0)
                 else:
                     tree, row_leaf = grow_tree_fused(
-                        bins_T, gh_T, self.fused_meta, fm_pads[tid],
+                        bins_T, gh_T, self.fused_meta, fm_t,
                         self.params, self.max_leaves, self.fused_Bp,
                         self.fused_f_oh, num_rows=n, nch=self.fused_nch,
                         max_depth=max_depth, extra_levels=extra,
@@ -2740,14 +3060,23 @@ class GBDT:
                         bundle_col_bins=self.fused_bundle_col_bins,
                         bundle_cfg=self.fused_bundle_cfg,
                         interpret=interp,
-                        mono_mode=getattr(self, "mono_mode", "basic"))
+                        mono_mode=getattr(self, "mono_mode", "basic"),
+                        quant_bits=quant, packed=packed,
+                        mask_onehot=mask_oh, gh_scales=scales)
                     delta = tree_score_delta(tree, row_leaf, shrink,
                                              num_rows=n, interpret=interp)
                 scores = scores.at[tid].add(delta)
                 trees.append(tree)
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees)
-            return scores, stacked
+            if screening:
+                # once-per-iteration EMA update from the realized split
+                # gains the trees materialize (same order as the sync
+                # driver's _finish_screen_iter)
+                gvec = _tree_gain_vec(stacked.split_feature,
+                                      stacked.split_gain, F_oh)
+                ema = alpha * ema + (1.0 - alpha) * gvec
+            return scores, stacked, ema
         return grow_k_trees
 
     def _make_fast_step(self):
@@ -2765,13 +3094,28 @@ class GBDT:
         # The score matrix is donated: the previous buffer dies at the
         # call, so XLA updates the [k, n] f32 in place instead of
         # round-tripping a fresh allocation through HBM each iteration.
-        def step(bins_T, scores, grad_in, hess_in, bag_weight, fm_pads):
+        ext = bool(self.use_screening or self.quant_bits)
+        if not ext:
+            def step(bins_T, scores, grad_in, hess_in, bag_weight,
+                     fm_pads):
+                if in_jit_grads:
+                    grad, hess = obj.gradients_from(scores, grad_in)
+                else:
+                    grad, hess = grad_in, hess_in
+                scores, stacked, _ = grow_k(bins_T, scores, grad, hess,
+                                            bag_weight, fm_pads)
+                return scores, stacked
+            return jax.jit(step, donate_argnums=_donate(1))
+
+        def step_ext(bins_T, scores, grad_in, hess_in, bag_weight,
+                     fm_pads, ema, explore, seed):
             if in_jit_grads:
                 grad, hess = obj.gradients_from(scores, grad_in)
             else:
                 grad, hess = grad_in, hess_in
-            return grow_k(bins_T, scores, grad, hess, bag_weight, fm_pads)
-        return jax.jit(step, donate_argnums=_donate(1))
+            return grow_k(bins_T, scores, grad, hess, bag_weight,
+                          fm_pads, ema, explore, seed)
+        return jax.jit(step_ext, donate_argnums=_donate(1))
 
     # ------------------------------------------------------------------
     # Fused boosting epilogue (ops/fused_level.epilogue_pass): the final
@@ -2785,11 +3129,18 @@ class GBDT:
         if self._epi_ok_cache is None:
             spec = (self.objective.epilogue_spec()
                     if self.objective is not None else None)
+            # the histogram-plane cuts bypass the fused epilogue: its
+            # kernel computes gradients/root histogram on the padded f32
+            # layout, and screening's per-tree mask must reach the NEXT
+            # tree's root build (docs/Performance.md eligibility matrix)
             self._epi_ok_cache = bool(
                 spec is not None
                 and bool(self.config.tpu_fused_epilogue)
                 and self.num_tree_per_iteration == 1
-                and self.parallel_mode == "serial")
+                and self.parallel_mode == "serial"
+                and not self.quant_bits
+                and not self.use_adaptive_bins
+                and not self.use_screening)
         return self._epi_ok_cache
 
     def _make_epi_fns(self):
@@ -2968,10 +3319,24 @@ class GBDT:
                 jnp.zeros((F_oh,), bool).at[:self.train_data.num_features]
                 .set(self._feature_mask()) for _ in range(k)])
         self.telemetry.inc("train.dispatches")
+        ext = bool(self.use_screening or self.quant_bits)
         with self._maybe_record_collectives(fresh_step) as rec:
-            self.scores, trees = self._fast_step_fn(
-                self.fused_bins_T, self.scores, grad_in, hess_in,
-                self.bag_weight, fm_pads)
+            if ext:
+                ema = (self._ensure_gain_ema() if self.use_screening
+                       else None)
+                explore = (jnp.asarray(self._screening_explore(self.iter))
+                           if self.use_screening else None)
+                seed = (jnp.uint32(self._quant_seed(self.iter))
+                        if self.quant_bits else None)
+                self.scores, trees, ema2 = self._fast_step_fn(
+                    self.fused_bins_T, self.scores, grad_in, hess_in,
+                    self.bag_weight, fm_pads, ema, explore, seed)
+                if self.use_screening:
+                    self._gain_ema_dev = ema2
+            else:
+                self.scores, trees = self._fast_step_fn(
+                    self.fused_bins_T, self.scores, grad_in, hess_in,
+                    self.bag_weight, fm_pads)
         if rec is not None:
             self._coll_per_iter = rec.profile
         return self._finish_fast_iter(trees, init_scores)
@@ -3229,6 +3594,22 @@ class GBDT:
             # here, so this is where the HBM watermarks move
             from ..obs.jaxmon import memory_watermarks
             memory_watermarks(tel, where="drain")
+        if tel.enabled and flat and self.use_screening \
+                and self._gain_ema_dev is not None:
+            # screening visibility: how many features the NEXT non-
+            # exploration mask keeps (host mirror of _screening_mask_fn
+            # over the just-settled EMA; the drain already synced)
+            try:
+                ema = np.asarray(self._gain_ema_dev)
+                F = self.train_data.num_features
+                keep_k = self._screening_keep_k()
+                kth = np.sort(ema[:F])[F - keep_k]
+                tel.gauge("screening.active_features",
+                          float(np.sum(ema[:F] >= kth)))
+            except Exception as e:   # a gauge must never kill training
+                log.debug("screening gauge failed: %s", e)
+        if tel.enabled and flat:
+            self._publish_hist_gauges()
         self._batch_t0 = self._batch_w0 = None
         self._batch_fused = 0
         # drain boundaries are the fast path's natural consistency
@@ -3567,11 +3948,22 @@ class GBDT:
         with jax.profiler.StepTraceAnnotation("megastep",
                                               step_num=self.iter), \
                 self._maybe_record_collectives(fresh_fn) as coll_rec:
+            ext = bool(self.use_screening or self.quant_bits)
             if plan is None:
-                scores, vscores, trees_B = fn(
-                    self.fused_bins_T, self.scores,
-                    tuple(self.valid_bins), tuple(self.valid_scores),
-                    operands, self.bag_weight, fm_pads)
+                if ext:
+                    ema0, explore_B, seeds_B = self._megastep_aux(chunk)
+                    scores, vscores, trees_B, ema2 = fn(
+                        self.fused_bins_T, self.scores,
+                        tuple(self.valid_bins), tuple(self.valid_scores),
+                        operands, self.bag_weight, fm_pads, ema0,
+                        explore_B, seeds_B)
+                    if self.use_screening:
+                        self._gain_ema_dev = ema2
+                else:
+                    scores, vscores, trees_B = fn(
+                        self.fused_bins_T, self.scores,
+                        tuple(self.valid_bins), tuple(self.valid_scores),
+                        operands, self.bag_weight, fm_pads)
             else:
                 if self._plan_ops is None:
                     self._plan_ops = plan.operands()
@@ -3579,11 +3971,24 @@ class GBDT:
                     self._es_carry = self._init_es_carry(plan.n_slots)
                 iters_B = jnp.arange(self.iter, self.iter + chunk,
                                      dtype=jnp.int32)
-                scores, vscores, self._es_carry, trees_B, metrics_B = fn(
-                    self.fused_bins_T, self.scores,
-                    tuple(self.valid_bins), tuple(self.valid_scores),
-                    operands, self.bag_weight, fm_pads, iters_B,
-                    self._plan_ops, self._es_carry)
+                if ext:
+                    ema0, explore_B, seeds_B = self._megastep_aux(chunk)
+                    (scores, vscores, self._es_carry, trees_B,
+                     metrics_B, ema2) = fn(
+                        self.fused_bins_T, self.scores,
+                        tuple(self.valid_bins), tuple(self.valid_scores),
+                        operands, self.bag_weight, fm_pads, iters_B,
+                        self._plan_ops, self._es_carry, ema0,
+                        explore_B, seeds_B)
+                    if self.use_screening:
+                        self._gain_ema_dev = ema2
+                else:
+                    (scores, vscores, self._es_carry, trees_B,
+                     metrics_B) = fn(
+                        self.fused_bins_T, self.scores,
+                        tuple(self.valid_bins), tuple(self.valid_scores),
+                        operands, self.bag_weight, fm_pads, iters_B,
+                        self._plan_ops, self._es_carry)
         if coll_rec is not None:
             # the scan traces its body ONCE regardless of chunk length,
             # so the recorded totals are the per-iteration schedule
@@ -3640,38 +4045,62 @@ class GBDT:
                                    is not None else None)
             for vi in range(len(self.valid_scores))]
 
+        ext = bool(self.use_screening or self.quant_bits)
+
         def one_iteration(bins_T, scores, vbins, vscores, grad_ops,
-                          bag_weight, fm_pads):
+                          bag_weight, fm_pads, ema=None, explore=None,
+                          seed=None):
             """The SAME traced bodies as the per-iteration fast path —
             _make_fused_tree_loop for growth/score updates and
             _make_valid_apply per valid set — scanned, so the megastep
             is bit-identical to the pipelined path by construction."""
             grad, hess = obj.gradients_from(scores, grad_ops)
-            scores, stacked = grow_k(bins_T, scores, grad, hess,
-                                     bag_weight, fm_pads)
+            scores, stacked, ema = grow_k(bins_T, scores, grad, hess,
+                                          bag_weight, fm_pads, ema,
+                                          explore, seed)
             vscores = tuple(
                 apply_v(vscore, vb, stacked)
                 for apply_v, vscore, vb in zip(valid_appliers, vscores,
                                                vbins))
-            return scores, vscores, stacked
+            return scores, vscores, stacked, ema
 
         plan = self._traced_plan if self._eval_consumer is not None \
             else None
         if plan is None:
-            def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
-                     fm_pads_B):
-                def body(carry, fm_pads):
-                    scores, vscores = carry
-                    scores, vscores, stacked = one_iteration(
+            if not ext:
+                def step(bins_T, scores, vbins, vscores, grad_ops,
+                         bag_weight, fm_pads_B):
+                    def body(carry, fm_pads):
+                        scores, vscores = carry
+                        scores, vscores, stacked, _ = one_iteration(
+                            bins_T, scores, vbins, vscores, grad_ops,
+                            bag_weight, fm_pads)
+                        return (scores, vscores), stacked
+                    (scores, vscores), trees_B = jax.lax.scan(
+                        body, (scores, vscores), fm_pads_B)
+                    return scores, vscores, trees_B
+                # donate the score carry and every valid-score buffer:
+                # the scan rewrites them in place across the whole chunk
+                return jax.jit(step, donate_argnums=_donate(1, 3))
+
+            def step_ext(bins_T, scores, vbins, vscores, grad_ops,
+                         bag_weight, fm_pads_B, ema0, explore_B,
+                         seeds_B):
+                # the gain EMA rides the scan CARRY (screening feedback
+                # within the chunk); exploration flags and dither seeds
+                # ride as xs alongside the feature masks
+                def body(carry, xs):
+                    scores, vscores, ema = carry
+                    fm_pads, explore, seed = xs
+                    scores, vscores, stacked, ema = one_iteration(
                         bins_T, scores, vbins, vscores, grad_ops,
-                        bag_weight, fm_pads)
-                    return (scores, vscores), stacked
-                (scores, vscores), trees_B = jax.lax.scan(
-                    body, (scores, vscores), fm_pads_B)
-                return scores, vscores, trees_B
-            # donate the score carry and every valid-score buffer: the
-            # scan rewrites them in place across the whole chunk
-            return jax.jit(step, donate_argnums=_donate(1, 3))
+                        bag_weight, fm_pads, ema, explore, seed)
+                    return (scores, vscores, ema), stacked
+                (scores, vscores, ema), trees_B = jax.lax.scan(
+                    body, (scores, vscores, ema0),
+                    (fm_pads_B, explore_B, seeds_B))
+                return scores, vscores, trees_B, ema
+            return jax.jit(step_ext, donate_argnums=_donate(1, 3))
 
         # ---- on-device eval variant: the scan additionally computes
         # every configured metric per iteration (traced reductions over
@@ -3713,27 +4142,58 @@ class GBDT:
             stop_it = jnp.where(stopped | ~trigger, stop_it, it)
             return (best, bround, stopped | trigger, stop_it)
 
-        def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
-                 fm_pads_B, iters_B, metric_ops, es0):
+        if not ext:
+            def step(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
+                     fm_pads_B, iters_B, metric_ops, es0):
+                def body(carry, xs):
+                    scores, vscores, es = carry
+                    fm_pads, it = xs
+                    active = ~es[2]
+                    new_scores, new_vscores, stacked, _ = one_iteration(
+                        bins_T, scores, vbins, vscores, grad_ops,
+                        bag_weight, fm_pads)
+                    # freeze past the stop latch: the tree still comes
+                    # out of the scan (static shapes) but contributes
+                    # nothing
+                    scores = jnp.where(active, new_scores, scores)
+                    vscores = tuple(jnp.where(active, nv, v)
+                                    for nv, v in zip(new_vscores,
+                                                     vscores))
+                    mvals = plan.eval_in_scan(scores, vscores, metric_ops)
+                    es = es_update(es, mvals, it, active)
+                    return (scores, vscores, es), (stacked, mvals)
+                (scores, vscores, es), (trees_B, metrics_B) = \
+                    jax.lax.scan(body, (scores, vscores, es0),
+                                 (fm_pads_B, iters_B))
+                return scores, vscores, es, trees_B, metrics_B
+            return jax.jit(step, donate_argnums=_donate(1, 3, 9))
+
+        def step_ext(bins_T, scores, vbins, vscores, grad_ops, bag_weight,
+                     fm_pads_B, iters_B, metric_ops, es0, ema0,
+                     explore_B, seeds_B):
             def body(carry, xs):
-                scores, vscores, es = carry
-                fm_pads, it = xs
+                scores, vscores, es, ema = carry
+                fm_pads, it, explore, seed = xs
                 active = ~es[2]
-                new_scores, new_vscores, stacked = one_iteration(
+                (new_scores, new_vscores, stacked,
+                 new_ema) = one_iteration(
                     bins_T, scores, vbins, vscores, grad_ops,
-                    bag_weight, fm_pads)
-                # freeze past the stop latch: the tree still comes out
-                # of the scan (static shapes) but contributes nothing
+                    bag_weight, fm_pads, ema, explore, seed)
                 scores = jnp.where(active, new_scores, scores)
                 vscores = tuple(jnp.where(active, nv, v)
                                 for nv, v in zip(new_vscores, vscores))
+                if new_ema is not None:
+                    # frozen tail: the latched model stops realizing
+                    # gains, so the EMA freezes with it
+                    ema = jnp.where(active, new_ema, ema)
                 mvals = plan.eval_in_scan(scores, vscores, metric_ops)
                 es = es_update(es, mvals, it, active)
-                return (scores, vscores, es), (stacked, mvals)
-            (scores, vscores, es), (trees_B, metrics_B) = jax.lax.scan(
-                body, (scores, vscores, es0), (fm_pads_B, iters_B))
-            return scores, vscores, es, trees_B, metrics_B
-        return jax.jit(step, donate_argnums=_donate(1, 3, 9))
+                return (scores, vscores, es, ema), (stacked, mvals)
+            (scores, vscores, es, ema), (trees_B, metrics_B) = \
+                jax.lax.scan(body, (scores, vscores, es0, ema0),
+                             (fm_pads_B, iters_B, explore_B, seeds_B))
+            return scores, vscores, es, trees_B, metrics_B, ema
+        return jax.jit(step_ext, donate_argnums=_donate(1, 3, 9))
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
@@ -3836,7 +4296,7 @@ class GBDT:
                 # (profile_dir splits them at the XLA op level)
                 with self._sec("histogram_split") as s:
                     tel.inc("train.dispatches")
-                    tree, row_leaf = self._grow(gh)
+                    tree, row_leaf = self._grow(gh, tid)
                     s.sync((tree, row_leaf))
                 nl = int(tree.num_leaves)
             else:
@@ -3988,6 +4448,7 @@ class GBDT:
                     log.warning("health check failed at iteration %d; "
                                 "auditing disabled for the rest of the "
                                 "run: %s", it, e)
+        self._finish_screen_iter()
         self.iter += 1
         return False
 

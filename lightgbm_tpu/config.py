@@ -289,6 +289,55 @@ _PARAMS: List[_Param] = [
        desc="bin capacity per EFB bundle column for sparse-built "
             "datasets (columns fill toward this cap, bounding the "
             "uniform-width padding of the fused kernel layout)"),
+    _p("tpu_quantized_grad", int, 0, ("tpu_quant_grad",),
+       check=(">=", 0), check2=("<=", 16),
+       desc="quantized gradient histograms on the fused engine: 16 or 8 "
+            "= stochastic-rounded fixed-point grad/hess under a "
+            "per-iteration global scale, integer MXU accumulation "
+            "(int8 channels, exact int32 sums) with one f32 rescale "
+            "before the split search — halves the one-hot scratch and "
+            "gh stream the histogram kernel's floor is made of "
+            "(docs/Performance.md 'Histogram plane'; accuracy-curve "
+            "A/B-gated). 0 = off (f32-grade bf16x2 path, the default). "
+            "Requires tpu_engine=fused; other engines degrade with a "
+            "structured event"),
+    _p("tpu_adaptive_bins", bool, False,
+       desc="adaptive per-feature bin widths in the fused kernel "
+            "layout: each feature's slab is sized to ITS effective bin "
+            "count (pow2, packed densely into the 128-lane quantum) "
+            "instead of padding every feature to the global pow2 "
+            "max_bin — shrinks the one-hot scratch and histogram "
+            "accumulator on heterogeneous-cardinality data. "
+            "BIT-IDENTICAL models to the padded layout (A/B-tested): "
+            "the packed layout is a pure re-indexing with the row tile "
+            "held at the padded formula. Off under EFB bundling and "
+            "voting-parallel (their layouts own the flat axis)"),
+    _p("tpu_gain_screening", bool, False,
+       desc="EMA-FS gain screening (arxiv 2606.26337): maintain a "
+            "per-feature EMA of realized split gains (in the megastep "
+            "scan carry on the fast path) and restrict each tree's "
+            "split search to the top tpu_screening_keep_ratio features "
+            "by EMA, composed with the feature_fraction mask; "
+            "screened-out features' one-hot slabs are zeroed in the "
+            "fused kernel. Warmup and periodic exploration rounds keep "
+            "the mask open so late-blooming features re-enter "
+            "(statistical-parity A/B-gated; EMA state rides resilience "
+            "checkpoints). Requires tpu_engine=fused"),
+    _p("tpu_screening_warmup", int, 10, check=(">=", 0),
+       desc="iterations before gain screening narrows the mask (all "
+            "features stay eligible while the gain EMA warms up)"),
+    _p("tpu_screening_keep_ratio", float, 0.5,
+       check=(">", 0.0), check2=("<=", 1.0),
+       desc="fraction of features kept by gain screening outside "
+            "exploration rounds (top-k by gain EMA, ties kept)"),
+    _p("tpu_screening_explore_period", int, 8, check=(">=", 0),
+       desc="every Nth iteration is an exploration round with the full "
+            "feature set eligible, so screened-out features can realize "
+            "gains and re-enter; 0 = never explore after warmup"),
+    _p("tpu_screening_ema_alpha", float, 0.9,
+       check=(">=", 0.0), check2=("<", 1.0),
+       desc="gain-EMA decay: ema = alpha * ema + (1 - alpha) * "
+            "realized split gains of the iteration's trees"),
     _p("tpu_fast_path", bool, True,
        desc="allow the pipelined fast path (device trees drained in "
             "batches); off = synchronous per-iteration host bookkeeping "

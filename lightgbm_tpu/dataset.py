@@ -463,9 +463,10 @@ class TpuDataset:
             return self
 
     def _finalize_feature_arrays(self) -> None:
+        from .binning import effective_bin_counts
         used = self.used_features
-        self.num_bin_per_feat = np.array(
-            [self.mappers[j].num_bin for j in used], np.int32)
+        self.num_bin_per_feat = effective_bin_counts(
+            [self.mappers[j] for j in used])
         self.max_num_bin = int(self.num_bin_per_feat.max()) if used else 1
         self.bin_offsets = np.concatenate(
             [[0], np.cumsum(self.num_bin_per_feat)]).astype(np.int32)

@@ -34,8 +34,9 @@ import jax.numpy as jnp
 
 from ..ops.fused_level import (NCH_PRECISE, build_route_table,
                                build_route_table_bundled,
-                               bundle_plane_views, hist_planes, level_pass,
-                               max_slot_cap, route_pass, table_lookup)
+                               bundle_plane_views, expand_feature_mask,
+                               hist_planes, level_pass, max_slot_cap,
+                               pack_route_table, route_pass, table_lookup)
 from ..ops.split import (BestSplit, SplitParams, best_split_cm,
                          calculate_leaf_output, per_feature_gains_cm)
 from ..ops.collectives import record_psum
@@ -114,7 +115,7 @@ def _merge_best_many(best: BestSplit, idx: jax.Array, vals: BestSplit,
                      "use_mono_bounds", "use_node_masks", "interpret",
                      "bundle_cols", "bundle_col_bins", "psum_axis",
                      "defer_final_route", "mono_mode", "parallel_mode",
-                     "top_k"))
+                     "top_k", "quant_bits", "packed", "mask_onehot"))
 def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     feature_mask: jax.Array, params: SplitParams,
                     num_leaves: int, max_bins: int, f_oh: int,
@@ -129,6 +130,8 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                     mono_mode: str = "basic",
                     parallel_mode: str = "data", top_k: int = 0,
                     feature_shard_mask: jax.Array = None,
+                    quant_bits: int = 0, packed=None,
+                    mask_onehot: bool = False, gh_scales: jax.Array = None,
                     ):
     """Grow one tree with fused level passes.
 
@@ -210,8 +213,33 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
         k_foh, k_B = bundle_cols, bundle_col_bins   # kernel layout
     else:
         k_foh, k_B = f_oh, B
+    # slot caps stay derived from the PADDED flat width so the level
+    # schedule — and hence the grown tree — is invariant to the adaptive
+    # packing (the adaptive-bin byte-identity A/B contract)
     caps = level_caps(L, max_depth, extra_levels,
                       slot_cap=max_slot_cap(k_foh * k_B, nch))
+    kern_fb = packed.fb if packed is not None else k_foh * k_B
+
+    def _decode(hist, Sp_):
+        """Kernel accumulator -> (g, h, c) f32 planes on the logical
+        padded layout: packed re-index (exact) + the quantized int32 ->
+        f32 rescale boundary, both before any split search."""
+        return hist_planes(hist, nch, Sp_, k_foh, k_B, packed=packed,
+                           quant_bits=quant_bits, scales=gh_scales)
+
+    if mask_onehot:
+        # gain screening: masked features' one-hot slabs are zeroed in
+        # the kernel. The leaf-totals column must survive: logical
+        # feature 0 feeds the total sums (best_split_cm reads
+        # grad[:, 0, :]) and the kernel's FIRST column carries the root
+        # pass's every-row-left routing trick — keep both unmasked.
+        keep0 = packed.feat_order[0] if packed is not None else 0
+        fm_keep = feature_mask.at[0].set(True).at[keep0].set(True)
+        fmask_fb = expand_feature_mask(fm_keep, k_foh, k_B, packed)
+        fmask2d = jnp.broadcast_to(fmask_fb[:, None], (kern_fb, 128)) \
+            .astype(jnp.int8 if quant_bits else jnp.bfloat16)
+    else:
+        fmask2d = None
 
     R = num_rows or Rp
     # padding rows sit at leaf -1; inactive slots use leaf_of_slot = -2 so
@@ -231,19 +259,25 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     if root_hist is not None:
         hist0 = root_hist
     else:
-        W0 = jnp.zeros((Sp0, k_foh * k_B), jnp.bfloat16).at[0, :k_B].set(1)
+        # the root trick sends every row "left" over the FIRST kernel
+        # column's one-hot — that column's width is the first packed
+        # feature's slab under the adaptive layout
+        w0_span = packed.widths[0] if packed is not None else k_B
+        W0 = jnp.zeros((Sp0, kern_fb), jnp.bfloat16).at[0, :w0_span].set(1)
         tbl0 = jnp.zeros((Sp0, 128), jnp.int32)
         tbl0 = tbl0.at[:, 0].set(jnp.where(jnp.arange(Sp0) == 0, 0, -2))
         tbl0 = tbl0.at[0, 2].set(1)
-        hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, num_slots=Sp0,
+        hist0, _ = level_pass(bins_T, leaf_T, gh_T, W0, tbl0, fmask2d,
+                              num_slots=Sp0,
                               num_bins=k_B, f_oh=k_foh, nch=nch,
-                              interpret=interpret)
+                              interpret=interpret, quant_bits=quant_bits,
+                              packed=packed)
         # feature mode: rows are replicated, the local histogram IS the
         # global one (a psum would multiply by the shard count); voting:
         # the root is always a full exchange like the XLA growers
         if psum_axis is not None and parallel_mode != "feature":
             hist0 = record_psum(hist0, psum_axis)
-    g0, h0, c0 = hist_planes(hist0, nch, Sp0, k_foh, k_B)
+    g0, h0, c0 = _decode(hist0, Sp0)
     if use_bundles:
         v = bundle_plane_views(jnp.stack([g0, h0, c0], axis=-1),
                                bundle_cfg.flat_idx, bundle_cfg.valid,
@@ -301,7 +335,7 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
     # so its routing can safely ride the epilogue kernel instead. Tables
     # are padded to the widest level (an all-(-2) table routes nothing).
     Sp_max = max([8] + [max(8, c) for c in caps])
-    def_W = jnp.zeros((Sp_max, k_foh * k_B), jnp.bfloat16)
+    def_W = jnp.zeros((Sp_max, kern_fb), jnp.bfloat16)
     def_tbl = jnp.zeros((Sp_max, 128), jnp.int32) \
         .at[:, 0].set(-2)
 
@@ -321,7 +355,9 @@ def grow_tree_fused(bins_T: jax.Array, gh_T: jax.Array, meta: FeatureMeta,
                            bundle_cols, bundle_col_bins, bundle_cfg,
                            interpret, psum_axis, defer_final_route,
                            mono_mode, parallel_mode, top_k,
-                           feature_shard_mask)
+                           feature_shard_mask,
+                           quant_bits=quant_bits, packed=packed,
+                           decode=_decode, fmask2d=fmask2d)
     tree, leaf_T = state[0], state[1]
     if defer_final_route:
         return tree, leaf_T[0], state[11], state[12]
@@ -334,7 +370,8 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                bundle_cols, bundle_col_bins, bundle_cfg, interpret,
                psum_axis=None, defer_final_route=False,
                mono_mode="basic", parallel_mode="data", top_k=0,
-               feature_shard_mask=None):
+               feature_shard_mask=None, quant_bits=0, packed=None,
+               decode=None, fmask2d=None):
     (tree, leaf_T, pool_g, pool_h, pool_c, best, lpn, lil,
      leaf_lo, leaf_hi, leaf_groups, def_W, def_tbl,
      reg_lo, reg_hi, pool_valid) = state
@@ -411,6 +448,10 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                                   Sp, f_oh, B,
                                   cat_flag=cf_s if has_cat else None,
                                   cat_mask=cm_s if has_cat else None)
+            if packed is not None:
+                # route tables are built on the logical padded layout and
+                # re-indexed onto the packed flat axis (exact 0/1 gather)
+                W = pack_route_table(W, packed)
         tbl = jnp.zeros((Sp, 128), jnp.int32)
         tbl = tbl.at[:, 0].set(lof)
         tbl = tbl.at[:, 1].set(delta_s)
@@ -434,13 +475,14 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
         elif route_only:
             leaf_T2 = route_pass(bins_T, leaf_T, W, tbl, num_slots=Sp,
                                  num_bins=k_B, f_oh=k_foh,
-                                 interpret=interpret)
+                                 interpret=interpret, packed=packed)
             pool_g2, pool_h2, pool_c2 = pool_g, pool_h, pool_c
             pool_valid2 = pool_valid
         else:
             hist, leaf_T2 = level_pass(
-                bins_T, leaf_T, gh_T, W, tbl, num_slots=Sp, num_bins=k_B,
-                f_oh=k_foh, nch=nch, interpret=interpret)
+                bins_T, leaf_T, gh_T, W, tbl, fmask2d, num_slots=Sp,
+                num_bins=k_B, f_oh=k_foh, nch=nch, interpret=interpret,
+                quant_bits=quant_bits, packed=packed)
             if psum_axis is not None and not vote_live and not feat_par:
                 hist = record_psum(hist, psum_axis)
 
@@ -452,7 +494,7 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
             # rule as the XLA growers' _exchange)
             if vote_live:
                 # local decode just for the vote ranking
-                lg, lh, lc = hist_planes(hist, nch, Sp, k_foh, k_B)
+                lg, lh, lc = decode(hist, Sp)
                 if use_bundles:
                     v = bundle_plane_views(
                         jnp.stack([lg, lh, lc], axis=-1),
@@ -501,11 +543,10 @@ def _one_level(state, bins_T, gh_T, meta, feature_mask, params, L, B, f_oh,
                                        psum_axis)
                     hr = jnp.zeros_like(hr).at[w_idx].set(sub)
                     hist = hr.reshape(k_foh * k_B, -1)
-                    sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh,
-                                                   k_B)
+                    sm_g, sm_h, sm_c = decode(hist, Sp)
             else:
                 lvl_valid = jnp.ones((f_oh,), bool)
-                sm_g, sm_h, sm_c = hist_planes(hist, nch, Sp, k_foh, k_B)
+                sm_g, sm_h, sm_c = decode(hist, Sp)
                 if use_bundles:
                     v = bundle_plane_views(
                         jnp.stack([sm_g, sm_h, sm_c], axis=-1),

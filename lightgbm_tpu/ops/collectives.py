@@ -40,6 +40,11 @@ class CollectiveTrace:
     def __init__(self):
         self.count = 0
         self.bytes = 0
+        # per-dtype (count, bytes) breakdown: the quantized histogram
+        # path (tpu_quantized_grad) psums int32 accumulators and the
+        # adaptive layout shrinks their flat width — the breakdown is
+        # what the histogram-plane composition tests assert against
+        self.by_dtype: dict = {}
         self._outer: Optional["CollectiveTrace"] = None
 
     def __enter__(self) -> "CollectiveTrace":
@@ -59,8 +64,11 @@ class CollectiveTrace:
     def _add(self, tree) -> None:
         for leaf in jax.tree_util.tree_leaves(tree):
             a = leaf if hasattr(leaf, "dtype") else jnp.asarray(leaf)
+            nbytes = int(a.size) * int(a.dtype.itemsize)
             self.count += 1
-            self.bytes += int(a.size) * int(a.dtype.itemsize)
+            self.bytes += nbytes
+            cnt, byt = self.by_dtype.get(str(a.dtype), (0, 0))
+            self.by_dtype[str(a.dtype)] = (cnt + 1, byt + nbytes)
 
 
 def _record(x) -> None:

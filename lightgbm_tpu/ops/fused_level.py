@@ -47,6 +47,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from .layout import PackedLayout, feature_layout  # noqa: F401  (shared
+# single-source layout contract — re-exported for existing callers)
+from . import quantize
+
 try:  # pragma: no cover - exotic backends fall back to interpret mode
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -107,22 +111,59 @@ def _fit_tile(C: int, R: int) -> int:
     return C
 
 
-def _write_onehot(bins_ref, oh_ref, F_oh: int, B: int) -> None:
+def _write_onehot(bins_ref, oh_ref, F_oh: int, B: int,
+                  packed: PackedLayout = None, fm_ref=None) -> None:
     """oh[f*B+b, r] = 1.0 iff bins[f, r] == b, written to the VMEM
     scratch. Built ARITHMETICALLY — relu(1 - |bins - b|) — in bf16:
     integers <= 256 are exact in bf16, so the result is bit-identical to
     a compare while the repeated-bins intermediate stays 2 B/elem
     (Mosaic on this target compiles only i32 compares, which forced a
     4 B/elem intermediate in the round-2/3 build). Bin counts > 256
-    (wide EFB bundle columns) use an f32 intermediate instead."""
+    (wide EFB bundle columns) use an f32 intermediate instead.
+
+    Variants (tentpole cuts; the default path above is byte-unchanged):
+    - int8 scratch (quantized histograms): a plain i32 compare cast to
+      int8 — the intermediate cost returns, but the scratch and both
+      MXU dots halve to 1 B/elem on the native s8 path;
+    - ``packed`` (adaptive per-feature bins): the bin matrix rows are
+      pre-permuted into width classes, so each class region builds with
+      the same bulk repeat+compare at ITS width instead of the global
+      pow2 B — class padding regions are zeroed;
+    - ``fm_ref`` ([FB, 128], col 0 live): gain-screened features'
+      slabs are zeroed after the build so they contribute nothing to
+      either dot (the dynamic-mask form of skipping the slab; the
+      static slab-skip is the on-chip ablation's follow-up).
+    """
+    quant = oh_ref.dtype == jnp.int8
     C = bins_ref.shape[1]
-    FB = F_oh * B
-    dt = jnp.bfloat16 if B <= 256 else jnp.float32
-    big = jnp.repeat(bins_ref[:F_oh].astype(dt), B, axis=0)     # [FB, C]
-    iota_b = (jax.lax.broadcasted_iota(jnp.int32, (FB, C), 0) % B) \
-        .astype(dt)
-    oh_ref[:] = jnp.maximum(1.0 - jnp.abs(big - iota_b), 0.0) \
-        .astype(jnp.bfloat16)
+
+    def build(seg_ref_rows, w, span):
+        """[rows] x width w -> one-hot block [rows*w, C]."""
+        if quant:
+            big = jnp.repeat(seg_ref_rows.astype(jnp.int32), w, axis=0)
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (span, C), 0) % w
+            return (big == iota_b).astype(jnp.int8)
+        dt = jnp.bfloat16 if w <= 256 else jnp.float32
+        big = jnp.repeat(seg_ref_rows.astype(dt), w, axis=0)
+        iota_b = (jax.lax.broadcasted_iota(jnp.int32, (span, C), 0) % w) \
+            .astype(dt)
+        return jnp.maximum(1.0 - jnp.abs(big - iota_b), 0.0) \
+            .astype(jnp.bfloat16)
+
+    if packed is None:
+        oh_ref[:] = build(bins_ref[:F_oh], B, F_oh * B)
+    else:
+        for ci, (w, cnt) in enumerate(packed.classes):
+            r0 = int(packed.row_offsets[ci])
+            o0 = int(packed.class_flat_offsets[ci])
+            span = cnt * w
+            oh_ref[o0:o0 + span] = build(bins_ref[r0:r0 + cnt], w, span)
+            pad = _round_up(span, 128) - span
+            if pad:
+                oh_ref[o0 + span:o0 + span + pad] = jnp.zeros(
+                    (pad, C), oh_ref.dtype)
+    if fm_ref is not None:
+        oh_ref[:] = oh_ref[:] * fm_ref[:, 0:1]
 
 
 def max_slot_cap(FB: int, nch: int, budget: int = 4 * 1024 * 1024) -> int:
@@ -132,18 +173,6 @@ def max_slot_cap(FB: int, nch: int, budget: int = 4 * 1024 * 1024) -> int:
     cap = budget // (FB * nch * 4)
     cap = 1 << max(3, int(cap).bit_length() - 1)
     return int(min(128, cap))
-
-
-def feature_layout(num_features: int, max_bin: int) -> Tuple[int, int]:
-    """(F_oh, B) such that B = pow2 >= max_bin and (F_oh * B) % 128 == 0.
-
-    F_oh is the one-hot feature count (>= num_features); padded features
-    must carry bin 0 everywhere and be masked out of the split scan.
-    """
-    B = max(8, _next_pow2(max_bin))
-    quota = max(1, 128 // min(B, 128))
-    F_oh = _round_up(max(num_features, 1), quota)
-    return F_oh, B
 
 
 def pack_gh(grad: jax.Array, hess: jax.Array, weight: jax.Array,
@@ -168,12 +197,79 @@ def pack_gh(grad: jax.Array, hess: jax.Array, weight: jax.Array,
     return jnp.stack(rows, axis=0)
 
 
-def hist_planes(hist: jax.Array, nch: int, Sp: int, F_oh: int, B: int):
+def pack_gh_quant(grad: jax.Array, hess: jax.Array, weight: jax.Array,
+                  bits: int, seed) -> Tuple[jax.Array, jax.Array]:
+    """Quantized sibling of :func:`pack_gh` (``tpu_quantized_grad``):
+    stochastic-rounded fixed-point grad/hess under a per-iteration
+    global scale from a traced max-abs reduction (ops/quantize.py).
+
+    Returns ([8, R] int8 channel block, [2] f32 scales).  bits=8 packs
+    (g, h, w); bits=16 packs the int8 hi/lo split (g_hi, g_lo, h_hi,
+    h_lo, w) so the MXU's native s8 x s8 -> s32 path accumulates the
+    full 16-bit grid exactly.  ``weight`` must be a 0/1 in-bag mask
+    (the fast paths' contract); zero-weight rows encode exactly zero.
+    """
+    R = grad.shape[-1]
+    scales = quantize.quant_scales(grad, hess, bits)
+    qg, qh = quantize.quantize_gh(grad, hess, scales, bits, seed)
+    rows = quantize.encode_channels(qg, qh, weight, bits)
+    z = jnp.zeros((R,), jnp.int8)
+    rows = rows + [z] * (8 - len(rows))
+    return jnp.stack(rows, axis=0), scales
+
+
+def pack_route_table(W: jax.Array, packed: PackedLayout) -> jax.Array:
+    """Padded-layout route table [Sp, F_oh*Bp] -> packed layout
+    [Sp, packed.fb] (class-padding columns zero)."""
+    idx = jnp.asarray(packed.packed_to_padded, jnp.int32)
+    valid = jnp.asarray(packed.packed_valid)
+    Wp = jnp.take(W, idx, axis=1)
+    return jnp.where(valid[None, :], Wp, 0).astype(W.dtype)
+
+
+def unpack_packed_flat(hist: jax.Array, packed: PackedLayout) -> jax.Array:
+    """[packed.fb, X] kernel accumulator -> [F_oh*Bp, X] padded flat
+    layout (exact gather — the accumulated per-(feature, bin) sums are
+    the padded layout's, just re-indexed, so the decode is
+    bit-identical to the padded kernel's output)."""
+    idx = jnp.asarray(packed.padded_to_packed, jnp.int32)
+    valid = jnp.asarray(packed.padded_valid)
+    out = jnp.take(hist, idx, axis=0)
+    return jnp.where(valid[:, None], out, 0)
+
+
+def expand_feature_mask(fm: jax.Array, F_oh: int, B: int,
+                        packed: PackedLayout = None) -> jax.Array:
+    """Per-feature bool mask [F_oh] -> per-flat-position bool [FB] in
+    the kernel layout (class/feature padding positions False)."""
+    if packed is None:
+        return jnp.repeat(fm, B, total_repeat_length=F_oh * B)
+    f_of = jnp.asarray(packed.feat_of_packed, jnp.int32)
+    valid = jnp.asarray(packed.packed_valid)
+    return jnp.take(fm, f_of) & valid
+
+
+def hist_planes(hist: jax.Array, nch: int, Sp: int, F_oh: int, B: int,
+                packed: PackedLayout = None, quant_bits: int = 0,
+                scales: jax.Array = None):
     """[FB, nch*Sp] kernel output -> (grad, hess, cnt) planes [Sp, F_oh, B]
-    in float32 (hi/lo recombined when nch=5)."""
+    in float32 (hi/lo recombined when nch=5).
+
+    ``packed`` re-indexes an adaptive-layout accumulator back onto the
+    padded logical layout first (exact); ``quant_bits`` decodes int32
+    integer sums through the ONE f32 rescale boundary (ops/quantize.py)
+    — everything above (split search, pools, subtraction) stays f32 and
+    unchanged."""
+    if packed is not None:
+        hist = unpack_packed_flat(hist, packed)
+
     def plane(c):
         return hist[:, c * Sp:(c + 1) * Sp]
-    if nch == NCH_PRECISE:
+    if quant_bits:
+        g, h, c = quantize.decode_sums(
+            [plane(i) for i in range(quantize.QNCH[quant_bits])],
+            scales, quant_bits)
+    elif nch == NCH_PRECISE:
         g = plane(0) + plane(1)
         h = plane(2) + plane(3)
         c = plane(4)
@@ -303,9 +399,16 @@ def bundle_plane_views(plane: jax.Array, flat_idx: jax.Array,
     return out[..., 0] if squeeze else out
 
 
-def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
-                  hist_ref, newleaf_ref, oh_ref, *,
-                  B: int, F_oh: int, Sp: int, nch: int):
+def _level_kernel(*refs, B: int, F_oh: int, Sp: int, nch: int,
+                  quant: bool = False, packed: PackedLayout = None,
+                  has_fm: bool = False):
+    if has_fm:
+        (bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref, fm_ref,
+         hist_ref, newleaf_ref, oh_ref) = refs
+    else:
+        (bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
+         hist_ref, newleaf_ref, oh_ref) = refs
+        fm_ref = None
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -313,21 +416,27 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
         hist_ref[:] = jnp.zeros_like(hist_ref)
 
     C = bins_ref.shape[1]
-    FB = F_oh * B
 
-    _write_onehot(bins_ref, oh_ref, F_oh, B)
+    _write_onehot(bins_ref, oh_ref, F_oh, B, packed=packed, fm_ref=fm_ref)
 
     leafb = leaf_ref[:]                                        # [1, C] i32
 
-    # ---- routing: D[k, r] = 1 iff row r goes left under slot k's split
+    # ---- routing: D[k, r] = 1 iff row r goes left under slot k's split.
+    # Quantized mode routes on the same int8 one-hot through the MXU's
+    # native s8 x s8 -> s32 path (W is 0/1-valued either way).
     oh = oh_ref[:]
-    D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # [Sp, C]
-    # Mask algebra stays in i32/bf16 throughout: broadcast i1 vectors hit a
-    # Mosaic relayout bug on this toolchain ("Invalid relayout ... 8x1024xi1"
-    # when an [Sp,1] bool meets an [Sp,C] bool), and int select lowers to the
-    # same VPU ops anyway.
-    left_i = (D > 0.5).astype(jnp.int32)                       # [Sp, C] 0/1
+    if quant:
+        D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        left_i = (D > 0).astype(jnp.int32)                     # [Sp, C] 0/1
+    else:
+        D = jax.lax.dot_general(w_ref[:], oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        # Mask algebra stays in i32/bf16 throughout: broadcast i1 vectors
+        # hit a Mosaic relayout bug on this toolchain ("Invalid relayout
+        # ... 8x1024xi1" when an [Sp,1] bool meets an [Sp,C] bool), and
+        # int select lowers to the same VPU ops anyway.
+        left_i = (D > 0.5).astype(jnp.int32)                   # [Sp, C] 0/1
 
     # ---- slot membership
     leaf_of_slot = tbl_ref[:, 0:1]                             # [Sp, 1]
@@ -336,20 +445,23 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
     P_i = (jnp.broadcast_to(leafb, (Sp, C))
            == leaf_of_slot).astype(jnp.int32)                  # [Sp, C] 0/1
     same_i = 1 - jnp.bitwise_xor(left_i, small_left_i)         # left==small
-    in_small = (P_i * same_i).astype(jnp.bfloat16)             # [Sp, C] 0/1
+    ch_dt = jnp.int8 if quant else jnp.bfloat16
+    in_small = (P_i * same_i).astype(ch_dt)                    # [Sp, C] 0/1
 
     # ---- histogram: one wide-N dot, all channels packed. mask*g instead of
     # a select (i1 selects also hit the relayout bug); requires FINITE
     # grad/hess — a NaN/Inf row would leak 0*NaN into other slots' bins,
     # but non-finite gradients wreck training under any formulation.
+    # Quantized mode: int8 channels, int32 accumulator — integer sums are
+    # EXACT and associative (ops/quantize.py), rescaled outside.
     chans = []
     for ch in range(nch):
-        g = gh_ref[ch:ch + 1, :]                               # [1, C] bf16
+        g = gh_ref[ch:ch + 1, :]                               # [1, C]
         chans.append(in_small * jnp.broadcast_to(g, (Sp, C)))
     ghs = jnp.concatenate(chans, axis=0)                       # [nch*Sp, C]
     hist_ref[:] += jax.lax.dot_general(
         oh, ghs, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                    # [FB, nch*Sp]
+        preferred_element_type=jnp.int32 if quant else jnp.float32)
 
     # ---- row->leaf update: right-child rows move to their new leaf id
     go_right = P_i * (1 - left_i)                              # [Sp, C] 0/1
@@ -358,31 +470,54 @@ def _level_kernel(bins_ref, leaf_ref, gh_ref, w_ref, tbl_ref,
     newleaf_ref[:] = leafb + delta
 
 
+def _kernel_fb(f_oh: int, num_bins: int, packed: PackedLayout) -> int:
+    return packed.fb if packed is not None else f_oh * num_bins
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_slots", "num_bins", "f_oh", "nch", "tile_rows",
-                     "interpret"))
+                     "interpret", "quant_bits", "packed"))
 def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
-               W: jax.Array, tbl: jax.Array, *, num_slots: int,
-               num_bins: int, f_oh: int, nch: int = NCH_PRECISE,
-               tile_rows: int = 0, interpret: bool = False):
+               W: jax.Array, tbl: jax.Array, fmask: jax.Array = None,
+               *, num_slots: int, num_bins: int, f_oh: int,
+               nch: int = NCH_PRECISE, tile_rows: int = 0,
+               interpret: bool = False, quant_bits: int = 0,
+               packed: PackedLayout = None):
     """One fused route+histogram pass over all rows.
 
     Args:
       bins_T: [Fp, R] int8 binned matrix, transposed (Fp >= f_oh; padded
         feature rows all-zero). R must be a multiple of the tile size
-        (pad rows carry leaf_T = -1 so they contribute nothing).
+        (pad rows carry leaf_T = -1 so they contribute nothing). With
+        ``packed`` the rows are pre-permuted into width-class order
+        (packed.feat_order).
       leaf_T: [1, R] int32 row->leaf ids (-1 = inactive/padding row).
-      gh_T: [8, R] bfloat16 channel block from pack_gh().
-      W: [Sp, f_oh*num_bins] bfloat16 route table (build_route_table).
+      gh_T: [8, R] bfloat16 channel block from pack_gh(), or the int8
+        block from pack_gh_quant() when ``quant_bits`` is set.
+      W: [Sp, FB] bfloat16 route table (build_route_table, packed via
+        pack_route_table under ``packed``).
       tbl: [Sp, 128] int32; col 0 leaf_of_slot (-1 = inactive slot),
         col 1 right_delta (new_leaf_id - leaf_id), col 2 small_is_left
         (any value > 0 means left). grad/hess/weight must be FINITE: the
         kernel masks channels by multiplication (Mosaic i1-select
         workaround), so a NaN/Inf row would bleed into other slots.
+      fmask: optional [FB, 128] (col 0 live) gain-screening mask — the
+        masked slabs of the one-hot are zeroed so screened-out features
+        contribute to neither dot.
+      quant_bits: 0 (f32 path, unchanged), 8 or 16 — integer MXU/VPU
+        accumulation into an int32 [FB, nch*Sp] accumulator; the caller
+        rescales via hist_planes(quant_bits=..., scales=...).
+      packed: adaptive per-feature bin layout (ops/layout.py). The row
+        TILE is still derived from the PADDED layout's f_oh*num_bins so
+        the per-element accumulation order — and hence the f32 sums —
+        stay bit-identical to the padded kernel's (the adaptive-bin A/B
+        contract); the win is the smaller scratch/accumulator, and the
+        on-chip ablation (scripts/ablate_hist.py) measures larger tiles.
 
     Returns:
-      hist: [f_oh*num_bins, nch*Sp] float32 smaller-child histograms.
+      hist: [FB, nch*Sp] float32 (int32 under quant_bits) smaller-child
+        histograms, FB = packed.fb or f_oh*num_bins.
       new_leaf: [1, R] int32 updated assignment.
     """
     if not HAS_PALLAS:
@@ -390,49 +525,64 @@ def level_pass(bins_T: jax.Array, leaf_T: jax.Array, gh_T: jax.Array,
                           "backend; use the XLA histogram path instead")
     Fp, R = bins_T.shape
     B = num_bins
-    FB = f_oh * B
+    FB = _kernel_fb(f_oh, B, packed)
+    FB_tiles = f_oh * B       # padded formula: keeps tiling A/B-stable
     Sp = tbl.shape[0]
-    C = _fit_tile(tile_rows or default_tile_rows(Sp, FB, nch,
+    C = _fit_tile(tile_rows or default_tile_rows(Sp, FB_tiles, nch,
                                                  wide_bins=B > 256), R)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
     T = R // C
+    quant = quant_bits > 0
+    oh_dt = jnp.int8 if quant else jnp.bfloat16
+    acc_dt = jnp.int32 if quant else jnp.float32
+    if quant:
+        W = W.astype(jnp.int8)
 
-    kernel = functools.partial(_level_kernel, B=B, F_oh=f_oh, Sp=Sp, nch=nch)
+    kernel = functools.partial(_level_kernel, B=B, F_oh=f_oh, Sp=Sp,
+                               nch=nch, quant=quant, packed=packed,
+                               has_fm=fmask is not None)
+    in_specs = [
+        pl.BlockSpec((Fp, C), lambda t: (0, t)),
+        pl.BlockSpec((1, C), lambda t: (0, t)),
+        pl.BlockSpec((8, C), lambda t: (0, t)),
+        pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
+        pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
+    ]
+    operands = [bins_T, leaf_T, gh_T, W, tbl]
+    if fmask is not None:
+        in_specs.append(pl.BlockSpec((FB, 128), lambda t: (0, 0)))
+        operands.append(fmask.astype(oh_dt))
     hist, new_leaf = pl.pallas_call(
         kernel,
         grid=(T,),
-        in_specs=[
-            pl.BlockSpec((Fp, C), lambda t: (0, t)),
-            pl.BlockSpec((1, C), lambda t: (0, t)),
-            pl.BlockSpec((8, C), lambda t: (0, t)),
-            pl.BlockSpec((Sp, FB), lambda t: (0, 0)),
-            pl.BlockSpec((Sp, 128), lambda t: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((FB, nch * Sp), lambda t: (0, 0)),
             pl.BlockSpec((1, C), lambda t: (0, t)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((FB, nch * Sp), jnp.float32),
+            jax.ShapeDtypeStruct((FB, nch * Sp), acc_dt),
             jax.ShapeDtypeStruct((1, R), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((FB, C), jnp.bfloat16)],
+        scratch_shapes=[pltpu.VMEM((FB, C), oh_dt)],
         compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(bins_T, leaf_T, gh_T, W, tbl)
+    )(*operands)
     return hist, new_leaf
 
 
 def _route_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, newleaf_ref,
-                  oh_ref, *, B: int, F_oh: int, Sp: int):
+                  oh_ref, *, B: int, F_oh: int, Sp: int,
+                  packed: PackedLayout = None):
     """Routing-only sibling of _level_kernel: updates row->leaf without
     accumulating histograms. Used for passes whose histograms can never be
     consumed (the leaf budget is exhausted, or no further pass follows) —
-    the histogram dot is ~60% of a deep pass's cost."""
+    the histogram dot is ~60% of a deep pass's cost. Routing keeps the
+    bf16 formulation under quantization (no precision at stake); only
+    the ``packed`` layout matters here (the bin rows are permuted)."""
     C = bins_ref.shape[1]
-    FB = F_oh * B
-    _write_onehot(bins_ref, oh_ref, F_oh, B)
+    _write_onehot(bins_ref, oh_ref, F_oh, B, packed=packed)
     leafb = leaf_ref[:]
     D = jax.lax.dot_general(w_ref[:], oh_ref[:], (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
@@ -450,23 +600,25 @@ def _route_kernel(bins_ref, leaf_ref, w_ref, tbl_ref, newleaf_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("num_slots", "num_bins", "f_oh", "tile_rows",
-                     "interpret"))
+                     "interpret", "packed"))
 def route_pass(bins_T: jax.Array, leaf_T: jax.Array, W: jax.Array,
                tbl: jax.Array, *, num_slots: int, num_bins: int,
                f_oh: int, tile_rows: int = 0,
-               interpret: bool = False) -> jax.Array:
+               interpret: bool = False,
+               packed: PackedLayout = None) -> jax.Array:
     """Row->leaf update only (same W/tbl contract as level_pass)."""
     if not HAS_PALLAS:
         raise ImportError("jax.experimental.pallas is unavailable on this "
                           "backend; use the XLA histogram path instead")
     Fp, R = bins_T.shape
     B = num_bins
-    FB = f_oh * B
+    FB = _kernel_fb(f_oh, B, packed)
     Sp = tbl.shape[0]
-    C = _fit_tile(tile_rows or default_tile_rows(Sp, FB, NCH_FAST,
+    C = _fit_tile(tile_rows or default_tile_rows(Sp, f_oh * B, NCH_FAST,
                                                  wide_bins=B > 256), R)
     assert R % C == 0, f"rows {R} not padded to tile {C}"
-    kernel = functools.partial(_route_kernel, B=B, F_oh=f_oh, Sp=Sp)
+    kernel = functools.partial(_route_kernel, B=B, F_oh=f_oh, Sp=Sp,
+                               packed=packed)
     new_leaf = pl.pallas_call(
         kernel,
         grid=(R // C,),

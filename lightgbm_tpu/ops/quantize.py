@@ -1,0 +1,169 @@
+"""Fixed-point gradient/hessian quantization for histogram accumulation.
+
+Grounding: arxiv 2011.02022 (Booster's fixed-point gradient
+accumulators).  Gradients and hessians are stochastically rounded onto a
+signed ``2^(bits-1)-1`` grid under a per-iteration GLOBAL scale (one
+traced max-abs reduction per channel), histograms accumulate the integer
+grid values EXACTLY (int32 — integer addition is associative, so the
+quantized histograms are also bit-identical across shard/psum orders),
+and one f32 rescale per level happens at the decode boundary, BEFORE the
+split search (``ops/split.py`` is unchanged above that boundary).
+
+TPU shape of the design: the MXU's native integer path is s8 x s8 -> s32,
+so the 16-bit grid is carried as two int8 channels per value (hi/lo split
+— the integer analog of the bf16 hi/lo trick ``ops/fused_level.pack_gh``
+already uses for f32-grade sums):
+
+    q = 256 * hi + lo' + 128 * w      with lo' in [-128, 127], w in {0, 1}
+
+The ``128 * w`` recentering keeps lo' signed while zero-weight rows
+(padding, out-of-bag) contribute exactly zero; the count channel ``w``
+the histograms already carry supplies the recentering sum for free.
+
+Stochastic rounding is hash-based and fully traced: the dither for a
+value is derived from its own bits, its row index and a per-iteration
+seed through a murmur-style integer mix — deterministic given
+(values, seed), so the quantized paths keep the repo's bit-reproducible
+A/B contracts (fast path vs sync driver, resume-from-checkpoint).
+
+Error model (docs/Performance.md "Histogram plane"): each row's
+grad/hess carries uniform quantization noise with zero mean (stochastic
+rounding is unbiased) and magnitude <= scale = max|g| / (2^(bits-1)-1);
+a bin summing n rows accumulates noise O(scale * sqrt(n)).  int32
+accumulators are exact for |sum q| < 2^31: worst case n_bin * 2^(bits-1)
+— safe to ~16M rows per bin at 8 bits, and the 16-bit hi channel's
+|hi| <= 128 gives the same ~16M bound per channel; the hi/lo
+RECOMBINATION (256x) therefore happens in f32 at the decode boundary,
+never in int32 (see decode_sums).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QMAX = {8: 127, 16: 32767}
+# channel count per bit width: 8 -> (g, h, w); 16 -> (g_hi, g_lo, h_hi,
+# h_lo, w) — mirrors NCH_FAST / NCH_PRECISE of the f32 kernel path
+QNCH = {8: 3, 16: 5}
+
+
+def quant_elem_bytes(quant_bits: int) -> int:
+    """Element width of the quantized grid (4 = the f32 default) — what
+    the one-hot chunk budget derives from (``histogram._choose_chunk``)."""
+    return {0: 4, 8: 1, 16: 2}[int(quant_bits)]
+
+
+def _mix(h: jax.Array) -> jax.Array:
+    """murmur3-style avalanche over uint32."""
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def stochastic_round(x: jax.Array, seed) -> jax.Array:
+    """floor(x) + (u < frac(x)) as int32, u in [0, 1) hashed from
+    (row index, seed).  Unbiased: u is independent of x and uniform, so
+    P(round up) = frac(x) and E[result] = x.  Deterministic given
+    (shape, seed).
+
+    Two deliberate properties:
+    - the dither compares against the FRACTIONAL part instead of being
+      added to x — adding u to a large-magnitude x rounds in f32 and
+      would mis-round exact integers (|x| ~ 2^15 has f32 spacing larger
+      than small dithers), breaking the integer-grid bit-comparability
+      contract;
+    - the hash takes ONLY (index, seed), never the value bits: hashing
+      the value would turn any ulp-level difference between two traced
+      programs (XLA fma/fusion choices differ between the pipelined
+      fast path and the sync driver) into a completely different dither
+      for that row, amplifying one-ulp drift into visible model
+      divergence.  With a value-independent dither, an ulp of drift
+      flips a rounding only when it straddles the u threshold — the
+      same robustness class as the f32 path's A/B contracts."""
+    n = x.shape[-1]
+    idx = jax.lax.iota(jnp.uint32, n)
+    if x.ndim > 1:
+        idx = jnp.broadcast_to(idx, x.shape)
+    seed = jnp.asarray(seed, jnp.uint32)
+    x = x.astype(jnp.float32)
+    h = _mix((idx * np.uint32(2654435761)) ^ (seed * np.uint32(0x27D4EB2F)))
+    u = (h >> np.uint32(8)).astype(jnp.float32) * np.float32(1.0 / (1 << 24))
+    lo = jnp.floor(x)
+    frac = x - lo          # exact: lo is within one ulp-neighborhood of x
+    return (lo.astype(jnp.int32)
+            + (u < frac).astype(jnp.int32))
+
+
+def quant_scales(grad: jax.Array, hess: jax.Array, bits: int) -> jax.Array:
+    """[2] f32 per-iteration global scales (grad, hess) from traced
+    max-abs reductions; a GSPMD-sharded operand reduces globally, so
+    every shard quantizes on the identical grid."""
+    qmax = np.float32(QMAX[bits])
+    tiny = np.float32(1e-30)
+    sg = jnp.maximum(jnp.max(jnp.abs(grad)), tiny) / qmax
+    sh = jnp.maximum(jnp.max(jnp.abs(hess)), tiny) / qmax
+    return jnp.stack([sg, sh]).astype(jnp.float32)
+
+
+def quantize_gh(grad: jax.Array, hess: jax.Array, scales: jax.Array,
+                bits: int, seed) -> Tuple[jax.Array, jax.Array]:
+    """(q_grad, q_hess) int32 on the signed grid, clipped to +-QMAX.
+    Distinct dither streams per channel (seed offsets)."""
+    qmax = QMAX[bits]
+    seed = jnp.asarray(seed, jnp.uint32)
+    qg = stochastic_round(grad / scales[0], seed)
+    qh = stochastic_round(hess / scales[1], seed ^ np.uint32(0x9E3779B9))
+    return (jnp.clip(qg, -qmax, qmax), jnp.clip(qh, -qmax, qmax))
+
+
+def encode_channels(qg: jax.Array, qh: jax.Array, w01: jax.Array,
+                    bits: int) -> List[jax.Array]:
+    """int8 channel rows for the kernels' packed gh block.
+
+    bits=8:  [g, h, w]
+    bits=16: [g_hi, g_lo', h_hi, h_lo', w] with the 128*w recentering
+             (module docstring); zero-weight rows encode exactly zero.
+    """
+    w8 = (w01 > 0).astype(jnp.int8)
+    if bits == 8:
+        return [qg.astype(jnp.int8), qh.astype(jnp.int8), w8]
+    w32 = w8.astype(jnp.int32)
+
+    def split(q):
+        hi = jnp.floor_divide(q, 256)
+        lo = q - 256 * hi - 128 * w32
+        return hi.astype(jnp.int8), lo.astype(jnp.int8)
+    g_hi, g_lo = split(qg)
+    h_hi, h_lo = split(qh)
+    return [g_hi, g_lo, h_hi, h_lo, w8]
+
+
+def decode_sums(planes: List[jax.Array], scales: jax.Array, bits: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(grad, hess, count) f32 sums from the int32 accumulator planes —
+    the ONE f32 rescale boundary before the split search.
+
+    The 16-bit hi/lo recombination happens in f32: ``256 * hi_sum``
+    would re-bind the int32 overflow limit at ~65K non-canceling rows
+    per bin (the ACCUMULATOR channels are safe to ~16M — |hi| <= 128 —
+    but the recombined magnitude is 256x larger). The f32 product
+    ``hi_sum * 256`` is exact (pow2 scaling of an exactly-represented
+    int < 2^24) and the two adds round once each — within the f32
+    rescale rounding the error model already accepts."""
+    if bits == 8:
+        g = planes[0].astype(jnp.float32) * scales[0]
+        h = planes[1].astype(jnp.float32) * scales[1]
+        c = planes[2].astype(jnp.float32)
+        return g, h, c
+    w = planes[4].astype(jnp.float32)
+    g = (planes[0].astype(jnp.float32) * 256.0
+         + planes[1].astype(jnp.float32) + 128.0 * w) * scales[0]
+    h = (planes[2].astype(jnp.float32) * 256.0
+         + planes[3].astype(jnp.float32) + 128.0 * w) * scales[1]
+    return g, h, w

@@ -24,6 +24,14 @@ Gain/leaf-output formulas are the closed-form Newton expressions with
 L1 thresholding, max_delta_step clipping and path smoothing
 (ref: feature_histogram.hpp:737-856 ThresholdL1 / CalculateSplittedLeafOutput /
 GetLeafGain / GetSplitGains).
+
+Precision contract: every scan in this module consumes f32 (grad, hess,
+count) planes.  The quantized histogram path (``tpu_quantized_grad``,
+ops/quantize.py) rescales its exact int32 fixed-point sums to f32 AT the
+decode boundary (ops/fused_level.hist_planes) — this module is unchanged
+above that boundary, so the split semantics are identical between the
+f32 and quantized planes up to the quantization noise already present in
+the sums.
 """
 from __future__ import annotations
 

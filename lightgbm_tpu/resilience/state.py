@@ -125,6 +125,13 @@ def capture(gbdt) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
     bag["cache_keys"] = sorted(int(key) for key in cache)
     for j, key in enumerate(bag["cache_keys"]):
         arrays[f"bag_cache{j}"] = np.asarray(cache[key], bool)
+    if getattr(gbdt, "use_screening", False) \
+            and getattr(gbdt, "_gain_ema_dev", None) is not None:
+        # EMA-FS gain-screening state (tpu_gain_screening): the
+        # per-feature gain EMA is part of the resumable training state —
+        # without it a resumed run would re-warm the mask and diverge
+        # from the uninterrupted run's feature screening
+        arrays["gain_ema"] = np.asarray(gbdt._gain_ema_dev, np.float32)
     extra_payload, extra_arrays = gbdt._capture_boosting_extra()
     arrays.update(extra_arrays)
     extra_cb = getattr(gbdt, "_ckpt_extra", None)
@@ -240,6 +247,11 @@ def restore(gbdt, payload: Dict[str, Any], arrays) -> int:
         cache[int(key)] = np.asarray(arrays[f"bag_cache{j}"], bool)
     gbdt._bag_round_cache = cache or None
     gbdt.feat_rng.x = int(payload.get("feat_rng_x", gbdt.feat_rng.x))
+    if "gain_ema" in arrays and getattr(gbdt, "use_screening", False):
+        gbdt._gain_ema_dev = jnp.asarray(
+            np.asarray(arrays["gain_ema"], np.float32))
+        gbdt._screen_mask_cache = None
+        gbdt._iter_gain_acc = None
 
     gbdt.best_score.clear()
     gbdt.best_iter.clear()

@@ -168,12 +168,27 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # - rollover_dropped_requests: MUST stay 0 — the atomic-swap
     #   rollover contract (continuous traffic, zero dropped);
     #   zero-to-nonzero always flags.
+    # - hist_dispatches_per_iter (bench.py --micro histogram leg): the
+    #   three histogram-plane cuts (quantized gradients, gain
+    #   screening, adaptive bins) riding the megastep — must EQUAL
+    #   dispatches_per_iter; drift means a cut started evicting it;
+    # - hist_bytes_per_iter / hist_bytes_per_iter_f32: the analytic
+    #   byte model of the histogram plane under the cut / baseline
+    #   layouts (pure layout arithmetic — zero wall-clock noise); an
+    #   increase means the packing or quantized channel layout
+    #   regressed;
+    # - hist_quant_bits / screening_active_features: the active cut
+    #   configuration and the screening mask width — shape drifts
+    #   flag.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
                  "ingest_dispatches_per_iter", "ingest_chunks",
                  "ingest_max_live_chunks", "ingest_model_mismatch",
                  "mp_dispatches_per_iter",
+                 "hist_dispatches_per_iter", "hist_bytes_per_iter",
+                 "hist_bytes_per_iter_f32", "hist_quant_bits",
+                 "screening_active_features",
                  "dispatches_per_request", "compiles_per_1k_requests",
                  "shed_ratio", "reject_ratio", "overload_unresolved",
                  "overload_queue_overflow",
