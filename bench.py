@@ -347,6 +347,13 @@ def run_micro() -> None:
 
     tel_path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                             f"bench_micro_tel_{os.getpid()}.jsonl")
+    # run reports land at stable paths so CI can run scripts/run_diff.py
+    # over the job's two reports after the bench exits
+    report_dir = os.environ.get("BENCH_REPORT_DIR",
+                                os.environ.get("TMPDIR", "/tmp"))
+    report_base = os.path.join(report_dir, "bench_micro_run_report.json")
+    report_obs = os.path.join(report_dir,
+                              "bench_micro_run_report_obs.json")
     params = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
               "learning_rate": 0.2, "min_data_in_leaf": 5, "verbose": -1,
               "metric": "None", "tpu_engine": "fused",
@@ -354,7 +361,7 @@ def run_micro() -> None:
               # mode exists precisely to measure its dispatch counters)
               "tpu_megastep": True, "telemetry_out": tel_path}
     t0 = time.perf_counter()
-    bst = lgb.train(params, lgb.Dataset(
+    bst = lgb.train(dict(params, run_report_out=report_base), lgb.Dataset(
         X, label=y, params={"max_bin": 63, "verbose": -1}),
         num_boost_round=n_iters)
     wall = time.perf_counter() - t0
@@ -374,6 +381,25 @@ def run_micro() -> None:
         float(c.get("train.dispatches", 0)) / iters, 4)
     _RESULT["drains"] = int(c.get("train.drains", 0))
     _RESULT["fast_path"] = bool(bst._gbdt._fast_path_ok())
+    # attach the consolidated run report (trimmed to its comparable
+    # core — the full artifact stays on disk for run_diff) so the
+    # trajectory history carries attribution, not just headlines
+    try:
+        rep = json.load(open(report_base))
+        _RESULT["run_report"] = {
+            "path": report_base, "schema": rep.get("schema"),
+            "run_id": rep.get("run_id"),
+            "derived": rep.get("derived"),
+            "cost": {k: rep.get("cost", {}).get(k)
+                     for k in ("flops_per_iter", "hlo_bytes_per_iter",
+                               "achieved_fraction")},
+            "reasons": rep.get("reasons")}
+        _RESULT["run_report_ok"] = bool(
+            str(rep.get("schema", "")).startswith(
+                "lightgbm_tpu.run_report/"))
+    except Exception as e:
+        print(f"run report attach failed: {e}", file=sys.stderr)
+        _RESULT["run_report_ok"] = False
     _emit()   # the bare-training counters are on stdout now
 
     # ---- eval leg: the dominant production config — train() with two
@@ -454,7 +480,8 @@ def run_micro() -> None:
     ds4 = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
     t0 = time.perf_counter()
     bst4 = lgb.train(dict(params, telemetry_out=tel_obs,
-                          metrics_port=obs_port),
+                          metrics_port=obs_port,
+                          run_report_out=report_obs),
                      ds4, num_boost_round=n_iters)
     obs_wall = time.perf_counter() - t0
     _phase("micro_obs_train_ok")
@@ -479,10 +506,113 @@ def run_micro() -> None:
     except Exception as e:
         print(f"exporter scrape failed: {e}", file=sys.stderr)
         _RESULT["exporter_scrape_ok"] = False
+    # armed-but-untriggered /profile endpoint: arming after the last
+    # drain boundary leaves the request pending forever — the window
+    # never opens, the counters never move (the CI assertion that
+    # obs_dispatches_per_iter == dispatches_per_iter above is measured
+    # with this armed endpoint live), and a second POST refuses with
+    # 409 (the overlap contract)
+    try:
+        from lightgbm_tpu.obs.export import post
+        base_url = mx.url.rsplit("/metrics", 1)[0]
+        code1, body1 = post(f"{base_url}/profile?iters=2")
+        code2, body2 = post(f"{base_url}/profile?iters=2")
+        _RESULT["profile_armed_untriggered_ok"] = (
+            code1 == 200 and bool(body1.get("armed"))
+            and code2 == 409 and not body2.get("armed", True))
+        c4b = bst4.telemetry().get("counters", {})
+        # arming must not have moved a single dispatch
+        _RESULT["profile_armed_untriggered_ok"] &= (
+            c4b.get("train.dispatches") == c4.get("train.dispatches"))
+    except Exception as e:
+        print(f"profile arm check failed: {e}", file=sys.stderr)
+        _RESULT["profile_armed_untriggered_ok"] = False
     finally:
         if mx is not None:
             mx.stop()
     _emit()   # the obs-leg counters are on stdout now
+
+    # ---- control-plane leg: POST /profile?iters=2 against a LIVE
+    # megastep training job (the ISSUE 15 acceptance run). Two chunks
+    # of n_iters iterations each, a watcher thread arming the endpoint
+    # as soon as it answers: the on-demand jax.profiler window opens at
+    # a drain boundary / iteration edge and closes at the next drain
+    # boundary — so the leg must measure ctl_dispatches_per_iter ==
+    # dispatches_per_iter EXACTLY (2 dispatches / 2*n_iters iterations
+    # == 1/n_iters == the base leg; profiling is dispatch-neutral),
+    # with exactly one closed profile_window and a non-empty trace dir.
+    import threading as _threading
+    ctl_port = _free_port()
+    tel_ctl = tel_path + ".ctl"
+    ctl_prof_dir = tempfile.mkdtemp(prefix="bench_micro_ctlprof_")
+    n_ctl_iters = 2 * n_iters
+    ctl_stop = _threading.Event()
+    ctl_armed = {}
+
+    def _arm_profile():
+        from lightgbm_tpu.obs.export import post as _post
+        from lightgbm_tpu.obs.export import scrape as _scrape
+        url = (f"http://127.0.0.1:{ctl_port}/profile?iters=2"
+               f"&dir={ctl_prof_dir}")
+        # wait until the first chunk has DISPATCHED before arming, so
+        # the window's open lands at the chunk's drain boundary — the
+        # drain-boundary semantics the acceptance criterion names
+        # (arming earlier is equally dispatch-neutral, just opens at
+        # the iteration-0 edge instead). Poll /snapshot, NOT /metrics:
+        # the metrics body is TTL-cached ~1 s, and a stale read here
+        # could slip the arm past the first drain boundary on a fast
+        # runner (the window must close at a drain, not at finalize)
+        while not ctl_stop.is_set():
+            try:
+                _, body = _scrape(
+                    f"http://127.0.0.1:{ctl_port}/snapshot", timeout=2)
+                if json.loads(body).get("counters", {}).get(
+                        "train.dispatches", 0) >= 1:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.02)
+        while not ctl_stop.is_set():
+            try:
+                code, body = _post(url, timeout=2)
+                ctl_armed["code"], ctl_armed["body"] = code, body
+                if code == 200:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.02)
+
+    ctl_thread = _threading.Thread(target=_arm_profile, daemon=True)
+    ctl_thread.start()
+    ds6 = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst6 = lgb.train(dict(params, telemetry_out=tel_ctl,
+                          metrics_port=ctl_port,
+                          tpu_megastep_iters=n_iters),
+                     ds6, num_boost_round=n_ctl_iters)
+    ctl_wall = time.perf_counter() - t0
+    ctl_stop.set()
+    ctl_thread.join(timeout=5)
+    _phase("micro_ctl_train_ok")
+    snap6 = bst6._gbdt.telemetry.snapshot()
+    c6 = snap6.get("counters", {})
+    ctl_iters = max(1, int(c6.get("iterations", n_ctl_iters)))
+    _RESULT["ctl_sec_per_iter"] = round(ctl_wall / ctl_iters, 5)
+    _RESULT["ctl_dispatches_per_iter"] = round(
+        float(c6.get("train.dispatches", 0)) / ctl_iters, 4)
+    windows = [e for e in snap6.get("events", [])
+               if e.get("event") == "profile_window"]
+    _RESULT["ctl_profile_windows"] = sum(
+        1 for e in windows if e.get("state") == "closed")
+    _RESULT["ctl_profile_states"] = [e.get("state") for e in windows]
+    ctl_files = [os.path.join(r, f)
+                 for r, _, fs in os.walk(ctl_prof_dir) for f in fs]
+    _RESULT["ctl_profile_trace_ok"] = bool(ctl_files)
+    mx6 = getattr(bst6._gbdt, "_metrics", None)
+    if mx6 is not None:
+        mx6.stop()
+    shutil.rmtree(ctl_prof_dir, ignore_errors=True)
+    _emit()   # the control-plane counters are on stdout now
 
     # ---- histogram-plane leg: quantized gradients + gain screening +
     # adaptive per-feature bins (ROADMAP item 4). Two trainings on a
@@ -637,8 +767,8 @@ def run_micro() -> None:
         _RESULT["mp_iterations_kept"] = mp_iters
     except Exception as e:
         print(f"multiproc leg failed: {e}", file=sys.stderr)
-    for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ing, tel_hb,
-              tel_hc):
+    for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ctl, tel_ing,
+              tel_hb, tel_hc):
         try:
             os.remove(p)
         except OSError:

@@ -269,6 +269,18 @@ class GBDT:
         self._prof_n = -1
         self._prof_active = False
         self._prof_done = False
+        # on-demand profiling control plane (POST /profile on the
+        # metrics exporter): the armed-request handoff and the open
+        # window's bookkeeping ({dir, it0, iters}); windows open/close
+        # only at drain boundaries / iteration edges, so an armed-but-
+        # idle endpoint is dispatch-neutral by construction
+        self._profile_ctl = None
+        self._ctl_window = None
+        self._ctl_no_open = False
+        # device-time cost ledger (obs/cost.py): fresh executable
+        # signatures queue here at dispatch, analyses run at drains
+        self._cost = None
+        self._run_report_out = ""
         # resilience (resilience/): async checkpoint manager, cadence
         # bookkeeping, the engine's extra-state hook (callback closures'
         # early-stop state rides the checkpoint), fault registry
@@ -446,7 +458,10 @@ class GBDT:
         metrics_port = int(getattr(config, "metrics_port", 0) or 0)
         self._mem_watermarks = bool(getattr(config, "memory_watermarks",
                                             True))
-        if out or self._trace_out or period > 0 or metrics_port > 0:
+        self._run_report_out = str(getattr(config, "run_report_out", "")
+                                   or "")
+        if out or self._trace_out or period > 0 or metrics_port > 0 \
+                or self._run_report_out:
             # enable() attaches the sink even when the registry is
             # already on sink-less (record_telemetry first, then
             # reset_parameter(telemetry_out=...) must still get a file);
@@ -476,8 +491,19 @@ class GBDT:
             self._metrics.stop()
             self._metrics = None
         if want_port > 0 and self._metrics is None:
-            from ..obs.export import MetricsExporter
-            self._metrics = MetricsExporter(tel, want_port)
+            from ..obs.export import MetricsExporter, ProfileControl
+            if self._profile_ctl is None:
+                self._profile_ctl = ProfileControl()
+                # overlap refusal extends to the config-keyed window: a
+                # pending/active profile_dir trace owns the profiler
+                self._profile_ctl.conflict_check = (
+                    lambda: "config:profile_dir window pending"
+                    if (self._prof_active
+                        or (self._prof_dir and not self._prof_done))
+                    else None)
+            self._metrics = MetricsExporter(
+                tel, want_port, profile_control=self._profile_ctl,
+                report_fn=self.build_run_report)
             if self._metrics.start() < 0:
                 # total bind failure (not the in-use fallback): drop
                 # the dead exporter so a later reset_parameter round
@@ -507,6 +533,15 @@ class GBDT:
                         gran)
             gran = "batch"
         self._tel_gran = gran
+        # device-time cost ledger: one per registry lifetime (keeps the
+        # analyzed-signature dedup across reset_parameter round trips);
+        # mode changes re-derive it
+        cost_mode = str(getattr(config, "cost_ledger", "hlo") or "hlo")
+        if not tel.enabled or cost_mode == "off":
+            self._cost = None
+        elif self._cost is None or self._cost.mode != cost_mode:
+            from ..obs.cost import CostLedger
+            self._cost = CostLedger(tel, cost_mode)
         # streamed/cached datasets carry their ingest counters from
         # before the registry existed; fold them in now (init and any
         # reset_config that turns telemetry on)
@@ -579,7 +614,9 @@ class GBDT:
         (profile_dir + profile_start_iteration + profile_num_iterations:
         a TensorBoard/Perfetto trace of iterations K..K+n is one config
         key away)."""
-        if self._prof_done or not self._prof_dir:
+        self._profile_ctl_step()
+        if self._prof_done or not self._prof_dir \
+                or self._ctl_window is not None:
             return
         it = self.iter
         if not self._prof_active:
@@ -610,11 +647,92 @@ class GBDT:
         self.telemetry.event("profiler_trace_stop", iteration=self.iter,
                              log_dir=self._prof_dir)
 
+    # ------------------------------------------- on-demand profile windows
+    def _profile_ctl_step(self) -> None:
+        """Advance the on-demand profiling state machine (POST /profile
+        on the metrics exporter) at the driver's existing sync points:
+        megastep drain boundaries (_drain_body tail) and iteration
+        edges (_profiler_step).  An open window closes at the first
+        boundary >= ``iters`` iterations after it opened; an armed
+        request opens only when no device work is pending and no
+        config-keyed window owns the profiler.  Everything here is host
+        flag-reads and (rarely) jax.profiler start/stop — zero device
+        dispatches, which is the neutrality contract the bench gates."""
+        ctl = self._profile_ctl
+        if ctl is None:
+            return
+        win = self._ctl_window
+        if win is not None:
+            if self.iter - win["it0"] >= win["iters"]:
+                self._close_ctl_window()
+            return
+        if self._prof_active or self._pending \
+                or getattr(self, "_ctl_no_open", False):
+            # a config window owns the profiler, dispatches are in
+            # flight (mid-pipeline edge), or finalize is running (no
+            # later boundary would ever stop a window opened now):
+            # wait for an honest boundary
+            return
+        req = ctl.take()
+        if req is None:
+            return
+        if not req.get("dir"):
+            # default trace dir minted only now, when the window really
+            # opens — an armed-but-never-fired request leaks nothing
+            import tempfile
+            req["dir"] = tempfile.mkdtemp(prefix="lgbm_profile_")
+        try:
+            jax.profiler.start_trace(req["dir"])
+        except Exception as e:
+            log.warning("on-demand profiler window failed to start: %s",
+                        e)
+            self.telemetry.event("profile_window", state="failed",
+                                 iteration=self.iter, dir=req["dir"],
+                                 error=str(e)[:200])
+            ctl.done()
+            return
+        self._ctl_window = {"dir": req["dir"], "it0": self.iter,
+                            "iters": int(req["iters"])}
+        self.telemetry.event("profile_window", state="open",
+                             iteration=self.iter, dir=req["dir"],
+                             iters=int(req["iters"]))
+
+    def _close_ctl_window(self, state: str = "closed") -> None:
+        win, self._ctl_window = self._ctl_window, None
+        if win is None:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("on-demand profiler window failed to stop: %s",
+                        e)
+            state = "failed"
+        self.telemetry.event("profile_window", state=state,
+                             iteration=self.iter, dir=win["dir"],
+                             iters=win["iters"],
+                             covered=self.iter - win["it0"])
+        if self._profile_ctl is not None:
+            self._profile_ctl.done()
+
     def finalize_telemetry(self) -> None:
         """End-of-training hook: stop an open profiler trace, emit the
         summary event (per-rank counters aggregated at rank 0 under
         multi-process — SPMD: every rank calls this at the same point),
-        flush the JSONL sink."""
+        write the consolidated run report (run_report_out), flush the
+        JSONL sink."""
+        # no NEW on-demand window may open past this point: the tail
+        # drain below runs _profile_ctl_step at its boundary, and a
+        # request taken there would open a trace with no later boundary
+        # to stop it (busy forever, leaked profiler session)
+        self._ctl_no_open = True
+        try:
+            self._finalize_telemetry_body()
+        finally:
+            # a kept booster can resume training (update loop after
+            # engine.train finalized) — windows must re-arm then
+            self._ctl_no_open = False
+
+    def _finalize_telemetry_body(self) -> None:
         self._profiler_stop()
         if self._ckpt is not None:
             # join the in-flight write: a checkpoint enqueued at the
@@ -625,23 +743,95 @@ class GBDT:
                 log.warning("checkpoint writer drain failed: %s", e)
         tel = self.telemetry
         if not tel.enabled:
+            self._close_ctl_window("closed_at_finalize")
             return
         self.drain_pending()
+        # the tail drain may have closed an elapsed window at its
+        # boundary; anything still open ends here, after the last
+        # iterations it covered are drained
+        self._close_ctl_window("closed_at_finalize")
+        if self._cost is not None:
+            self._cost.flush()   # analyses queued since the last drain
         snap = tel.snapshot()
+        rank_sections = None
         if getattr(self, "mp", None) is not None:
             from ..obs import allgather_json
-            per_rank = allgather_json({"rank": snap["rank"],
-                                       "counters": snap["counters"]})
+            from ..obs import report as report_mod
+            # ONE allgather carries both the summary counters and the
+            # compact per-rank report section (zero new collectives —
+            # the payload just grew)
+            per_rank = allgather_json({
+                "rank": snap["rank"], "counters": snap["counters"],
+                "report_section": report_mod.rank_section(
+                    snap, snap["rank"],
+                    evicted=self._evicted_snapshot())})
+            rank_sections = [p.get("report_section") for p in per_rank
+                             if isinstance(p.get("report_section"), dict)]
             if tel.rank == 0:
                 tel.event("summary", iteration=self.iter,
                           counters=snap["counters"],
-                          timings=snap["timings"], ranks=per_rank)
+                          timings=snap["timings"],
+                          ranks=[{k: p.get(k)
+                                  for k in ("rank", "counters")}
+                                 for p in per_rank])
         else:
             tel.event("summary", iteration=self.iter,
                       counters=snap["counters"],
                       timings=snap["timings"])
+        self._write_run_report(snap, rank_sections)
         self._export_trace()
         tel.flush()
+
+    # -------------------------------------------------------- run report
+    def _evicted_snapshot(self):
+        """Race-tolerant copy of the eviction-reason set: GET /report is
+        served from the exporter's HTTP threads WHILE training mutates
+        `_evict_reported`, and iterating a set across a concurrent add
+        raises RuntimeError in CPython.  The set only ever grows (a few
+        entries per run), so a short retry converges immediately."""
+        for _ in range(8):
+            try:
+                return sorted(self._evict_reported)
+            except RuntimeError:
+                continue
+        return []
+
+    def build_run_report(self, snapshot=None, rank_sections=None):
+        """Consolidated run report (obs/report.py) from the LIVE
+        registry — the exporter's GET /report source and the
+        run_report_out artifact builder."""
+        from ..obs import report as report_mod
+        tel = self.telemetry
+        try:
+            import jax as _jax
+            world = int(_jax.process_count())
+        except Exception:
+            world = 1
+        return report_mod.build_report(
+            snapshot if snapshot is not None else tel.snapshot(),
+            run_id=tel.run_id, rank=tel.rank, world_size=world,
+            evicted=self._evicted_snapshot(),
+            cost_entries=self._cost.entries() if self._cost else None,
+            ranks=rank_sections)
+
+    def _write_run_report(self, snap, rank_sections) -> None:
+        """Write run_report.json (+ .md) at finalize.  Multi-process:
+        rank 0 writes the aggregated report (per-rank sections rode the
+        finalize allgather); other ranks write nothing — one artifact
+        per run, like the merged trace."""
+        out = self._run_report_out
+        if not out or self.telemetry.rank != 0:
+            return
+        from ..obs import report as report_mod
+        try:
+            report = self.build_run_report(snap, rank_sections)
+            report_mod.write_report(out, report)
+        except Exception as e:   # the report must never kill finalize
+            log.warning("run report write to %s failed: %s", out, e)
+            return
+        self.telemetry.event("run_report_written", path=out,
+                             schema=report_mod.SCHEMA)
+        log.info("run report written to %s", out)
 
     def _export_trace(self) -> None:
         """Write the Chrome-trace timeline (trace_out): drain this
@@ -3320,6 +3510,7 @@ class GBDT:
                 .set(self._feature_mask()) for _ in range(k)])
         self.telemetry.inc("train.dispatches")
         ext = bool(self.use_screening or self.quant_bits)
+        t_call0 = time.perf_counter() if fresh_step else 0.0
         with self._maybe_record_collectives(fresh_step) as rec:
             if ext:
                 ema = (self._ensure_gain_ema() if self.use_screening
@@ -3328,17 +3519,34 @@ class GBDT:
                            if self.use_screening else None)
                 seed = (jnp.uint32(self._quant_seed(self.iter))
                         if self.quant_bits else None)
-                self.scores, trees, ema2 = self._fast_step_fn(
-                    self.fused_bins_T, self.scores, grad_in, hess_in,
-                    self.bag_weight, fm_pads, ema, explore, seed)
+                call_args = (self.fused_bins_T, self.scores, grad_in,
+                             hess_in, self.bag_weight, fm_pads, ema,
+                             explore, seed)
+                self.scores, trees, ema2 = self._fast_step_fn(*call_args)
                 if self.use_screening:
                     self._gain_ema_dev = ema2
             else:
-                self.scores, trees = self._fast_step_fn(
-                    self.fused_bins_T, self.scores, grad_in, hess_in,
-                    self.bag_weight, fm_pads)
+                call_args = (self.fused_bins_T, self.scores, grad_in,
+                             hess_in, self.bag_weight, fm_pads)
+                self.scores, trees = self._fast_step_fn(*call_args)
         if rec is not None:
             self._coll_per_iter = rec.profile
+        if fresh_step and self.telemetry.enabled:
+            # fast-step compile accounting, same contract as the
+            # megastep's: the first call traces + compiles before the
+            # async dispatch returns, so its wall is the compile cost;
+            # the cost-ledger note defers fn.lower() to the next drain
+            op_bytes = sum(int(getattr(a, "nbytes", 0))
+                           for a in call_args if a is not None)
+            sig = f"fast_step[k={k},ext={ext}]"
+            self.telemetry.compile_executable(
+                sig, (time.perf_counter() - t_call0) * 1000.0, op_bytes,
+                iteration=self.iter)
+            if self._cost is not None:
+                self._cost.note(self._fast_step_fn, call_args, sig,
+                                kind="fast_step", scale=1,
+                                operand_bytes=op_bytes,
+                                iteration=self.iter)
         return self._finish_fast_iter(trees, init_scores)
 
     def _finish_fast_iter(self, trees, init_scores):
@@ -3610,6 +3818,21 @@ class GBDT:
                 log.debug("screening gauge failed: %s", e)
         if tel.enabled and flat:
             self._publish_hist_gauges()
+        if tel.enabled and flat and self._cost is not None:
+            # cost-ledger join for the drained batch: the deferred
+            # fn.lower() analyses run HERE (host-sync point), then one
+            # record marries analytic flops/bytes-per-iter with the
+            # batch's measured wall, the measured collective payload
+            # and the hist.* analytic plane model
+            meas = getattr(self, "_coll_per_iter", None)
+            self._cost.ledger_record(
+                base_iter, len(flat),
+                wall_s=(time.perf_counter() - self._batch_t0
+                        if self._batch_t0 is not None else None),
+                hist_bytes_per_iter=(self._hist_stats or {}).get(
+                    "bytes_per_iter"),
+                coll_bytes_per_iter=(float(meas[1]) if meas is not None
+                                     else None))
         self._batch_t0 = self._batch_w0 = None
         self._batch_fused = 0
         # drain boundaries are the fast path's natural consistency
@@ -3618,6 +3841,10 @@ class GBDT:
         # training state without any extra device dispatch
         if flat and self._ckpt is not None:
             self.maybe_checkpoint()
+        # ... and the on-demand profiling window (POST /profile) opens
+        # and closes at exactly these boundaries on the megastep driver
+        if flat:
+            self._profile_ctl_step()
 
     def _replay_drained_eval(self, flat_metrics, base_iter: int,
                              n_flat: int, stop_i: Optional[int],
@@ -3949,21 +4176,20 @@ class GBDT:
                                               step_num=self.iter), \
                 self._maybe_record_collectives(fresh_fn) as coll_rec:
             ext = bool(self.use_screening or self.quant_bits)
+            base_args = (self.fused_bins_T, self.scores,
+                         tuple(self.valid_bins),
+                         tuple(self.valid_scores),
+                         operands, self.bag_weight, fm_pads)
             if plan is None:
                 if ext:
                     ema0, explore_B, seeds_B = self._megastep_aux(chunk)
-                    scores, vscores, trees_B, ema2 = fn(
-                        self.fused_bins_T, self.scores,
-                        tuple(self.valid_bins), tuple(self.valid_scores),
-                        operands, self.bag_weight, fm_pads, ema0,
-                        explore_B, seeds_B)
+                    call_args = base_args + (ema0, explore_B, seeds_B)
+                    scores, vscores, trees_B, ema2 = fn(*call_args)
                     if self.use_screening:
                         self._gain_ema_dev = ema2
                 else:
-                    scores, vscores, trees_B = fn(
-                        self.fused_bins_T, self.scores,
-                        tuple(self.valid_bins), tuple(self.valid_scores),
-                        operands, self.bag_weight, fm_pads)
+                    call_args = base_args
+                    scores, vscores, trees_B = fn(*call_args)
             else:
                 if self._plan_ops is None:
                     self._plan_ops = plan.operands()
@@ -3973,22 +4199,18 @@ class GBDT:
                                      dtype=jnp.int32)
                 if ext:
                     ema0, explore_B, seeds_B = self._megastep_aux(chunk)
+                    call_args = base_args + (iters_B, self._plan_ops,
+                                             self._es_carry, ema0,
+                                             explore_B, seeds_B)
                     (scores, vscores, self._es_carry, trees_B,
-                     metrics_B, ema2) = fn(
-                        self.fused_bins_T, self.scores,
-                        tuple(self.valid_bins), tuple(self.valid_scores),
-                        operands, self.bag_weight, fm_pads, iters_B,
-                        self._plan_ops, self._es_carry, ema0,
-                        explore_B, seeds_B)
+                     metrics_B, ema2) = fn(*call_args)
                     if self.use_screening:
                         self._gain_ema_dev = ema2
                 else:
+                    call_args = base_args + (iters_B, self._plan_ops,
+                                             self._es_carry)
                     (scores, vscores, self._es_carry, trees_B,
-                     metrics_B) = fn(
-                        self.fused_bins_T, self.scores,
-                        tuple(self.valid_bins), tuple(self.valid_scores),
-                        operands, self.bag_weight, fm_pads, iters_B,
-                        self._plan_ops, self._es_carry)
+                     metrics_B) = fn(*call_args)
         if coll_rec is not None:
             # the scan traces its body ONCE regardless of chunk length,
             # so the recorded totals are the per-iteration schedule
@@ -4003,10 +4225,18 @@ class GBDT:
                 int(getattr(a, "nbytes", 0)) for a in
                 [self.fused_bins_T, self.scores, self.bag_weight,
                  fm_pads, *self.valid_bins, *self.valid_scores])
+            sig = f"megastep[chunk={chunk},k={k},eval={plan is not None}]"
             self.telemetry.compile_executable(
-                f"megastep[chunk={chunk},k={k},eval={plan is not None}]",
-                (time.perf_counter() - t_call0) * 1000.0, op_bytes,
+                sig, (time.perf_counter() - t_call0) * 1000.0, op_bytes,
                 iteration=self.iter)
+            if self._cost is not None:
+                # queue the fresh signature for the cost ledger: aval
+                # capture only here (cheap, donation-safe); the
+                # fn.lower() analysis runs at the next drain boundary,
+                # off the dispatch path (obs/cost.py)
+                self._cost.note(fn, call_args, sig, kind="megastep",
+                                scale=chunk, operand_bytes=op_bytes,
+                                iteration=self.iter)
         self.scores = scores
         self.valid_scores = list(vscores)
         # the fused-epilogue carry (score_pad, hist0, gh_T) captured

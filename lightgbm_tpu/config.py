@@ -452,10 +452,37 @@ _PARAMS: List[_Param] = [
     _p("memory_watermarks", bool, True,
        ("memory_watermark", "mem_watermarks"),
        desc="when telemetry is enabled, gauge every local device's "
-            "bytes_in_use / peak_bytes_in_use / bytes_limit "
-            "(mem.d<id>.* gauges, the exporter's HBM-headroom series) "
-            "at megastep drain and serving dispatch boundaries; "
-            "backends without allocator stats (CPU) degrade to a no-op"),
+            "bytes_in_use / peak_bytes_in_use / bytes_limit — plus "
+            "bytes_reserved / peak_bytes_reserved and a derived "
+            "free-space fragmentation ratio where the backend's "
+            "allocator reports them — (mem.d<id>.* gauges, the "
+            "exporter's HBM-headroom series) at megastep drain and "
+            "serving dispatch boundaries; backends without allocator "
+            "stats (CPU) degrade to a no-op"),
+    _p("run_report_out", str, "", ("run_report", "report_out"),
+       desc="path: write the consolidated, schema-versioned run report "
+            "(run_report.json + rendered <path>.md) at finalize — "
+            "dispatch/compile counters with per-iteration derivations, "
+            "every megastep_evicted / degrade reason fired, the "
+            "device-time cost ledger, collective traffic, memory "
+            "watermarks and checkpoint/recovery events in ONE "
+            "comparable artifact (scripts/run_diff.py diffs two of "
+            "them). Multi-process: rank 0 writes the report with a "
+            "per-rank section aggregated over the existing finalize "
+            "allgather. Implies telemetry (batch granularity); the "
+            "same report is served live from GET /report when "
+            "metrics_port is set"),
+    _p("cost_ledger", str, "hlo", ("cost_analysis_mode",),
+       desc="device-time cost ledger mode (obs/cost.py): 'hlo' "
+            "(default — analyze each fresh executable signature "
+            "[megastep chunks, fast step, serve buckets] with the "
+            "client-side HLO cost model, no second compile), "
+            "'compiled' (post-optimization compiled.cost_analysis(); "
+            "pays a second backend compile unless "
+            "compilation_cache_dir is armed), 'off'. Active only while "
+            "telemetry is enabled; feeds cost.flops_per_iter / "
+            "cost.hlo_bytes_per_iter / cost.achieved_fraction gauges "
+            "and one cost_ledger record per drained batch"),
     # ---- Serving admission control (docs/Serving.md) ----
     _p("serve_max_queue_rows", int, 0, ("serve_queue_rows",),
        check=(">=", 0),

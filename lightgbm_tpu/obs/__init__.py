@@ -24,20 +24,37 @@ package is the permanent, low-overhead replacement:
 - reqtrace.py — request-scoped serving traces: a ``trace_id`` minted at
   ``PredictionService.submit()`` rides through the micro-batcher and
   engine dispatch into one ``serve_access`` JSONL record and one
-  Perfetto span per request.
+  Perfetto span per request;
+- :class:`ProfileControl` (export.py) — the on-demand profiling handoff
+  behind ``POST /profile?iters=N``: the exporter arms it, the driver
+  opens/closes a bounded ``jax.profiler`` window at its next drain
+  boundary;
+- :class:`CostLedger` (cost.py) — device-time cost ledger: per fresh
+  executable signature ``cost_analysis()`` joined with measured wall
+  times, collective payloads and the analytic histogram byte model into
+  ``cost.*`` gauges and per-batch ``cost_ledger`` records;
+- report.py — the schema-versioned consolidated run report
+  (``run_report_out=<path>`` / ``GET /report``) that
+  ``scripts/run_diff.py`` compares with deterministic-counter
+  strictness.
 
 Every recording method is a no-op behind a single attribute check while
 the registry is disabled, so instrumentation stays in the hot driver
 paths permanently, like the reference's TIMETAG sections.
 """
+from .cost import CostLedger
 from .events import JsonlSink
-from .export import MetricsExporter, render_openmetrics
+from .export import MetricsExporter, ProfileControl, render_openmetrics
 from .health import HealthAuditor, model_state_hash
 from .jaxmon import device_memory_stats, memory_watermarks
 from .registry import Telemetry, allgather_json
+from .report import (build_report, compare_reports, load_report,
+                     render_markdown, write_report)
 from .trace import chrome_trace_events, write_trace
 
 __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
            "memory_watermarks", "allgather_json", "HealthAuditor",
            "model_state_hash", "chrome_trace_events", "write_trace",
-           "MetricsExporter", "render_openmetrics"]
+           "MetricsExporter", "render_openmetrics", "ProfileControl",
+           "CostLedger", "build_report", "compare_reports",
+           "load_report", "render_markdown", "write_report"]

@@ -33,11 +33,36 @@ Port discipline: under the multiproc launcher each rank binds
 ephemeral port with a structured ``metrics_exporter`` event (never an
 exception into training), so two boosters in one process — or a test
 runner racing itself — cannot crash a run over a TCP bind.
+
+Control plane (docs/Observability.md §12): beyond the scrape path the
+exporter is the RUNNING job's control surface —
+
+- ``GET /snapshot`` — the FULL registry snapshot (counters, gauges,
+  timings, dists, event + finding rings) as JSON; the on-demand deep
+  view ``/metrics`` deliberately omits;
+- ``POST /profile?iters=N[&dir=...]`` — arm a bounded ``jax.profiler``
+  window that the driver opens at its next megastep drain boundary
+  (iteration edge on the sync driver) and closes N iterations later at
+  the following boundary.  Arming while a window is armed, open, or a
+  ``profile_dir`` config window is pending answers 409 (overlap
+  refusal); arming never dispatches — the driver only reads a flag at
+  sync points it already owns, which is the counter-asserted
+  dispatch-neutrality contract ``bench.py --micro`` gates;
+- ``GET /report`` — the consolidated run report (obs/report.py) built
+  from the live registry, same schema as the ``run_report_out``
+  artifact.
+
+``/metrics`` bodies are cached for ``cache_ttl`` (~1 s): a tight
+external scrape loop re-reads the cached rendering instead of
+contending the training/serving worker threads on the registry lock;
+``/snapshot`` and ``/report`` are on-demand and never cached.
 """
 from __future__ import annotations
 
+import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -139,24 +164,130 @@ def render_openmetrics(snapshot: Dict[str, Any],
     return "\n".join(lines) + "\n"
 
 
+class ProfileControl:
+    """Thread-safe handoff of on-demand profiling requests between the
+    HTTP control plane (exporter daemon threads) and the training
+    driver (which polls at drain boundaries / iteration edges — the
+    sync points it already owns, so an armed-but-idle request costs
+    zero device dispatches).
+
+    State machine: idle -> armed (``arm``) -> busy (driver ``take``
+    opens the window) -> idle (``done`` when the window closes).
+    ``arm`` refuses overlap: a second request while armed or busy —
+    or while the owner's ``conflict_check`` reports a pending
+    ``profile_dir`` config window — returns ``(False, reason)``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Optional[Dict[str, Any]] = None
+        self._busy = False
+        # owner-installed () -> Optional[str]: non-None names a
+        # conflicting profiling source (e.g. the profile_dir window)
+        self.conflict_check = None
+
+    def arm(self, iters: int, log_dir: str = ""
+            ) -> Tuple[bool, str, Dict[str, Any]]:
+        iters = int(iters)
+        if iters <= 0:
+            return False, "iters must be >= 1", {}
+        chk = self.conflict_check
+        conflict = None
+        if chk is not None:
+            try:
+                conflict = chk()
+            except Exception:
+                conflict = None
+        with self._lock:
+            if self._armed is not None:
+                return False, "profile window already armed", {}
+            if self._busy:
+                return False, "profile window already open", {}
+            if conflict:
+                return False, conflict, {}
+            # no default dir is minted HERE: a request armed against a
+            # finished job (no boundary ever fires — the bench's
+            # armed-but-untriggered leg does this on purpose) must not
+            # leak a directory per POST; the driver mkdtemps when the
+            # window actually opens and reports it on the
+            # profile_window open/closed events
+            req = {"iters": iters, "dir": str(log_dir or ""),
+                   "armed_ts": time.time()}
+            self._armed = req
+            return True, "armed", dict(req)
+
+    def take(self) -> Optional[Dict[str, Any]]:
+        """Driver side: claim the armed request (marks the control busy
+        until ``done``)."""
+        with self._lock:
+            req, self._armed = self._armed, None
+            if req is not None:
+                self._busy = True
+            return req
+
+    def done(self) -> None:
+        with self._lock:
+            self._busy = False
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"armed": dict(self._armed) if self._armed else None,
+                    "open": self._busy}
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the exporter must never block a scrape behind a slow peer
     timeout = 10
     exporter: "MetricsExporter" = None   # class attr set per server
 
+    def _send(self, code: int, body: bytes,
+              ctype: str = "text/plain") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: Any) -> None:
+        self._send(code, (json.dumps(obj, default=str) + "\n")
+                   .encode("utf-8"), "application/json")
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
         if path in ("/metrics", "/", "/metrics/"):
             try:
-                body = self.exporter.render().encode("utf-8")
+                body = self.exporter.render_cached().encode("utf-8")
             except Exception as e:   # a scrape bug must not kill serving
                 self.send_error(500, str(e)[:200])
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._send(200, body, CONTENT_TYPE)
+        elif path == "/snapshot":
+            # the FULL registry view (incl. event/finding rings) as
+            # JSON — on demand only, so the deep copy under the lock is
+            # an operator's choice, never a scrape loop's side effect
+            try:
+                snap = self.exporter.telemetry.snapshot()
+                snap["run_id"] = self.exporter.telemetry.run_id
+                snap["profile"] = (
+                    self.exporter.profile_control.status()
+                    if self.exporter.profile_control is not None
+                    else None)
+            except Exception as e:
+                self.send_error(500, str(e)[:200])
+                return
+            self._send_json(200, snap)
+        elif path == "/report":
+            fn = self.exporter.report_fn
+            if fn is None:
+                self._send_json(404, {"error": "no report source "
+                                               "attached"})
+                return
+            try:
+                rep = fn()
+            except Exception as e:
+                self.send_error(500, str(e)[:200])
+                return
+            self._send_json(200, rep)
         elif path == "/healthz":
             body = b"ok\n"
             self.send_response(200)
@@ -184,6 +315,39 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self.send_error(404)
 
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        path, _, query = self.path.partition("?")
+        if path != "/profile":
+            self.send_error(404)
+            return
+        ctl = self.exporter.profile_control
+        if ctl is None:
+            self._send_json(404, {"error": "no profile control attached "
+                                           "(serving exporters and bare "
+                                           "registries do not profile)"})
+            return
+        from urllib.parse import parse_qs
+        qs = parse_qs(query, keep_blank_values=True)
+        params = {k: v[-1] for k, v in qs.items()}
+        try:
+            iters = int(params.get("iters", "1"))
+        except ValueError:
+            self._send_json(400, {"error": "iters must be an integer"})
+            return
+        ok, reason, req = ctl.arm(iters, params.get("dir", ""))
+        tel = self.exporter.telemetry
+        if not ok:
+            # overlap refusal is a first-class, structured outcome: the
+            # 409 carries the reason and the registry records it
+            tel.event("profile_window", state="refused", reason=reason,
+                      iters=iters)
+            self._send_json(409, {"armed": False, "reason": reason})
+            return
+        tel.event("profile_window", state="armed", iters=req["iters"],
+                  dir=req["dir"])
+        self._send_json(200, {"armed": True, "iters": req["iters"],
+                              "dir": req["dir"]})
+
     def log_message(self, fmt, *args) -> None:   # silence per-scrape spam
         pass
 
@@ -193,7 +357,8 @@ class MetricsExporter:
 
     def __init__(self, telemetry, port: int, host: str = "127.0.0.1",
                  extra_labels: Optional[Dict[str, Any]] = None,
-                 ready_check=None):
+                 ready_check=None, profile_control=None, report_fn=None,
+                 cache_ttl: float = 1.0):
         self.telemetry = telemetry
         self.requested_port = int(port)
         self.host = host
@@ -201,6 +366,19 @@ class MetricsExporter:
         # () -> (ok, reason) readiness probe behind GET /readyz; None =
         # always ready (liveness == readiness, the training exporter)
         self.ready_check = ready_check
+        # control-plane hooks: the on-demand profiling handoff (POST
+        # /profile — training drivers install one) and the run-report
+        # source (GET /report)
+        self.profile_control = profile_control
+        self.report_fn = report_fn
+        # /metrics body cache: a tight external scrape loop re-reads
+        # the cached rendering for cache_ttl seconds instead of
+        # re-snapshotting the registry under its lock per request
+        self.cache_ttl = float(cache_ttl)
+        self.cache_hits = 0
+        self._cache_lock = threading.Lock()
+        self._cache_body: Optional[str] = None
+        self._cache_ts = 0.0
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -214,6 +392,27 @@ class MetricsExporter:
         # the events-free view: a scrape must not deep-copy the event
         # rings under the registry lock (metrics_snapshot docstring)
         return render_openmetrics(tel.metrics_snapshot(), labels, fleet)
+
+    def render_cached(self) -> str:
+        """The /metrics serving path: one fresh render per ``cache_ttl``
+        window, shared by every scraper that lands inside it.  The TTL
+        bounds staleness at ~1 s — negligible against the 15 s scrape
+        intervals time-series stores use, and the price of making a
+        scrape storm contention-free."""
+        ttl = self.cache_ttl
+        if ttl <= 0:
+            return self.render()
+        now = time.monotonic()
+        with self._cache_lock:
+            if self._cache_body is not None \
+                    and now - self._cache_ts < ttl:
+                self.cache_hits += 1
+                return self._cache_body
+        body = self.render()
+        with self._cache_lock:
+            self._cache_body = body
+            self._cache_ts = time.monotonic()
+        return body
 
     @property
     def url(self) -> Optional[str]:
@@ -292,3 +491,21 @@ def scrape(url: str, timeout: float = 5.0) -> Tuple[str, str]:
     with urlopen(url, timeout=timeout) as resp:
         return (resp.headers.get("Content-Type", ""),
                 resp.read().decode("utf-8"))
+
+
+def post(url: str, timeout: float = 5.0) -> Tuple[int, Dict[str, Any]]:
+    """Convenience POST against the control endpoints (tests, bench):
+    returns ``(status, parsed JSON body)`` — a 4xx answer (e.g. the 409
+    overlap refusal) is a RESULT here, not an exception."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+    req = Request(url, data=b"", method="POST")
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return (resp.status,
+                    json.loads(resp.read().decode("utf-8")))
+    except HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode("utf-8"))
+        except Exception:
+            return e.code, {}

@@ -86,7 +86,15 @@ def _on_duration(event: str, duration: float, **kwargs) -> None:
 
 
 _STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-              "largest_alloc_size")
+              "largest_alloc_size", "bytes_reserved",
+              "peak_bytes_reserved", "largest_free_block_bytes")
+
+#: stats exported as per-device gauges by memory_watermarks (the
+#: reserved-bytes pair only exists where the backend's allocator
+#: reports it — TPU/GPU BFC allocators do, CPU does not; absent keys
+#: are simply absent from the gauges, never zero-filled)
+_GAUGE_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+               "bytes_reserved", "peak_bytes_reserved")
 
 
 def device_memory_stats() -> Optional[Dict[int, dict]]:
@@ -133,9 +141,37 @@ def memory_watermarks(tel, where: str = "") -> Optional[Dict[int, dict]]:
     if not stats:
         return None
     for did, ent in stats.items():
-        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        for key in _GAUGE_KEYS:
             if key in ent:
                 tel.gauge(f"mem.d{did}.{key}", ent[key])
+        frag = fragmentation(ent)
+        if frag is not None:
+            ent["fragmentation"] = frag
+            tel.gauge(f"mem.d{did}.fragmentation", frag)
     if where:
         tel.inc("mem.watermarks." + where)
     return stats
+
+
+def fragmentation(ent: dict) -> Optional[float]:
+    """Free-space fragmentation of one device's allocator: the share of
+    free pool bytes NOT reachable as a single contiguous block
+    (``1 - largest_free_block / free``).  0 = one perfect free block;
+    approaching 1 = free space is shattered and a large histogram
+    buffer may OOM despite headroom.  ``largest_free_block_bytes``
+    describes the allocator's RESERVED pool, so where the allocator
+    reports ``bytes_reserved`` (a growing BFC pool) the free
+    denominator is ``bytes_reserved - bytes_in_use`` — dividing by the
+    whole unreserved limit would read a barely-grown pool as ~100%
+    fragmented while most of HBM is freely allocatable.  None where
+    the backend reports no block/limit stats (CPU)."""
+    try:
+        in_use = int(ent["bytes_in_use"])
+        largest = int(ent["largest_free_block_bytes"])
+        pool = int(ent.get("bytes_reserved", ent["bytes_limit"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+    free = pool - in_use
+    if free <= 0:
+        return 0.0
+    return max(0.0, min(1.0, 1.0 - largest / free))

@@ -1,0 +1,382 @@
+"""Consolidated, comparable run reports.
+
+One ``run_report.json`` per training (or serving) run — the single
+artifact that answers "what did this run do, and did it regress vs that
+one?" without JSONL archaeology: dispatch/compile counters with their
+per-iteration derivations, every ``megastep_evicted`` feature and
+``degrade`` reason that fired, the device-time cost ledger (obs/cost),
+measured collective traffic, per-device memory watermarks (incl. the
+``bytes_reserved``/fragmentation series where the backend reports
+them), checkpoint/recovery activity and profile windows.  Schema-
+versioned so ``scripts/run_diff.py`` can refuse apples-to-oranges
+comparisons, and rank-0 aggregates a compact per-rank section under
+multi-process (riding the finalize allgather — zero new collectives).
+
+Produced at finalize when ``run_report_out=<path>`` is set, and on
+demand from ``GET /report`` on the metrics exporter; ``bench.py``
+attaches it to trajectory records so the bench history carries the full
+attribution, not just headline numbers.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+#: bump on any structural change; run_diff refuses mismatched majors
+SCHEMA = "lightgbm_tpu.run_report/1"
+
+#: counters whose per-iteration derivation is deterministic for a fixed
+#: config — the strict half of run_diff (borrowed from bench_compare's
+#: deterministic-counter discipline: no wall-clock noise, tight
+#: threshold, zero-to-nonzero always flags)
+DETERMINISTIC_KEYS = (
+    "derived.dispatches_per_iter",
+    "derived.drains_per_iter",
+    "cost.flops_per_iter",
+    "cost.hlo_bytes_per_iter",
+    "cost.achieved_fraction",
+    "hist.bytes_per_iter",
+    "counters.iterations",
+)
+
+
+def _g(d: Dict[str, Any], dotted: str) -> Any:
+    cur: Any = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def build_report(snapshot: Dict[str, Any], *,
+                 run_id: str = "", rank: int = 0, world_size: int = 1,
+                 evicted: Optional[List[str]] = None,
+                 cost_entries: Optional[List[Dict[str, Any]]] = None,
+                 extra: Optional[Dict[str, Any]] = None,
+                 ranks: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
+    """Registry snapshot (Telemetry.snapshot schema) -> report dict.
+
+    Bounded by construction: counters/gauges/timings come over whole,
+    events are consolidated into per-name counts plus the small
+    record families the report exists to surface (cost ledger, profile
+    windows, recovery) — never the raw 512-entry ring."""
+    counters = dict(snapshot.get("counters", {}))
+    gauges = dict(snapshot.get("gauges", {}))
+    events = snapshot.get("events", []) or []
+    iters = float(counters.get("iterations", 0))
+
+    def per_iter(key: str) -> Optional[float]:
+        if iters <= 0:
+            return None
+        return round(float(counters.get(key, 0)) / iters, 6)
+
+    degrade = {k[len("degrade."):]: int(v) for k, v in counters.items()
+               if k.startswith("degrade.")}
+    by_name: Dict[str, int] = {}
+    cost_records: List[Dict[str, Any]] = []
+    profile_windows: List[Dict[str, Any]] = []
+    recoveries: List[Dict[str, Any]] = []
+    for ev in events:
+        name = str(ev.get("event", "?"))
+        by_name[name] = by_name.get(name, 0) + 1
+        if name == "cost_ledger":
+            cost_records.append({k: v for k, v in ev.items()
+                                 if k not in ("ts", "rank", "event")})
+        elif name == "profile_window":
+            profile_windows.append({k: v for k, v in ev.items()
+                                    if k not in ("ts", "rank", "event")})
+        elif name in ("recovery", "rank_divergence", "straggler"):
+            recoveries.append({k: v for k, v in ev.items()
+                               if k not in ("ts",)})
+        elif name == "megastep_evicted":
+            feat = str(ev.get("feature", "?"))
+            evicted = list(evicted or [])
+            if feat not in evicted:
+                evicted.append(feat)
+    mem = {}
+    for k, v in gauges.items():
+        if k.startswith("mem."):
+            dev, _, stat = k[len("mem."):].partition(".")
+            mem.setdefault(dev, {})[stat] = v
+    cost = {
+        "flops_per_iter": gauges.get("cost.flops_per_iter"),
+        "hlo_bytes_per_iter": gauges.get("cost.hlo_bytes_per_iter"),
+        "achieved_fraction": gauges.get("cost.achieved_fraction"),
+        "executables": list(cost_entries or []),
+        "records": cost_records[-32:],
+    }
+    hist = {k[len("hist."):]: v for k, v in gauges.items()
+            if k.startswith("hist.")}
+    report: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "generated_ts": round(time.time(), 3),
+        "run_id": str(run_id),
+        "rank": int(rank),
+        "world_size": int(world_size),
+        "counters": counters,
+        "gauges": gauges,
+        "timings": {k: dict(v)
+                    for k, v in snapshot.get("timings", {}).items()},
+        "derived": {
+            "iterations": int(iters),
+            "dispatches_per_iter": per_iter("train.dispatches"),
+            "drains_per_iter": per_iter("train.drains"),
+            "compile_executables": int(counters.get(
+                "compile.executables", 0)),
+        },
+        "reasons": {
+            "megastep_evicted": sorted(evicted or []),
+            "degrade": degrade,
+        },
+        "cost": cost,
+        "hist": hist,
+        "collectives": {
+            "count": counters.get("collectives.count", 0),
+            "bytes": counters.get("collectives.bytes", 0),
+            "bytes_per_iter": per_iter("collectives.bytes"),
+        },
+        "memory": mem,
+        "checkpoints": {
+            "written": int(counters.get("ckpt.written", 0)),
+            "recoveries": recoveries[-32:],
+        },
+        "profile_windows": profile_windows[-32:],
+        "events": {"by_name": by_name},
+    }
+    if extra:
+        report.update(extra)
+    if ranks is not None:
+        report["ranks"] = ranks
+    return report
+
+
+def rank_section(snapshot: Dict[str, Any], rank: int,
+                 evicted: Optional[List[str]] = None) -> Dict[str, Any]:
+    """The compact per-rank payload rank 0 aggregates under
+    ``report["ranks"]`` — counters + the deterministic gauges, small
+    enough to ride the existing finalize allgather."""
+    counters = dict(snapshot.get("counters", {}))
+    gauges = snapshot.get("gauges", {})
+    return {
+        "rank": int(rank),
+        "counters": counters,
+        "gauges": {k: v for k, v in gauges.items()
+                   if k.startswith(("cost.", "hist.", "mem.",
+                                    "screening."))},
+        "evicted": sorted(evicted or []),
+    }
+
+
+def write_report(path: str, report: Dict[str, Any]) -> None:
+    """Atomic write (write-then-rename) of the JSON artifact plus a
+    rendered ``<path>.md`` markdown sibling."""
+    from ..resilience.atomicio import atomic_write_text
+    atomic_write_text(path, json.dumps(report, indent=1, sort_keys=True,
+                                       default=str) + "\n")
+    try:
+        atomic_write_text(path + ".md", render_markdown(report))
+    except Exception:      # the JSON artifact is the contract
+        pass
+
+
+def load_report(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        rep = json.load(fh)
+    if not isinstance(rep, dict) or not str(
+            rep.get("schema", "")).startswith("lightgbm_tpu.run_report/"):
+        raise ValueError(f"{path} is not a lightgbm_tpu run report")
+    return rep
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e5 or abs(v) < 1e-3:
+            return f"{v:.3e}"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_markdown(report: Dict[str, Any]) -> str:
+    d = report.get("derived", {})
+    lines = [
+        f"# Run report `{report.get('run_id', '?')}`",
+        "",
+        f"- schema: `{report.get('schema')}`  rank "
+        f"{report.get('rank', 0)}/{report.get('world_size', 1)}",
+        f"- iterations: {d.get('iterations')}   dispatches/iter: "
+        f"{_fmt(d.get('dispatches_per_iter'))}   drains/iter: "
+        f"{_fmt(d.get('drains_per_iter'))}   fresh executables: "
+        f"{d.get('compile_executables')}",
+    ]
+    cost = report.get("cost", {})
+    if cost.get("flops_per_iter") is not None:
+        lines += ["", "## Cost ledger",
+                  f"- flops/iter: {_fmt(cost.get('flops_per_iter'))}   "
+                  f"hlo bytes/iter: "
+                  f"{_fmt(cost.get('hlo_bytes_per_iter'))}   "
+                  f"analytic hist fraction: "
+                  f"{_fmt(cost.get('achieved_fraction'))}"]
+        for ent in cost.get("executables", [])[:16]:
+            lines.append(
+                f"  - `{ent.get('signature')}` ({ent.get('kind')}, "
+                f"x{ent.get('scale')}): flops {_fmt(ent.get('flops'))}, "
+                f"bytes {_fmt(ent.get('hlo_bytes'))}, operands "
+                f"{_fmt(ent.get('operand_bytes'))}")
+    reasons = report.get("reasons", {})
+    if reasons.get("megastep_evicted") or reasons.get("degrade"):
+        lines += ["", "## Evictions & degradations"]
+        for feat in reasons.get("megastep_evicted", []):
+            lines.append(f"- megastep_evicted: `{feat}`")
+        for r, n in sorted(reasons.get("degrade", {}).items()):
+            lines.append(f"- degrade `{r}`: {n}")
+    coll = report.get("collectives", {})
+    if coll.get("count"):
+        lines += ["", "## Collectives",
+                  f"- {int(coll['count'])} ops, "
+                  f"{_fmt(float(coll.get('bytes', 0)))} bytes "
+                  f"({_fmt(coll.get('bytes_per_iter'))}/iter)"]
+    mem = report.get("memory", {})
+    if mem:
+        lines += ["", "## Memory watermarks"]
+        for dev in sorted(mem):
+            ent = mem[dev]
+            lines.append(
+                "- " + dev + ": " + "  ".join(
+                    f"{k}={_fmt(v)}" for k, v in sorted(ent.items())))
+    ck = report.get("checkpoints", {})
+    if ck.get("written") or ck.get("recoveries"):
+        lines += ["", "## Resilience",
+                  f"- checkpoints written: {ck.get('written', 0)}, "
+                  f"recovery/divergence events: "
+                  f"{len(ck.get('recoveries', []))}"]
+    pw = report.get("profile_windows", [])
+    if pw:
+        lines += ["", "## Profile windows"]
+        for w in pw:
+            lines.append("- " + "  ".join(f"{k}={_fmt(v)}"
+                                          for k, v in sorted(w.items())))
+    ranks = report.get("ranks")
+    if ranks:
+        lines += ["", "## Per-rank"]
+        for sec in ranks:
+            c = sec.get("counters", {})
+            lines.append(
+                f"- rank {sec.get('rank')}: iterations "
+                f"{int(c.get('iterations', 0))}, dispatches "
+                f"{int(c.get('train.dispatches', 0))}, evicted "
+                f"{sec.get('evicted', [])}")
+    lines += ["", "## Events", ""]
+    for name, n in sorted(report.get("events", {}).get("by_name", {})
+                          .items(), key=lambda kv: -kv[1]):
+        lines.append(f"- {name}: {n}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- diff
+def compare_reports(prev: Dict[str, Any], cur: Dict[str, Any],
+                    threshold: float = 0.15,
+                    det_threshold: float = 0.05) -> Dict[str, Any]:
+    """Two reports -> comparison with bench_compare's deterministic-
+    counter strictness: the DETERMINISTIC_KEYS get a tight threshold
+    (they carry no wall-clock noise), zero-to-nonzero always flags, a
+    NEW eviction/degradation reason always flags, and wall timings diff
+    per-call under the loose timing threshold.  Schema majors must
+    match."""
+    rep: Dict[str, Any] = {"status": "ok",
+                           "prev_run": prev.get("run_id"),
+                           "cur_run": cur.get("run_id"),
+                           "deterministic": {}, "timings": [],
+                           "regressions": [], "new_reasons": []}
+    ps, cs = str(prev.get("schema", "")), str(cur.get("schema", ""))
+    if ps != cs:
+        rep["status"] = "schema_mismatch"
+        rep["prev_schema"], rep["cur_schema"] = ps, cs
+        return rep
+
+    for key in DETERMINISTIC_KEYS:
+        p, c = _g(prev, key), _g(cur, key)
+        p_num = isinstance(p, (int, float))
+        c_num = isinstance(c, (int, float))
+        if not p_num and not c_num:
+            continue          # neither run carries it: not comparable
+        if p_num and not c_num:
+            # the baseline measured this counter and the candidate
+            # LOST it (e.g. every cost analysis failed, so the gauges
+            # never appeared) — silently skipping here would let the
+            # gate pass while the very counters it guards vanished
+            ent = {"name": key, "prev": round(float(p), 6),
+                   "cur": None, "ratio": None, "regressed": True,
+                   "lost": True}
+        elif not p_num:
+            # new counter the baseline predates: informational only
+            ent = {"name": key, "prev": None,
+                   "cur": round(float(c), 6), "ratio": None,
+                   "regressed": False, "new": True}
+        elif p <= 0:
+            ent = {"name": key, "prev": float(p), "cur": float(c),
+                   "ratio": None if c > 0 else 1.0, "regressed": c > 0}
+        elif c == 0:
+            # nonzero -> zero is the counter disappearing in place
+            # (a real run with iterations > 0 cannot dispatch zero
+            # times, and a ledger that read zero stopped measuring)
+            ent = {"name": key, "prev": round(float(p), 6),
+                   "cur": 0.0, "ratio": 0.0, "regressed": True,
+                   "lost": True}
+        else:
+            ratio = float(c) / float(p)
+            ent = {"name": key, "prev": round(float(p), 6),
+                   "cur": round(float(c), 6), "ratio": round(ratio, 6),
+                   "regressed": ratio > 1.0 + det_threshold}
+            # achieved_fraction regresses in EITHER direction: the
+            # analytic model drifting off the HLO truth is the finding
+            if key.endswith("achieved_fraction") \
+                    and ratio < 1.0 - det_threshold:
+                ent["regressed"] = True
+        rep["deterministic"][key] = ent
+        if ent["regressed"]:
+            rep["regressions"].append(ent)
+
+    prev_r = set(_g(prev, "reasons.megastep_evicted") or [])
+    cur_r = set(_g(cur, "reasons.megastep_evicted") or [])
+    prev_d = set((_g(prev, "reasons.degrade") or {}).keys())
+    cur_d = set((_g(cur, "reasons.degrade") or {}).keys())
+    for reason in sorted(cur_r - prev_r):
+        ent = {"name": f"megastep_evicted:{reason}", "prev": 0.0,
+               "cur": 1.0, "ratio": None, "regressed": True}
+        rep["new_reasons"].append(ent)
+        rep["regressions"].append(ent)
+    for reason in sorted(cur_d - prev_d):
+        ent = {"name": f"degrade:{reason}", "prev": 0.0, "cur": 1.0,
+               "ratio": None, "regressed": True}
+        rep["new_reasons"].append(ent)
+        rep["regressions"].append(ent)
+
+    pt, ct = prev.get("timings", {}) or {}, cur.get("timings", {}) or {}
+    # only run-time duration families diff as timings: compile.* is
+    # build time (swings on compilation-cache hits, not run perf) and
+    # observe() families that aren't seconds (batch.split_gain_mean)
+    # have no slower/faster meaning
+    _TIMED = ("section.", "megastep.", "collective.", "serve.")
+    for name in sorted(set(pt) & set(ct)):
+        if not name.startswith(_TIMED):
+            continue
+        try:
+            p = float(pt[name]["total"]) / max(1, int(pt[name]["count"]))
+            c = float(ct[name]["total"]) / max(1, int(ct[name]["count"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+        if max(p, c) < 0.005 or p <= 0:
+            continue
+        ratio = c / p
+        ent = {"name": name, "prev": round(p, 6), "cur": round(c, 6),
+               "ratio": round(ratio, 4),
+               "regressed": ratio > 1.0 + threshold}
+        rep["timings"].append(ent)
+        if ent["regressed"]:
+            rep["regressions"].append(ent)
+    return rep

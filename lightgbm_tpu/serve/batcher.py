@@ -131,6 +131,12 @@ class MicroBatcher:
         self.shed_watermark_rows: Optional[int] = None
         # post-batch hook (the admission controller's step); best-effort
         self.on_batch_done: Optional[Callable[[], None]] = None
+        # post-batch cost-ledger flush (obs/cost.py): the service wires
+        # this to the resident engines so a fresh bucket signature's
+        # deferred HLO analysis runs on the worker AFTER the batch's
+        # futures resolved — signature plumbing that keeps the request
+        # latency path analysis-free
+        self.cost_flush: Optional[Callable[[], None]] = None
         self._q: collections.deque = collections.deque()
         self._q_rows = 0
         self._cv = threading.Condition()
@@ -491,6 +497,7 @@ class MicroBatcher:
                 memory_watermarks(self.tel, where="serve")
 
         self._record(_batch_telemetry)
+        self._record(lambda: self.cost_flush and self.cost_flush())
         # adaptive admission: evaluate AFTER the batch's latency samples
         # landed in the dist ring (time-gated inside the controller)
         self._record(lambda: self.on_batch_done and self.on_batch_done())

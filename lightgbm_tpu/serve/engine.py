@@ -56,7 +56,8 @@ class ServingEngine:
                  telemetry=None, max_batch_rows: int = 8192,
                  min_bucket_rows: int = 64,
                  start_iteration: int = 0,
-                 num_iteration: Optional[int] = None):
+                 num_iteration: Optional[int] = None,
+                 cost_ledger: str = "hlo"):
         self.booster = booster
         self.model_id = model_id
         self.tel = telemetry
@@ -88,6 +89,15 @@ class ServingEngine:
         self.compiles = 0
         self.host_rows = 0
         self._lock = threading.Lock()
+        # device-time cost ledger (obs/cost.py): fresh bucket signatures
+        # queue a cost analysis at dispatch; the batcher's post-batch
+        # hook (flush_cost) runs them OFF the request latency path,
+        # warmup flushes inline (cold path anyway).  Mode follows the
+        # cost_ledger config key like training's ledger does.
+        self._cost = None
+        if telemetry is not None and cost_ledger != "off":
+            from ..obs.cost import CostLedger
+            self._cost = CostLedger(telemetry, cost_ledger)
 
         ts = getattr(booster, "train_set", None)
         if ts is not None and getattr(ts, "_inner", None) is not None:
@@ -179,6 +189,9 @@ class ServingEngine:
             jax.block_until_ready(self._dispatch(enc, b))
             warmed.append(b)
         n = self.compiles - compiles_before
+        # warmup is the cold path: run the queued cost analyses inline
+        # so steady-state traffic starts with the ledger settled
+        self.flush_cost()
         # warmup activity is accounted separately so steady-state rates
         # (dispatches_per_request, compiles_per_1k_requests) can be
         # computed off the lifetime counters without warmup skew
@@ -236,11 +249,23 @@ class ServingEngine:
                             signature=sig_hash,
                             compile_ms=round(compile_ms, 3),
                             operand_bytes=op_bytes)
+                sig_str = (f"serve[{self.pred.variant},bucket={bucket},"
+                           f"sig={sig_hash}]")
                 if self.tel is not None:
                     self.tel.compile_executable(
-                        f"serve[{self.pred.variant},bucket={bucket},"
-                        f"sig={sig_hash}]", compile_ms, op_bytes,
+                        sig_str, compile_ms, op_bytes,
                         model_id=self.model_id)
+                if self._cost is not None:
+                    # avals only (shape/dtype) — the np buffer itself
+                    # never reaches the ledger, donation-safe
+                    self._cost.note(
+                        stacked_run_fn(self.pred.variant),
+                        (enc,) + tuple(self._operands),
+                        sig_str, kind="serve_bucket", scale=bucket,
+                        kwargs={"k": self.k,
+                                "max_steps": self.pred.max_steps},
+                        operand_bytes=op_bytes,
+                        model_id=self.model_id, bucket=bucket)
         with self._lock:
             self.dispatches += 1
         self._inc("serve.dispatches")
@@ -306,6 +331,22 @@ class ServingEngine:
         return finalize_raw_predictions(raw, self.k, b.objective,
                                         b.average_output,
                                         self.num_iteration, raw_score)
+
+    # ------------------------------------------------------------------
+    def flush_cost(self) -> None:
+        """Run queued cost analyses and refresh the ``cost.serve.*``
+        per-row gauges.  Called from warmup and from the batcher's
+        post-batch hook — never from inside a request's dispatch."""
+        cost = self._cost
+        if cost is None or not cost.has_pending:
+            return
+        cost.flush()
+        ent = cost.entry("serve_bucket")
+        if ent is not None and self.tel is not None and ent["scale"] > 0:
+            self.tel.gauge("cost.serve.flops_per_row",
+                           ent["flops"] / ent["scale"])
+            self.tel.gauge("cost.serve.hlo_bytes_per_row",
+                           ent["hlo_bytes"] / ent["scale"])
 
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:

@@ -171,6 +171,13 @@ class ResidencyManager:
         with self._lock:
             return list(self._engines)
 
+    def resident_engines(self) -> List["ServingEngine"]:
+        """Snapshot of the live engine objects (no LRU touch, no
+        rebuild) — the batcher's post-batch cost-flush hook iterates
+        this off the request latency path."""
+        with self._lock:
+            return list(self._engines.values())
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {

@@ -108,7 +108,8 @@ class PredictionService:
                  max_queue_requests: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
                  target_p99_ms: Optional[float] = None,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 cost_ledger: Optional[str] = None):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -129,6 +130,8 @@ class PredictionService:
             default_deadline_ms = param_default("serve_default_deadline_ms")
         if target_p99_ms is None:
             target_p99_ms = param_default("serve_target_p99_ms")
+        if cost_ledger is None:
+            cost_ledger = param_default("cost_ledger")
         self.retry_policy = retry_policy
 
         self.raw_score = bool(raw_score)
@@ -153,13 +156,15 @@ class PredictionService:
             from ..obs.export import MetricsExporter
             self._metrics = MetricsExporter(
                 self.tel, int(metrics_port) + self.tel.rank,
-                ready_check=self._readiness)
+                ready_check=self._readiness,
+                report_fn=self.run_report)
             self._metrics.start()
         self.residency = ResidencyManager(
             budget_bytes=device_budget_bytes, telemetry=self.tel,
             max_batch_rows=max_batch_rows,
             min_bucket_rows=min_bucket_rows,
-            num_iteration=num_iteration)
+            num_iteration=num_iteration,
+            cost_ledger=str(cost_ledger or "hlo"))
         for mid, spec in specs.items():
             self.residency.register(str(mid), _as_booster(spec))
         self.batcher = MicroBatcher(
@@ -170,6 +175,10 @@ class PredictionService:
             max_queue_rows=int(max_queue_rows or 0),
             max_queue_requests=int(max_queue_requests or 0),
             default_deadline_ms=float(default_deadline_ms or 0.0))
+        # post-batch cost-ledger flush: fresh bucket signatures'
+        # deferred HLO analyses run on the worker thread after the
+        # batch's futures resolved (obs/cost.py; engine.flush_cost)
+        self.batcher.cost_flush = self._flush_cost
         # adaptive admission: armed only by a nonzero p99 target; runs
         # on the worker thread via the post-batch hook
         self.admission: Optional[AdmissionController] = None
@@ -452,6 +461,25 @@ class PredictionService:
                 max(0, out["compiles"] - out["warmup_compiles"])
                 * 1000.0 / requests, 6)
         return out
+
+    def _flush_cost(self) -> None:
+        """Batcher post-batch hook: run every resident engine's queued
+        cost analyses (obs/cost.py) off the request latency path.  Must
+        never raise into the worker."""
+        try:
+            for eng in self.residency.resident_engines():
+                eng.flush_cost()
+        except Exception:
+            pass
+
+    def run_report(self) -> Dict[str, Any]:
+        """Consolidated run report over the serving registry — the
+        exporter's ``GET /report`` source, same schema as training's
+        ``run_report_out`` artifact with the serving stats attached."""
+        from ..obs import report as report_mod
+        return report_mod.build_report(
+            self.tel.snapshot(), run_id=self.tel.run_id,
+            rank=self.tel.rank, extra={"serve": self.stats()})
 
     # ------------------------------------------------------------------
     def close(self, drain: bool = True,

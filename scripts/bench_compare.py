@@ -172,6 +172,16 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   three histogram-plane cuts (quantized gradients, gain
     #   screening, adaptive bins) riding the megastep — must EQUAL
     #   dispatches_per_iter; drift means a cut started evicting it;
+    # - ctl_dispatches_per_iter (bench.py --micro control-plane leg):
+    #   training with the metrics exporter up and a LIVE
+    #   POST /profile?iters=N captured mid-run — the on-demand
+    #   profiling window opens/closes at drain boundaries, so this
+    #   must EQUAL dispatches_per_iter exactly (profiling is
+    #   dispatch-neutral); drift means the control plane started
+    #   paying device round trips;
+    # - ctl_profile_windows: closed on-demand windows in that leg —
+    #   exactly 1; 0 means the endpoint stopped firing (the
+    #   neutrality equality would then pass vacuously);
     # - hist_bytes_per_iter / hist_bytes_per_iter_f32: the analytic
     #   byte model of the histogram plane under the cut / baseline
     #   layouts (pure layout arithmetic — zero wall-clock noise); an
@@ -186,6 +196,7 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
                  "ingest_dispatches_per_iter", "ingest_chunks",
                  "ingest_max_live_chunks", "ingest_model_mismatch",
                  "mp_dispatches_per_iter",
+                 "ctl_dispatches_per_iter", "ctl_profile_windows",
                  "hist_dispatches_per_iter", "hist_bytes_per_iter",
                  "hist_bytes_per_iter_f32", "hist_quant_bits",
                  "screening_active_features",

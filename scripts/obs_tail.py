@@ -12,8 +12,12 @@ shared reader:
     python scripts/obs_tail.py run.jsonl --event anomaly,straggler
     python scripts/obs_tail.py run.jsonl.rank1 --rank 1 --last 20
 
-    # per-event counts, iteration span, findings
+    # per-event counts, iteration span, findings (plus cost:/hist:
+    # lines when the run emitted cost_ledger records)
     python scripts/obs_tail.py run.jsonl --summary
+
+    # render a consolidated run report (run_report_out / GET /report)
+    python scripts/obs_tail.py --report run_report.json
 
     # live: keep printing as the training run appends
     python scripts/obs_tail.py run.jsonl --follow
@@ -97,12 +101,17 @@ def format_record(rec: Dict[str, Any], t0: Optional[float] = None) -> str:
     return "  ".join(parts)
 
 
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
 def summarize(records: List[Dict[str, Any]]) -> str:
     by_event: Dict[str, int] = {}
     ranks = set()
     iters: List[int] = []
     findings: List[Dict[str, Any]] = []
     ingest: List[Dict[str, Any]] = []
+    cost: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -115,9 +124,34 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             findings.append(r)
         if r.get("event") == "ingest":
             ingest.append(r)
+        if r.get("event") == "cost_ledger":
+            cost.append(r)
     lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
     if iters:
         lines.append(f"iterations: {min(iters)}..{max(iters)}")
+    if cost:
+        # one line each for the device-time ledger and the analytic
+        # histogram plane it is checked against (obs/cost.py): means
+        # over the drained batches, last achieved fraction
+        flops = _mean([float(r.get("flops_per_iter", 0)) for r in cost])
+        hbytes = _mean([float(r.get("hlo_bytes_per_iter", 0))
+                        for r in cost])
+        fracs = [float(r["achieved_fraction"]) for r in cost
+                 if isinstance(r.get("achieved_fraction"), (int, float))]
+        secs = [float(r["sec_per_iter"]) for r in cost
+                if isinstance(r.get("sec_per_iter"), (int, float))]
+        lines.append(
+            f"cost: {len(cost)} ledger record(s)  "
+            f"flops/iter={flops:.3e}  hlo_bytes/iter={hbytes:.3e}"
+            + (f"  sec/iter={_mean(secs):.4g}" if secs else ""))
+        hist_b = [float(r["hist_bytes_per_iter"]) for r in cost
+                  if isinstance(r.get("hist_bytes_per_iter"),
+                                (int, float))]
+        if hist_b:
+            lines.append(
+                f"hist: analytic bytes/iter={_mean(hist_b):.3e}"
+                + (f"  achieved_fraction={fracs[-1]:.4g} of HLO bytes"
+                   if fracs else ""))
     if ingest:
         # one line per ingest (streamed/cached dataset build): source,
         # chunk arithmetic, the bounded-residency watermark, cache hit
@@ -167,10 +201,24 @@ def follow(path: str, events: Optional[List[str]],
                     print(format_record(rec, t0), flush=True)
 
 
+def render_report(path: str) -> str:
+    """Render a consolidated run report (obs/report.py markdown view)
+    — the ``--report`` mode."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.obs.report import load_report, render_markdown
+    return render_markdown(load_report(path))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry JSONL file (or bench "
-                                 "trajectory with --dedup-runs)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="telemetry JSONL file (or bench "
+                         "trajectory with --dedup-runs)")
+    ap.add_argument("--report", default=None, metavar="RUN_REPORT_JSON",
+                    help="render a consolidated run_report.json "
+                         "(run_report_out / GET /report) instead of "
+                         "tailing a JSONL stream")
     ap.add_argument("--event", default="",
                     help="comma-separated event names to keep")
     ap.add_argument("--rank", type=int, default=None,
@@ -187,6 +235,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit raw JSON lines instead of human format")
     args = ap.parse_args(argv)
+
+    if args.report:
+        print(render_report(args.report), end="")
+        return 0
+    if not args.path:
+        ap.error("a JSONL path is required unless --report is given")
 
     events = [e for e in args.event.split(",") if e] or None
     if args.follow:

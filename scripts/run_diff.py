@@ -1,0 +1,75 @@
+"""Diff two consolidated run reports and flag regressions.
+
+The training/serving drivers emit one schema-versioned
+``run_report.json`` per run (``run_report_out=<path>`` at finalize, or
+live from ``GET /report`` on the metrics exporter).  This tool is the
+A/B half of that artifact: compare a candidate run against a baseline
+with the deterministic-counter strictness ``scripts/bench_compare.py``
+established — counters that carry no wall-clock noise (dispatches per
+iteration, cost-ledger flops/bytes per iteration, the analytic-model
+fraction) get a tight threshold, zero-to-nonzero always flags, a NEW
+``megastep_evicted`` / ``degrade`` reason always flags, and wall
+timings diff per-call under the loose timing threshold.
+
+Usage:
+    python scripts/run_diff.py baseline.json candidate.json \
+        [--threshold 0.15] [--det-threshold 0.05] [--fail-on-regress]
+
+Exit codes: 0 clean (identical runs compare clean by construction),
+1 regressions flagged under ``--fail-on-regress``, 2 the reports are
+not comparable (schema mismatch / unreadable).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="baseline run_report.json")
+    ap.add_argument("candidate", help="candidate run_report.json")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional per-call slowdown that counts as "
+                         "a timing regression (0.15 = 15%%)")
+    ap.add_argument("--det-threshold", type=float, default=0.05,
+                    help="tight threshold for the deterministic "
+                         "counters (no wall-clock noise)")
+    ap.add_argument("--fail-on-regress", action="store_true",
+                    help="exit 1 when a regression is flagged")
+    args = ap.parse_args(argv)
+
+    from lightgbm_tpu.obs.report import compare_reports, load_report
+    try:
+        prev = load_report(args.baseline)
+        cur = load_report(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"status": "unreadable", "error": str(e)}))
+        return 2
+
+    rep = compare_reports(prev, cur, threshold=args.threshold,
+                          det_threshold=args.det_threshold)
+    print(json.dumps(rep))
+    if rep["status"] != "ok":
+        print(f"run_diff: not comparable ({rep['status']})",
+              file=sys.stderr)
+        return 2
+    for ent in rep["regressions"]:
+        pct = "from-zero/new" if ent.get("ratio") is None \
+            else f"ratio {ent['ratio']}"
+        print(f"REGRESSION {ent['name']}: {ent['prev']} -> "
+              f"{ent['cur']} ({pct})", file=sys.stderr)
+    if rep["regressions"] and args.fail_on_regress:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,567 @@
+"""Live control plane (ISSUE 15): on-demand profiling, the device-time
+cost ledger, and comparable run reports.
+
+Tier-1 coverage of the exporter's control endpoints (/snapshot during
+live training matching the registry, the /profile round trip with
+overlap refusal and dispatch neutrality, /report), the cost ledger's
+self-consistency against the compile_executable records and the hist.*
+analytic plane model, the run_report.json schema + scripts/run_diff.py
+on identical and doctored reports, the /metrics TTL cache under
+scrape-storm concurrency, and the bytes_reserved/fragmentation memory
+satellites.  The two-process rank-0 report aggregation runs in the
+weekly slow pass.
+"""
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import jaxmon
+from lightgbm_tpu.obs.export import MetricsExporter, post, scrape
+from lightgbm_tpu.obs.registry import Telemetry
+
+
+def _data(n=600, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    return X, y
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _load_script(name):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_FUSED = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "learning_rate": 0.2, "min_data_in_leaf": 5, "verbose": -1,
+          "metric": "None", "tpu_engine": "fused", "tpu_megastep": True}
+
+
+def _ds(X, y):
+    return lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+
+
+# ------------------------------------------------------------ /snapshot
+def test_snapshot_during_live_training_matches_registry(tmp_path):
+    port = _free_port()
+    X, y = _data()
+    mid = {}
+
+    def snap_cb(env):
+        if env.iteration == 2 and not mid:
+            _, body = scrape(f"http://127.0.0.1:{port}/snapshot")
+            mid["snap"] = json.loads(body)
+
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "metrics_port": port,
+                     "telemetry_out": str(tmp_path / "t.jsonl")},
+                    _ds(X, y), num_boost_round=5, callbacks=[snap_cb])
+    try:
+        tel = bst._gbdt.telemetry
+        # mid-run: the live /snapshot answered with the deep registry
+        # view (events + findings, which /metrics never carries)
+        assert mid, "callback never hit /snapshot"
+        assert "events" in mid["snap"] and "counters" in mid["snap"]
+        assert mid["snap"]["run_id"] == tel.run_id
+        assert 0 < mid["snap"]["counters"]["iterations"] \
+            <= tel.snapshot()["counters"]["iterations"]
+        # settled: /snapshot is the registry snapshot, verbatim
+        _, body = scrape(f"http://127.0.0.1:{port}/snapshot")
+        live = json.loads(body)
+        ref = tel.snapshot()
+        assert live["counters"] == ref["counters"]
+        assert live["gauges"] == ref["gauges"]
+        assert [e["event"] for e in live["events"]] == \
+            [e["event"] for e in ref["events"]]
+        # the profile handoff state rides along
+        assert live["profile"] == {"armed": None, "open": False}
+    finally:
+        bst._gbdt._metrics.stop()
+
+
+# ------------------------------------------------------------- /profile
+def test_profile_round_trip_refusal_and_dispatch_neutrality(tmp_path):
+    """POST /profile arms; a second POST refuses with 409; the window
+    opens at an iteration edge (the sync-driver leg of the contract),
+    closes after >= iters iterations, produces a non-empty trace
+    directory, and the dispatch count matches the sync driver's usual
+    per-iteration schedule (profiling adds none).  The megastep
+    drain-boundary leg is covered below on the fused engine."""
+    X, y = _data()
+    prof_dir = tmp_path / "prof"
+    port = _free_port()
+    # the XLA sync driver: cheap off-TPU, and exactly the "iteration
+    # edge" arm of the window contract
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "metric": "None", "tpu_engine": "xla",
+              "metrics_port": port,
+              "telemetry_out": str(tmp_path / "t.jsonl")}
+    bst = lgb.Booster(params=params, train_set=_ds(X, y))
+    url = f"http://127.0.0.1:{port}"
+    code, body = post(f"{url}/profile?iters=2&dir={prof_dir}")
+    assert code == 200 and body["armed"] is True
+    code2, body2 = post(f"{url}/profile?iters=9")
+    assert code2 == 409 and body2["armed"] is False
+    assert "already armed" in body2["reason"]
+    disp_per_iter = None
+    for i in range(4):
+        bst.update()
+        if i == 0:
+            disp_per_iter = bst._gbdt.telemetry.snapshot()[
+                "counters"]["train.dispatches"]
+    bst._gbdt.drain_pending()
+    snap = bst._gbdt.telemetry.snapshot()
+    bst._gbdt._metrics.stop()
+
+    states = [e["state"] for e in snap["events"]
+              if e["event"] == "profile_window"]
+    assert states == ["armed", "refused", "open", "closed"]
+    closed = [e for e in snap["events"]
+              if e["event"] == "profile_window"
+              and e["state"] == "closed"]
+    assert closed[0]["covered"] >= 2
+    files = [os.path.join(r, f)
+             for r, _, fs in os.walk(prof_dir) for f in fs]
+    assert files, "on-demand profiler window produced no trace"
+    # dispatch neutrality: iterations 2-4 ran under/after the window
+    # and paid exactly the same per-iteration dispatch schedule as
+    # iteration 1
+    assert snap["counters"]["train.dispatches"] == 4 * disp_per_iter
+
+
+def test_profile_fires_at_megastep_drain_boundary(tmp_path):
+    """Against an engine-armed megastep run the window opens and closes
+    at drain boundaries (chunk-multiple iterations), and the dispatch
+    schedule is unchanged: one dispatch per fused chunk, exactly."""
+    X, y = _data()
+    prof_dir = tmp_path / "prof_ms"
+    chunk = 3
+    port = _free_port()
+    stop = threading.Event()
+
+    def _arm():
+        url = (f"http://127.0.0.1:{port}/profile?iters=1"
+               f"&dir={prof_dir}")
+        while not stop.is_set():
+            try:
+                code, _ = post(url, timeout=2)
+                if code == 200:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    th = threading.Thread(target=_arm, daemon=True)
+    th.start()
+    bst = lgb.train(
+        dict(_FUSED, metrics_port=port, tpu_megastep_iters=chunk,
+             telemetry_out=str(tmp_path / "ms.jsonl")),
+        _ds(X, y), num_boost_round=2 * chunk)
+    stop.set()
+    th.join(timeout=5)
+    snap = bst._gbdt.telemetry.snapshot()
+    bst._gbdt._metrics.stop()
+
+    closed = [e for e in snap["events"]
+              if e["event"] == "profile_window"
+              and e["state"] in ("closed", "closed_at_finalize")]
+    assert closed, ("no profile window closed: "
+                    + str([e for e in snap["events"]
+                           if e["event"] == "profile_window"]))
+    # boundary alignment: open/close iterations are chunk multiples
+    opened = [e for e in snap["events"]
+              if e["event"] == "profile_window"
+              and e["state"] == "open"]
+    assert opened and opened[0]["iter"] % chunk == 0
+    assert closed[0]["iter"] % chunk == 0
+    files = [os.path.join(r, f)
+             for r, _, fs in os.walk(prof_dir) for f in fs]
+    assert files
+    # dispatch neutrality, absolutely: one dispatch per fused chunk —
+    # the armed/open/closed window added none
+    assert snap["counters"]["train.dispatches"] == 2
+
+
+def test_profile_refuses_while_config_window_pending(tmp_path):
+    """A profile_dir config window owns the profiler: POST /profile
+    answers 409 until it completes."""
+    port = _free_port()
+    X, y = _data(n=400)
+    params = dict(_FUSED, metrics_port=port,
+                  profile_dir=str(tmp_path / "cfg_prof"),
+                  profile_start_iteration=0, profile_num_iterations=2)
+    ds = _ds(X, y)
+    bst = lgb.Booster(params=params, train_set=ds)
+    try:
+        code, body = post(f"http://127.0.0.1:{port}/profile?iters=1")
+        assert code == 409
+        assert "profile_dir" in body["reason"]
+    finally:
+        bst._gbdt._metrics.stop()
+
+
+# ---------------------------------------------------------- cost ledger
+def test_cost_ledger_gauges_and_compile_executable_consistency(tmp_path):
+    X, y = _data()
+    bst = lgb.train(dict(_FUSED,
+                         telemetry_out=str(tmp_path / "c.jsonl")),
+                    _ds(X, y), num_boost_round=4)
+    snap = bst._gbdt.telemetry.snapshot()
+    g = snap["gauges"]
+    assert g.get("cost.flops_per_iter", 0) > 0
+    assert g.get("cost.hlo_bytes_per_iter", 0) > 0
+    # achieved_fraction is the hist analytic model over the HLO bytes
+    assert 0 < g.get("cost.achieved_fraction", 0) <= 1.0
+    assert abs(g["cost.achieved_fraction"]
+               - g["hist.bytes_per_iter"] / g["cost.hlo_bytes_per_iter"]) \
+        < 1e-9
+    evs = snap["events"]
+    compiles = {e["signature"]: e for e in evs
+                if e["event"] == "compile_executable"}
+    costs = {e["signature"]: e for e in evs
+             if e["event"] == "cost_executable"}
+    assert costs, "no cost_executable records"
+    for sig, ce in costs.items():
+        # the ledger joins the compile record by signature, and both
+        # quote the SAME operand-byte estimate
+        assert sig in compiles, (sig, sorted(compiles))
+        assert ce["operand_bytes"] == compiles[sig]["operand_bytes"]
+        assert ce["flops"] > 0 and ce["hlo_bytes"] > 0
+    ledgers = [e for e in evs if e["event"] == "cost_ledger"]
+    assert ledgers, "no cost_ledger record at the drain"
+    led = ledgers[-1]
+    ent = costs[led["signature"]]
+    assert led["flops_per_iter"] == ent["flops"] / ent["scale"]
+    assert led["hlo_bytes_per_iter"] == ent["hlo_bytes"] / ent["scale"]
+    assert led["kind"] in ("megastep", "fast_step")
+
+
+def test_cost_ledger_compiled_mode_and_off(tmp_path):
+    X, y = _data()
+    bst = lgb.train(dict(_FUSED, cost_ledger="compiled",
+                         telemetry_out=str(tmp_path / "cc.jsonl")),
+                    _ds(X, y), num_boost_round=4)
+    snap = bst._gbdt.telemetry.snapshot()
+    assert snap["gauges"].get("cost.flops_per_iter", 0) > 0
+    ce = [e for e in snap["events"] if e["event"] == "cost_executable"]
+    assert ce and ce[0]["mode"] == "compiled"
+
+    bst2 = lgb.train(dict(_FUSED, cost_ledger="off",
+                          telemetry_out=str(tmp_path / "co.jsonl")),
+                     _ds(X, y), num_boost_round=4)
+    snap2 = bst2._gbdt.telemetry.snapshot()
+    assert "cost.flops_per_iter" not in snap2["gauges"]
+    assert not [e for e in snap2["events"]
+                if e["event"].startswith("cost")]
+
+
+def test_serve_cost_gauges(tmp_path):
+    from lightgbm_tpu.serve import PredictionService
+    X, y = _data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "max_bin": 63}, _ds(X, y),
+                    num_boost_round=5)
+    svc = PredictionService({"m": bst}, max_batch_rows=128,
+                            min_bucket_rows=16, batch_events=False)
+    try:
+        svc.warmup()
+        svc.predict("m", X[:40])
+        snap = svc.tel.snapshot()
+        assert snap["gauges"].get("cost.serve.flops_per_row", 0) > 0
+        assert snap["gauges"].get("cost.serve.hlo_bytes_per_row", 0) > 0
+        sigs = {e["signature"] for e in snap["events"]
+                if e["event"] == "cost_executable"}
+        warmed = {e["signature"] for e in snap["events"]
+                  if e["event"] == "compile_executable"}
+        assert sigs == warmed and sigs
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------- run report
+def _train_with_report(tmp_path, name, rounds=4, **extra):
+    X, y = _data()
+    out = tmp_path / name
+    lgb.train(dict(_FUSED, run_report_out=str(out),
+                   telemetry_out=str(tmp_path / (name + ".jsonl")),
+                   **extra),
+              _ds(X, y), num_boost_round=rounds)
+    return out
+
+
+def test_run_report_schema_and_run_diff(tmp_path):
+    from lightgbm_tpu.obs.report import SCHEMA, load_report
+    a = _train_with_report(tmp_path, "a.json")
+    b = _train_with_report(tmp_path, "b.json")
+    rep = load_report(str(a))
+    assert rep["schema"] == SCHEMA
+    assert rep["derived"]["dispatches_per_iter"] > 0
+    assert rep["derived"]["iterations"] == 4
+    assert rep["cost"]["flops_per_iter"] > 0
+    assert rep["reasons"]["megastep_evicted"] == []
+    assert os.path.exists(str(a) + ".md")
+    md = open(str(a) + ".md").read()
+    assert "Cost ledger" in md and "dispatches/iter" in md
+
+    run_diff = _load_script("run_diff")
+    # identical runs (same params, same data, same seed): exit 0
+    assert run_diff.main([str(a), str(b), "--fail-on-regress"]) == 0
+
+    # doctored regression #1: dispatches/iter grew (fast-path eviction)
+    bad = json.loads(open(b).read())
+    bad["derived"]["dispatches_per_iter"] *= 4
+    (tmp_path / "bad1.json").write_text(json.dumps(bad))
+    assert run_diff.main([str(a), str(tmp_path / "bad1.json"),
+                          "--fail-on-regress"]) == 1
+    # doctored regression #2: a NEW eviction reason fired
+    bad2 = json.loads(open(b).read())
+    bad2["reasons"]["megastep_evicted"] = ["callback:user_cb"]
+    (tmp_path / "bad2.json").write_text(json.dumps(bad2))
+    assert run_diff.main([str(a), str(tmp_path / "bad2.json"),
+                          "--fail-on-regress"]) == 1
+    # doctored regression #3: the candidate LOST its cost ledger (every
+    # analysis failed -> the gauges never appeared) — a silently
+    # missing deterministic counter must flag, not skip
+    bad_lost = json.loads(open(b).read())
+    bad_lost["cost"]["flops_per_iter"] = None
+    bad_lost["cost"]["hlo_bytes_per_iter"] = None
+    bad_lost["cost"]["achieved_fraction"] = None
+    (tmp_path / "bad_lost.json").write_text(json.dumps(bad_lost))
+    assert run_diff.main([str(a), str(tmp_path / "bad_lost.json"),
+                          "--fail-on-regress"]) == 1
+    # ... but a counter the BASELINE predates is informational only
+    old_base = json.loads(open(a).read())
+    old_base["cost"]["achieved_fraction"] = None
+    (tmp_path / "old_base.json").write_text(json.dumps(old_base))
+    assert run_diff.main([str(tmp_path / "old_base.json"), str(b),
+                          "--fail-on-regress"]) == 0
+    # schema mismatch is not comparable: exit 2
+    bad3 = json.loads(open(b).read())
+    bad3["schema"] = "lightgbm_tpu.run_report/999"
+    (tmp_path / "bad3.json").write_text(json.dumps(bad3))
+    assert run_diff.main([str(a), str(tmp_path / "bad3.json")]) == 2
+
+
+def test_run_report_records_evictions(tmp_path):
+    """A run that evicts off the megastep (user callback) must name the
+    reason in the report."""
+    from lightgbm_tpu.obs.report import load_report
+    X, y = _data()
+    rep_path = tmp_path / "ev.json"
+    lgb.train(dict(_FUSED, run_report_out=str(rep_path)),
+              _ds(X, y), num_boost_round=3,
+              callbacks=[lambda env: None])
+    rep = load_report(str(rep_path))
+    assert rep["reasons"]["megastep_evicted"], rep["reasons"]
+
+
+def test_report_endpoint_matches_artifact(tmp_path):
+    port = _free_port()
+    X, y = _data()
+    rep_path = tmp_path / "live.json"
+    bst = lgb.train(dict(_FUSED, metrics_port=port,
+                         run_report_out=str(rep_path)),
+                    _ds(X, y), num_boost_round=4)
+    try:
+        _, body = scrape(f"http://127.0.0.1:{port}/report")
+        live = json.loads(body)
+        disk = json.loads(open(rep_path).read())
+        assert live["schema"] == disk["schema"]
+        assert live["derived"] == disk["derived"]
+        assert live["cost"]["flops_per_iter"] == \
+            disk["cost"]["flops_per_iter"]
+    finally:
+        bst._gbdt._metrics.stop()
+
+
+def test_obs_tail_summary_and_report_mode(tmp_path, capsys):
+    _train_with_report(tmp_path, "ot.json")
+    obs_tail = _load_script("obs_tail")
+    assert obs_tail.main([str(tmp_path / "ot.json.jsonl"),
+                          "--summary"]) == 0
+    out = capsys.readouterr().out
+    assert "cost:" in out and "flops/iter=" in out
+    assert "hist:" in out and "achieved_fraction=" in out
+    assert obs_tail.main(["--report", str(tmp_path / "ot.json")]) == 0
+    out = capsys.readouterr().out
+    assert "# Run report" in out and "Cost ledger" in out
+
+
+# ------------------------------------------------------ scrape TTL cache
+def test_metrics_ttl_cache_under_scrape_storm():
+    tel = Telemetry(enabled=True)
+    tel.inc("x", 5)
+    exp = MetricsExporter(tel, 0, cache_ttl=0.5)
+    try:
+        port = exp.start()
+        assert port > 0
+        url = f"http://127.0.0.1:{port}/metrics"
+        errors = []
+        bodies = []
+
+        def storm():
+            try:
+                for _ in range(40):
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        bodies.append(r.read().decode())
+            except Exception as e:      # pragma: no cover
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=storm) for _ in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # mutate the registry while the storm runs — the cache bounds
+        # how often the renderer touches the registry lock
+        while any(t.is_alive() for t in threads):
+            tel.inc("x")
+            time.sleep(0.001)
+        for t in threads:
+            t.join(timeout=30)
+        wall = time.perf_counter() - t0
+        assert not errors, errors
+        assert len(bodies) == 8 * 40
+        # the storm was served mostly from cache: distinct bodies are
+        # bounded by elapsed ttl windows, not by request count
+        distinct = len(set(bodies))
+        assert distinct <= int(wall / 0.5) + 2, (distinct, wall)
+        assert exp.cache_hits > 0
+        # after the TTL expires a scrape sees fresh values again
+        time.sleep(0.6)
+        tel.inc("x", 1000)
+        time.sleep(0.6)
+        with urllib.request.urlopen(url, timeout=10) as r:
+            fresh = r.read().decode()
+        line = next(l for l in fresh.splitlines()
+                    if l.startswith("lgbm_x_total"))
+        assert float(line.rsplit(" ", 1)[1]) == \
+            tel.snapshot()["counters"]["x"]
+    finally:
+        exp.stop()
+
+
+# ----------------------------------------------- memory stat satellites
+def test_memory_watermarks_reserved_and_fragmentation(monkeypatch):
+    tel = Telemetry(enabled=True)
+    fake = {0: {"bytes_in_use": 400, "peak_bytes_in_use": 500,
+                "bytes_limit": 1000, "bytes_reserved": 600,
+                "peak_bytes_reserved": 700,
+                "largest_free_block_bytes": 150}}
+    monkeypatch.setattr(jaxmon, "device_memory_stats", lambda: fake)
+    stats = jaxmon.memory_watermarks(tel, where="test")
+    g = tel.snapshot()["gauges"]
+    assert g["mem.d0.bytes_reserved"] == 600
+    assert g["mem.d0.peak_bytes_reserved"] == 700
+    # free pool = reserved 600 - in_use 400 = 200 (NOT limit - in_use:
+    # the largest-free-block stat describes the reserved pool); largest
+    # block 150 -> 25% of the pool's free space is shattered
+    assert abs(g["mem.d0.fragmentation"] - 0.25) < 1e-9
+    assert stats[0]["fragmentation"] == g["mem.d0.fragmentation"]
+
+
+def test_memory_watermarks_gracefully_absent_without_stats():
+    # CPU backend: no allocator stats — no reserved/fragmentation
+    # gauges, no exception (the graceful-absence half of the satellite)
+    tel = Telemetry(enabled=True)
+    jaxmon.memory_watermarks(tel, where="cpu")
+    g = tel.snapshot()["gauges"]
+    assert not any("bytes_reserved" in k or "fragmentation" in k
+                   for k in g)
+
+
+def test_fragmentation_edge_cases():
+    assert jaxmon.fragmentation({}) is None
+    assert jaxmon.fragmentation(
+        {"bytes_limit": 100, "bytes_in_use": 100,
+         "largest_free_block_bytes": 0}) == 0.0
+    assert jaxmon.fragmentation(
+        {"bytes_limit": 100, "bytes_in_use": 0,
+         "largest_free_block_bytes": 100}) == 0.0
+
+
+# ------------------------------------------------- two-process rank-0
+_MP_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=sys.argv[1],
+        num_processes=int(sys.argv[2]), process_id=int(sys.argv[3]))
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    path, report_out, out_path = sys.argv[4], sys.argv[5], sys.argv[6]
+    ds = lgb.Dataset(path, params={"label_column": 0, "verbose": -1,
+                                   "max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.2, "tree_learner": "data",
+                     "verbose": -1, "run_report_out": report_out},
+                    ds, num_boost_round=4)
+    c = bst.telemetry().get("counters", {})
+    with open(out_path, "w") as fh:
+        json.dump({"rank": jax.process_index(),
+                   "iterations": int(c.get("iterations", 0))}, fh)
+""")
+
+
+@pytest.mark.slow
+def test_multiproc_rank0_report_aggregates_sections(tmp_path):
+    """Two-process run with run_report_out: rank 0 writes ONE report
+    whose ``ranks`` section carries both ranks' counters (riding the
+    finalize allgather), rank 1 writes nothing."""
+    rng = np.random.RandomState(5)
+    n, F = 2000, 6
+    X = rng.rand(n, F)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",",
+               fmt="%.6f")
+    coord = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(_MP_WORKER)
+    report_path = tmp_path / "mp_report.json"
+    outs = [tmp_path / f"rank{i}.json" for i in range(2)]
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, "2", str(i), str(train),
+         str(report_path), str(outs[i])],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err.decode()[-3000:]
+    rep = json.loads(report_path.read_text())
+    assert rep["rank"] == 0 and rep["world_size"] == 2
+    ranks = rep["ranks"]
+    assert sorted(s["rank"] for s in ranks) == [0, 1]
+    for sec in ranks:
+        assert sec["counters"].get("iterations", 0) > 0
+    # exactly one artifact: rank 1 wrote nothing else into tmp
+    assert not (tmp_path / "mp_report.json.rank1").exists()

@@ -131,7 +131,11 @@ def test_exporter_scrape_during_training(tmp_path):
 
         # post-train the endpoint is still live and the exposition
         # carries EVERY registry counter, gauge, timing and dist with
-        # the rank/run_id labels
+        # the rank/run_id labels (TTL cache off: the mid-train scrape
+        # above may still be inside the ~1 s cache window, and this
+        # assertion needs the LIVE body — the cache itself is covered
+        # by test_control_plane.py)
+        bst._gbdt._metrics.cache_ttl = 0.0
         _, body = _scrape(port)
         _parse_exposition(body)
         snap = bst.telemetry()
