@@ -740,6 +740,73 @@ def run_micro() -> None:
     shutil.rmtree(ingest_dir, ignore_errors=True)
     _emit()   # the ingest-leg counters are on stdout now
 
+    # ---- drift leg: the drift & lineage plane (obs/drift.py). The
+    # training-side profile capture is pure host numpy at dataset
+    # finalize, so `drift_dispatches_per_iter` must EQUAL
+    # dispatches_per_iter EXACTLY, with the profile + provenance
+    # blocks embedded in the artifact. The serving-side DriftMonitor
+    # accumulates on the already-encoded batch host-side, so the
+    # closed loop keeps `serve_drift_dispatches_per_request` at
+    # exactly 1.0 with zero compiles — while a deterministically
+    # shifted feed (np.clip(x + 0.35, 0, 1) vs the rand(0,1) training
+    # distribution) raises EXACTLY one hysteresis-gated drift_alert at
+    # a reproducible PSI, and the in-distribution control raises none.
+    from lightgbm_tpu.serve import PredictionService as _DriftSvc
+    tel_drift = tel_path + ".drift"
+    ds_dr = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst_dr = lgb.train(dict(params, telemetry_out=tel_drift,
+                            drift_profile=True), ds_dr,
+                       num_boost_round=n_iters)
+    drift_wall = time.perf_counter() - t0
+    _phase("micro_drift_train_ok")
+    c7 = bst_dr.telemetry().get("counters", {})
+    dr_iters = max(1, int(c7.get("iterations", n_iters)))
+    _RESULT["drift_sec_per_iter"] = round(drift_wall / dr_iters, 5)
+    _RESULT["drift_dispatches_per_iter"] = round(
+        float(c7.get("train.dispatches", 0)) / dr_iters, 4)
+    model_dr = bst_dr.model_to_string()
+    _RESULT["drift_profile_embedded"] = float(
+        "\ndata_profile:\n" in model_dr and "\nprovenance:\n" in model_dr)
+
+    def _drift_serve(shift):
+        svc = _DriftSvc({"m": bst_dr}, max_batch_rows=256,
+                        max_delay_ms=0.5, min_bucket_rows=16,
+                        batch_events=False, drift_eval_rows=128,
+                        drift_hysteresis=2)
+        svc.warmup()
+        rng_d = np.random.RandomState(17)
+        s0 = svc.stats()
+        for _ in range(20):
+            Xq = rng_d.rand(40, n_feat).astype(np.float32)
+            if shift:
+                Xq = np.clip(Xq + 0.35, 0.0, 1.0).astype(np.float32)
+            svc.predict("m", Xq, timeout=60)
+        s1 = svc.stats()
+        # close() joins the batcher worker, and post-batch drift_flush
+        # records run synchronously on it — snapshotting after close
+        # makes the final evaluation (and so psi_max) deterministic
+        svc.close()
+        snap_d = svc.tel.snapshot()
+        return {
+            "dpr": round((s1["dispatches"] - s0["dispatches"]) / 20.0, 6),
+            "cp1k": round((s1["compiles"] - s0["compiles"]) * 50.0, 6),
+            "alerts": int(snap_d.get("counters", {})
+                          .get("drift.alerts", 0)),
+            "psi_max": round(float(snap_d.get("gauges", {})
+                                   .get("drift.psi_max", 0.0)), 4)}
+
+    ctrl = _drift_serve(shift=False)
+    drifted = _drift_serve(shift=True)
+    _phase("micro_drift_serve_ok")
+    _RESULT["serve_drift_dispatches_per_request"] = drifted["dpr"]
+    _RESULT["serve_drift_compiles_per_1k"] = drifted["cp1k"]
+    _RESULT["drift_alerts"] = drifted["alerts"]
+    _RESULT["drift_psi_max"] = drifted["psi_max"]
+    _RESULT["drift_alerts_control"] = ctrl["alerts"]
+    _RESULT["drift_psi_max_control"] = ctrl["psi_max"]
+    _emit()   # the drift-plane counters are on stdout now
+
     # ---- multiproc leg: 2 REAL processes x 2 virtual CPU devices over
     # one gloo mesh, tree_learner=data on the fused engine with the
     # megastep armed — the pod-scale fast path. The deterministic gate
@@ -768,7 +835,7 @@ def run_micro() -> None:
     except Exception as e:
         print(f"multiproc leg failed: {e}", file=sys.stderr)
     for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ctl, tel_ing,
-              tel_hb, tel_hc):
+              tel_hb, tel_hc, tel_drift):
         try:
             os.remove(p)
         except OSError:

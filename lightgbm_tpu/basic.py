@@ -658,6 +658,12 @@ class Booster:
         self.feature_infos: List[str] = []
         self.monotone_constraints = None
         self.label_index = 0
+        # drift & lineage plane (obs/drift.py): the training-data
+        # profile and provenance record ride the model artifact and
+        # checkpoint payloads; None for pre-plane artifacts (serving
+        # degrades structurally — see docs/Observability.md §13)
+        self.data_profile: Optional[Dict[str, Any]] = None
+        self.provenance: Optional[Dict[str, Any]] = None
 
         if train_set is not None:
             self._init_train(train_set)
@@ -709,6 +715,47 @@ class Booster:
         self.feature_infos = inner.feature_infos()
         if inner.monotone_constraints is not None:
             self.monotone_constraints = inner.monotone_constraints
+        if bool(getattr(self.config, "drift_profile", True)):
+            self._capture_profile(train_set, inner)
+
+    def _capture_profile(self, train_set: Dataset, inner) -> None:
+        """Capture the DataProfile + provenance record at train init
+        (the packed bins and frozen mappers exist; one bincount per
+        feature, no device work).  Mirrored onto the driver so
+        checkpoint payloads and the run report carry them."""
+        try:
+            from .ingest.pipeline import dataset_params_digest
+            from .obs import drift as _drift
+            try:
+                import jax as _jax
+                world = int(_jax.process_count())
+            except Exception:
+                world = 1
+            if world > 1:
+                # multiprocess ranks hold rank-local row shards: a
+                # per-rank profile would make the rank artifacts
+                # diverge, breaking the cross-rank model-identity
+                # contract. Skip embedding — serving such a model takes
+                # the structural drift_unavailable degrade path.
+                log.debug("drift profile skipped: %d-process training "
+                          "shards rows rank-locally", world)
+                return
+            cats = [int(j) for k, j in enumerate(inner.used_features)
+                    if inner.is_categorical[k]]
+            self.data_profile = _drift.build_profile(inner)
+            # run_id is left for build_provenance to content-derive:
+            # embedding the (per-process) telemetry run_id would break
+            # byte-equality of identical trainings' model strings
+            self.provenance = _drift.build_provenance(
+                params_digest=dataset_params_digest(self.config, cats),
+                source=_drift.source_fingerprint(train_set.data,
+                                                 self.data_profile),
+                parent_checkpoint="",
+                profile=self.data_profile)
+            self._gbdt.data_profile = self.data_profile
+            self._gbdt.provenance = self.provenance
+        except Exception as exc:  # never fail training over telemetry
+            log.warning("data-profile capture failed: %s", exc)
 
     def _make_metrics(self, inner: TpuDataset) -> List:
         names = [str(m) for m in self.config.metric]
@@ -782,6 +829,22 @@ class Booster:
         """End-of-training telemetry epilogue (engine.train calls this):
         profiler stop + summary event + trace export + JSONL flush."""
         if self._gbdt is not None:
+            if self.data_profile is not None \
+                    and "score" not in self.data_profile:
+                # final train-margin distribution: the scores are being
+                # fetched to host here anyway — no extra dispatch
+                try:
+                    from .obs.drift import (add_score_distribution,
+                                            profile_digest)
+                    scores = getattr(self._gbdt, "scores", None)
+                    if scores is not None:
+                        add_score_distribution(self.data_profile,
+                                               np.asarray(scores))
+                        if self.provenance is not None:
+                            self.provenance["profile_digest"] = \
+                                profile_digest(self.data_profile)
+                except Exception as exc:
+                    log.warning("score-profile capture failed: %s", exc)
             self._gbdt.finalize_telemetry()
 
     def _dump_crash(self, exc: BaseException) -> None:
@@ -1129,6 +1192,10 @@ class Booster:
         obj_str = header.get("objective", "none")
         self._objective_str = obj_str
         self.objective = create_objective_from_string(obj_str)
+        # pre-plane artifacts have neither block -> None (serving emits
+        # one drift_unavailable event instead of monitoring)
+        self.data_profile = model_io.extract_data_profile(model_str)
+        self.provenance = model_io.extract_provenance(model_str)
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

@@ -572,3 +572,30 @@ def mappers_digest(mappers: Sequence["BinMapper"]) -> str:
         h.update(json.dumps(d, sort_keys=True, default=str).encode())
         h.update(b"\x00")
     return h.hexdigest()
+
+
+def mapper_drift_counts(mapper: "BinMapper", col) -> tuple:
+    """Diff one raw column chunk against a frozen mapper (the ingest
+    drift monitor's per-chunk primitive — obs/drift.py).
+
+    Returns ``(out_of_range, new_categories, n_finite)``: for numeric
+    mappers, how many finite values fall outside the [min_val, max_val]
+    range the bins were fit on (the out-of-range quantile mass); for
+    categorical mappers, how many values name a category absent from
+    the training vocabulary.  NaNs are missing, not drift — the
+    mapper already has a missing bin for them."""
+    v = np.asarray(col, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    n = int(v.size)
+    if n == 0 or mapper.is_trivial:
+        return 0, 0, n
+    if mapper.bin_type == BIN_CATEGORICAL:
+        if not mapper.categorical_2_bin:
+            return 0, n, n
+        known = np.array(sorted(mapper.categorical_2_bin), np.int64)
+        iv = v.astype(np.int64)
+        pos = np.clip(np.searchsorted(known, iv), 0, known.size - 1)
+        return 0, int(np.count_nonzero(known[pos] != iv)), n
+    out = int(np.count_nonzero((v < mapper.min_val)
+                               | (v > mapper.max_val)))
+    return out, 0, n

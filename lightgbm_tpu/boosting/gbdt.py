@@ -807,12 +807,19 @@ class GBDT:
             world = int(_jax.process_count())
         except Exception:
             world = 1
+        extra = None
+        prov = getattr(self, "provenance", None)
+        if prov is not None:
+            # lineage section: the training run's provenance record
+            # (run_id, source fingerprint, parent checkpoint, profile
+            # digest) — the training end of the rollover chain
+            extra = {"lineage": {"training": dict(prov)}}
         return report_mod.build_report(
             snapshot if snapshot is not None else tel.snapshot(),
             run_id=tel.run_id, rank=tel.rank, world_size=world,
             evicted=self._evicted_snapshot(),
             cost_entries=self._cost.entries() if self._cost else None,
-            ranks=rank_sections)
+            extra=extra, ranks=rank_sections)
 
     def _write_run_report(self, snap, rank_sections) -> None:
         """Write run_report.json (+ .md) at finalize.  Multi-process:

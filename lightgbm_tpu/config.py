@@ -483,6 +483,46 @@ _PARAMS: List[_Param] = [
             "telemetry is enabled; feeds cost.flops_per_iter / "
             "cost.hlo_bytes_per_iter / cost.achieved_fraction gauges "
             "and one cost_ledger record per drained batch"),
+    _p("drift_profile", bool, True, ("data_profile", "drift_monitor"),
+       desc="capture a compact DataProfile of the training distribution "
+            "at dataset finalize (per-feature bin-occupancy histograms "
+            "over the packed bins, missing rates, label/score "
+            "distribution, mappers digest, row count) and embed it — "
+            "with the model's provenance record — in the serialized "
+            "model artifact and in resilience checkpoints, so any "
+            "loaded booster carries its training distribution. Also "
+            "the master switch for the ingest mapper-drift monitor and "
+            "the serving drift monitor (both degrade structurally when "
+            "a model has no embedded profile: one drift_unavailable "
+            "event, never an exception). Default ON"),
+    _p("drift_psi_threshold", float, 0.2, ("psi_threshold",),
+       check=(">", 0.0),
+       desc="serving drift monitor: PSI level at or below which a "
+            "feature/score distribution counts as stable; evaluations "
+            "with max PSI above it arm the hysteresis counter toward a "
+            "drift_alert event (0.2 is the conventional "
+            "investigate-shift PSI rule of thumb)"),
+    _p("drift_eval_rows", int, 512, ("drift_eval_period_rows",),
+       check=(">=", 1),
+       desc="serving drift monitor: minimum accumulated request rows "
+            "between PSI evaluations — evaluation runs on the "
+            "micro-batcher's post-batch flush hook, off the request "
+            "latency path and with zero extra device dispatches"),
+    _p("drift_hysteresis", int, 2, ("drift_alert_hysteresis",),
+       check=(">=", 1),
+       desc="serving drift monitor: consecutive over-threshold "
+            "evaluations required before a drift_alert fires; the "
+            "alert then latches until an evaluation drops back under "
+            "the threshold, so one sustained distribution shift "
+            "raises exactly one alert"),
+    _p("drift_mapper_threshold", float, 0.02,
+       ("mapper_drift_threshold",), check=(">=", 0.0),
+       desc="ingest drift monitor: per-chunk fraction of values "
+            "outside the frozen mappers' training range (numeric "
+            "out-of-range mass + categorical new-category rate) at or "
+            "above which the chunk is flagged in the mapper_drift "
+            "event — the rebuild-vs-append trigger for continuous "
+            "learning"),
     # ---- Serving admission control (docs/Serving.md) ----
     _p("serve_max_queue_rows", int, 0, ("serve_queue_rows",),
        check=(">=", 0),

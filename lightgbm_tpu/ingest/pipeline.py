@@ -153,12 +153,36 @@ def ingest_text_streamed(path: str, config, label_column=None,
                              source=None)
     else:
         bins_out = np.empty((n, len(ds.used_features)), ds.bin_dtype())
+    # per-chunk mapper-drift diff against the frozen mappers (fresh or
+    # reference-borrowed): pure numpy on the chunk pass 2 already holds
+    drift_on = bool(getattr(config, "drift_profile", True))
+    drift_thresh = float(getattr(config, "drift_mapper_threshold", 0.02))
+    drift_agg: Optional[dict] = None
+    if drift_on:
+        drift_agg = {"chunks": 0, "flagged_chunks": 0, "rows": 0,
+                     "out_of_range": 0, "new_categories": 0, "values": 0,
+                     "worst_rate": 0.0, "worst_feature": -1,
+                     "threshold": drift_thresh}
     try:
         for row0, Xc, yc in iter_chunks(layout, chunk_rows, sl.start,
                                         sl.stop, start_offset=off0):
             stats.chunk_opened(len(Xc))
             Xf = _features_of(Xc, yc, row0)
             packed = ds.bin_rows(Xf)
+            if drift_agg is not None:
+                from ..obs.drift import chunk_mapper_drift
+                d = chunk_mapper_drift(ds.mappers, ds.used_features, Xf)
+                drift_agg["chunks"] += 1
+                drift_agg["rows"] += d["rows"]
+                drift_agg["out_of_range"] += d["out_of_range"]
+                drift_agg["new_categories"] += d["new_categories"]
+                drift_agg["values"] += d["values"]
+                rate = d["out_of_range_rate"] + d["new_category_rate"]
+                if rate > drift_thresh:
+                    drift_agg["flagged_chunks"] += 1
+                if d["worst_rate"] > drift_agg["worst_rate"]:
+                    drift_agg["worst_rate"] = d["worst_rate"]
+                    drift_agg["worst_feature"] = d["worst_feature"]
             if writer is not None:
                 writer.append_rows(packed)
             else:
@@ -168,6 +192,13 @@ def ingest_text_streamed(path: str, config, label_column=None,
         if writer is not None:
             writer.abort()
         raise
+    if drift_agg is not None:
+        vals = drift_agg["values"]
+        drift_agg["out_of_range_rate"] = round(
+            drift_agg["out_of_range"] / vals, 6) if vals else 0.0
+        drift_agg["new_category_rate"] = round(
+            drift_agg["new_categories"] / vals, 6) if vals else 0.0
+        stats.mapper_drift = drift_agg
 
     side = load_sidecars(str(path), sl, rank, num_machines)
     if label is not None:

@@ -43,6 +43,9 @@ class IngestStats:
         self.cache_hit = 0
         self.host_wait_ms = 0.0
         self.sample_rows = 0
+        # per-chunk mapper-drift aggregate (obs/drift.py): set by the
+        # pipeline's pass 2 when drift_profile is on
+        self.mapper_drift: Optional[Dict[str, Any]] = None
 
     def chunk_opened(self, rows: int = 0) -> None:
         self.chunks += 1
@@ -54,12 +57,15 @@ class IngestStats:
         self.live_chunks = max(0, self.live_chunks - 1)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"source": self.source, "chunks": self.chunks,
-                "rows": self.rows,
-                "max_live_chunks": self.max_live_chunks,
-                "cache_hit": self.cache_hit,
-                "host_wait_ms": round(self.host_wait_ms, 3),
-                "sample_rows": self.sample_rows}
+        out = {"source": self.source, "chunks": self.chunks,
+               "rows": self.rows,
+               "max_live_chunks": self.max_live_chunks,
+               "cache_hit": self.cache_hit,
+               "host_wait_ms": round(self.host_wait_ms, 3),
+               "sample_rows": self.sample_rows}
+        if self.mapper_drift is not None:
+            out["mapper_drift"] = dict(self.mapper_drift)
+        return out
 
 
 def publish_ingest_stats(tel, stats: Dict[str, Any]) -> None:
@@ -79,7 +85,20 @@ def publish_ingest_stats(tel, stats: Dict[str, Any]) -> None:
     if stats.get("host_wait_ms"):
         tel.inc("prefetch.host_wait_ms", float(stats["host_wait_ms"]))
     tel.event("ingest", **{k: v for k, v in stats.items()
-                           if k != "event"})
+                           if k not in ("event", "mapper_drift")})
+    md = stats.get("mapper_drift")
+    if md:
+        # ingest runs before the booster owns a registry, so the
+        # per-chunk mapper diff rides the dataset's stats and its
+        # structured event lands here — the rebuild-vs-append trigger
+        # (ROADMAP item 2, docs/Data.md)
+        tel.inc("ingest.drift_chunks", float(md.get("flagged_chunks", 0)))
+        tel.inc("ingest.out_of_range_values",
+                float(md.get("out_of_range", 0)))
+        tel.inc("ingest.new_category_values",
+                float(md.get("new_categories", 0)))
+        if md.get("flagged_chunks", 0) > 0:
+            tel.event("mapper_drift", **md)
 
 
 def stream_to_device(bins: np.ndarray, chunk_rows: int, tel=None,

@@ -209,6 +209,29 @@ def save_model_to_string(booster, start_iteration: int = 0,
                 vv = ",".join(str(x) for x in vv)
             body += f"[{kk}: {vv}]\n"
         body += "end of parameters\n"
+    # drift/lineage plane (obs/drift.py): the training DataProfile and
+    # the provenance record ride the artifact as trailing blocks AFTER
+    # "end of parameters" — the header loop stops at the first Tree=
+    # and the parameter extraction uses explicit start/end markers, so
+    # stock-LightGBM interoperability and the existing parser are both
+    # untouched. canonical_json makes the round trip byte-stable:
+    # saving a loaded model re-emits the identical block.
+    profile = getattr(booster, "data_profile", None)
+    if profile:
+        from ..obs.drift import canonical_json
+        body += "\ndata_profile:\n" + canonical_json(profile) \
+                + "\nend of data_profile\n"
+    prov = getattr(booster, "provenance", None)
+    if prov:
+        from ..obs.drift import canonical_json
+        # parent_checkpoint is RUN metadata, not model identity: a
+        # resumed run must serialize byte-identically to the straight
+        # run it resumes (the resume-identity contract), so the chained
+        # checkpoint hash stays in-memory and in checkpoint manifests
+        # but out of the artifact
+        prov = dict(prov, parent_checkpoint="")
+        body += "\nprovenance:\n" + canonical_json(prov) \
+                + "\nend of provenance\n"
     return body
 
 
@@ -264,6 +287,36 @@ def parse_model_string(model_str: str) -> Tuple[Dict[str, str],
         if end > 0:
             params = model_str[start:end]
     return header, trees, params
+
+
+def _extract_json_block(model_str: str, name: str) -> Optional[dict]:
+    """Parse one trailing ``<name>:`` ... ``end of <name>`` JSON block
+    (the drift/lineage plane's artifact channel).  Absent or corrupt
+    blocks return ``None`` — a model file without a profile must load
+    exactly as before, never raise."""
+    marker = f"\n{name}:\n"
+    if marker not in model_str:
+        return None
+    start = model_str.index(marker) + len(marker)
+    end = model_str.find(f"\nend of {name}", start)
+    if end < 0:
+        return None
+    try:
+        blob = json.loads(model_str[start:end])
+    except (json.JSONDecodeError, ValueError):
+        return None
+    return blob if isinstance(blob, dict) else None
+
+
+def extract_data_profile(model_str: str) -> Optional[dict]:
+    """The embedded training DataProfile, or ``None`` (back-compat with
+    every pre-profile artifact)."""
+    return _extract_json_block(model_str, "data_profile")
+
+
+def extract_provenance(model_str: str) -> Optional[dict]:
+    """The embedded provenance/lineage record, or ``None``."""
+    return _extract_json_block(model_str, "provenance")
 
 
 def dump_model_json(booster, start_iteration: int = 0,

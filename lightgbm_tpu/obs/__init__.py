@@ -36,13 +36,19 @@ package is the permanent, low-overhead replacement:
 - report.py — the schema-versioned consolidated run report
   (``run_report_out=<path>`` / ``GET /report``) that
   ``scripts/run_diff.py`` compares with deterministic-counter
-  strictness.
+  strictness;
+- drift.py — the drift & lineage plane: training-data profiles
+  (embedded in model artifacts + checkpoints), PSI/JS divergence, the
+  serving-side :class:`DriftMonitor` and the provenance record chained
+  through rollovers (docs/Observability.md §13).
 
 Every recording method is a no-op behind a single attribute check while
 the registry is disabled, so instrumentation stays in the hot driver
 paths permanently, like the reference's TIMETAG sections.
 """
 from .cost import CostLedger
+from .drift import (DriftMonitor, build_profile, build_provenance,
+                    canonical_json, js_divergence, profile_digest, psi)
 from .events import JsonlSink
 from .export import MetricsExporter, ProfileControl, render_openmetrics
 from .health import HealthAuditor, model_state_hash
@@ -57,4 +63,6 @@ __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
            "model_state_hash", "chrome_trace_events", "write_trace",
            "MetricsExporter", "render_openmetrics", "ProfileControl",
            "CostLedger", "build_report", "compare_reports",
-           "load_report", "render_markdown", "write_report"]
+           "load_report", "render_markdown", "write_report",
+           "DriftMonitor", "build_profile", "build_provenance",
+           "canonical_json", "js_divergence", "profile_digest", "psi"]

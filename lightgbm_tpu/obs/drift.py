@@ -1,0 +1,478 @@
+"""Drift & lineage plane: training data profiles, divergence math and
+the serving drift monitor.
+
+Three cooperating pieces (ROADMAP item 2's observability prerequisites):
+
+- **DataProfile** — a compact, JSON-canonical snapshot of the training
+  distribution captured at dataset finalize: per-feature bin-occupancy
+  histograms (one ``np.bincount`` over the packed bins the dataset
+  already holds — no re-binning), missing rates, the label
+  distribution, the frozen ``mappers_digest`` and row count, plus (for
+  numeric features) the bin upper bounds so a RAW-variant serving
+  engine can host-bin float inputs against the same edges.  The profile
+  rides the model artifact (``io/model_io.py`` appends a
+  ``data_profile:`` block after ``end of parameters``) and checkpoint
+  payloads, so any loaded booster carries its training distribution.
+  Serialization is byte-stable: :func:`canonical_json` of a profile
+  that round-trips through save/load re-emits the identical bytes.
+
+- **PSI / JS divergence** — :func:`psi` and :func:`js_divergence` with
+  epsilon smoothing, defined for every degenerate shape the monitors
+  meet in production: empty reference bins, single-bin features,
+  all-missing columns, zero-count current windows.
+
+- **DriftMonitor** — the serving-side accumulator+evaluator.  The
+  micro-batcher feeds it host-side from the ALREADY-ENCODED batch
+  (zero extra device dispatches; the 1.0 dispatches/request and
+  0-recompile serving contracts are counter-asserted in CI), and a
+  periodic evaluation computes per-feature PSI against the resident
+  model's profile with consecutive-evaluation hysteresis so one
+  sustained excursion raises exactly one ``drift_alert``.
+
+Provenance (:func:`build_provenance`) is the lineage half: source
+fingerprint, params digest, parent checkpoint hash, training ``run_id``
+and profile digest, riding the same artifact/checkpoint channels and
+chained through ``rollover()`` into ``serve_rollover`` events.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+PROFILE_SCHEMA = "lightgbm_tpu.data_profile/1"
+PROVENANCE_SCHEMA = "lightgbm_tpu.provenance/1"
+
+# smoothing mass added to every bin before normalizing: keeps the PSI
+# log terms finite when a bin is empty on either side (the standard
+# industry treatment; the exact value only matters for bins with no
+# reference mass, where any finite penalty is a modeling choice)
+PSI_EPS = 1e-4
+
+# label / score distributions use fixed-size quantile sketches
+_SCORE_BINS = 16
+
+# histograms are COARSENED to at most this many contiguous groups
+# before the PSI compare: under the null (no drift) the PSI estimate's
+# expectation is ~ (groups-1) * (1/N_ref + 1/N_cur), so comparing the
+# raw 63-255 training bins directly would read pure sampling noise as
+# drift at any practical eval window.  8 groups keeps the null
+# expectation well under the 0.2 alert threshold from a few hundred
+# rows while a real location/scale shift still moves whole groups.
+_PSI_GROUPS = 8
+
+
+def coarsen(counts, groups: int = _PSI_GROUPS) -> np.ndarray:
+    """Sum contiguous histogram bins down to at most ``groups`` —
+    the noise-control step in front of every PSI comparison."""
+    c = np.asarray(counts, np.float64).ravel()
+    if c.size <= groups:
+        return c
+    starts = np.linspace(0, c.size, groups + 1).astype(int)[:-1]
+    return np.add.reduceat(c, starts)
+
+
+# ---------------------------------------------------------------------
+# divergence math
+def _smooth_norm(counts, eps: float) -> np.ndarray:
+    c = np.asarray(counts, np.float64).ravel()
+    if c.size == 0:
+        return c
+    c = np.maximum(c, 0.0) + eps
+    return c / c.sum()
+
+
+def psi(ref_counts, cur_counts, eps: float = PSI_EPS) -> float:
+    """Population Stability Index between two count vectors.
+
+    ``sum((p_i - q_i) * ln(p_i / q_i))`` over smoothed, normalized
+    bins.  Degenerate shapes are defined, not exceptional: mismatched
+    lengths compare over the shorter prefix padded with empty bins,
+    a single-bin feature is identically 0 (both normalize to [1.0]),
+    and an empty/zero vector on either side yields a finite value via
+    the smoothing mass.
+    """
+    r = np.asarray(ref_counts, np.float64).ravel()
+    c = np.asarray(cur_counts, np.float64).ravel()
+    n = max(r.size, c.size)
+    if n == 0:
+        return 0.0
+    if r.size < n:
+        r = np.concatenate([r, np.zeros(n - r.size)])
+    if c.size < n:
+        c = np.concatenate([c, np.zeros(n - c.size)])
+    p, q = _smooth_norm(r, eps), _smooth_norm(c, eps)
+    return float(np.sum((p - q) * np.log(p / q)))
+
+
+def js_divergence(ref_counts, cur_counts, eps: float = PSI_EPS) -> float:
+    """Jensen-Shannon divergence (natural log; bounded by ln 2) with
+    the same smoothing/shape conventions as :func:`psi`."""
+    r = np.asarray(ref_counts, np.float64).ravel()
+    c = np.asarray(cur_counts, np.float64).ravel()
+    n = max(r.size, c.size)
+    if n == 0:
+        return 0.0
+    if r.size < n:
+        r = np.concatenate([r, np.zeros(n - r.size)])
+    if c.size < n:
+        c = np.concatenate([c, np.zeros(n - c.size)])
+    p, q = _smooth_norm(r, eps), _smooth_norm(c, eps)
+    m = 0.5 * (p + q)
+    kl = lambda a, b: float(np.sum(a * np.log(a / b)))  # noqa: E731
+    return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+
+# ---------------------------------------------------------------------
+# canonical serialization (the byte-stability contract)
+def _jsonable(x: Any) -> Any:
+    """Plain-python view of numpy scalars/arrays so the canonical dump
+    is independent of who built the object (fresh bincount vs a parsed
+    round trip)."""
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, np.ndarray):
+        return [_jsonable(v) for v in x.tolist()]
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    return x
+
+
+def canonical_json(obj: Any) -> str:
+    """Sorted-key, separator-minimal JSON — dumping a parsed dump
+    reproduces the identical bytes (floats use Python's shortest
+    round-trip repr, which json both emits and parses exactly)."""
+    return json.dumps(_jsonable(obj), sort_keys=True,
+                      separators=(",", ":"), allow_nan=False)
+
+
+def profile_digest(profile: Optional[Dict[str, Any]]) -> str:
+    if not profile:
+        return ""
+    return hashlib.sha256(canonical_json(profile).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------
+# training reference profile
+def _quantile_sketch(values: np.ndarray, bins: int = _SCORE_BINS
+                     ) -> Dict[str, Any]:
+    """Fixed-size histogram of a 1-D float sample: interior quantile
+    edges (deduplicated — a constant sample degrades to one bin) and
+    the counts of ``searchsorted`` against them.  Comparable across
+    samples because the EDGES ride the profile."""
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return {"edges": [], "counts": [], "count": 0}
+    qs = np.quantile(v, np.linspace(0.0, 1.0, bins + 1)[1:-1])
+    edges = np.unique(qs)
+    counts = np.bincount(np.searchsorted(edges, v, side="right"),
+                         minlength=edges.size + 1)
+    return {"edges": [float(e) for e in edges],
+            "counts": [int(c) for c in counts],
+            "count": int(v.size),
+            "mean": float(v.mean()), "std": float(v.std())}
+
+
+def sketch_counts(sketch: Dict[str, Any], values: np.ndarray
+                  ) -> np.ndarray:
+    """Histogram ``values`` against a stored sketch's edges."""
+    edges = np.asarray(sketch.get("edges", []), np.float64)
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    return np.bincount(np.searchsorted(edges, v, side="right"),
+                       minlength=edges.size + 1)
+
+
+def build_profile(ds) -> Dict[str, Any]:
+    """Capture a :data:`PROFILE_SCHEMA` DataProfile from a finalized
+    ``TpuDataset`` (packed bins + frozen mappers present).  One
+    ``np.bincount`` per used feature over columns that already exist —
+    no re-binning, no device work."""
+    from ..binning import mappers_digest
+
+    bins = ds.bins
+    n = int(bins.shape[0]) if bins is not None else 0
+    # sparse-EFB datasets hold BUNDLE columns, not per-feature columns:
+    # per-feature histograms are structurally unavailable — emit empty
+    # counts (the monitor skips empty references) but keep the label /
+    # missing-rate / digest parts of the profile
+    bundled = getattr(ds, "prebundled", None) is not None
+    features: List[Dict[str, Any]] = []
+    for k, j in enumerate(ds.used_features):
+        nb = int(ds.num_bin_per_feat[k])
+        if n and not bundled:
+            counts = np.bincount(np.asarray(bins[:, k], np.int64),
+                                 minlength=nb)[:nb]
+        else:
+            counts = np.zeros(0, np.int64)
+        mapper = ds.mappers[j]
+        mtype = mapper.missing_type_str()
+        if mtype == "NaN" and counts.size:
+            miss = int(counts[nb - 1])
+        elif mtype == "Zero" \
+                and 0 <= int(mapper.default_bin) < counts.size:
+            miss = int(counts[int(mapper.default_bin)])
+        else:
+            miss = 0
+        feat = {
+            "index": int(j),
+            "num_bin": nb,
+            "counts": [int(c) for c in counts],
+            "missing_rate": float(miss) / n if n else 0.0,
+            "categorical": bool(ds.is_categorical[k]),
+        }
+        if not feat["categorical"] \
+                and getattr(mapper, "bin_upper_bound", None) is not None:
+            # numeric edges let a raw-variant engine host-bin floats
+            # against the training layout; +-inf edges are dropped
+            # (allow_nan=False canonical JSON) — searchsorted against
+            # the finite interior edges reproduces the same bins
+            feat["edges"] = [float(b) for b in mapper.bin_upper_bound
+                             if np.isfinite(b)]
+        features.append(feat)
+
+    label = getattr(ds.metadata, "label", None)
+    profile = {
+        "schema": PROFILE_SCHEMA,
+        "rows": n,
+        "mappers_digest": mappers_digest(ds.mappers),
+        "features": features,
+        "label": _quantile_sketch(label) if label is not None
+        else {"edges": [], "counts": [], "count": 0},
+    }
+    return profile
+
+
+def add_score_distribution(profile: Optional[Dict[str, Any]],
+                           scores) -> None:
+    """Attach the final training-score (margin) distribution — called
+    at training finalize, where the drained scores are already on host
+    fetch path (no extra dispatch)."""
+    if not profile:
+        return
+    profile["score"] = _quantile_sketch(np.asarray(scores))
+
+
+# ---------------------------------------------------------------------
+# provenance (the lineage record)
+def build_provenance(*, run_id: str = "", params_digest: str = "",
+                     source: str = "", parent_checkpoint: str = "",
+                     profile: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """When ``run_id`` is not supplied it is CONTENT-DERIVED — a digest
+    of (params digest, source fingerprint, profile digest) — so two
+    identical trainings serialize byte-identical model artifacts (the
+    repo's rerun-determinism contract; reference model strings carry no
+    per-run entropy either).  For the same reason the record holds no
+    wall-clock timestamp, and ``parent_checkpoint`` stays OUT of the
+    derivation: a resumed run is the same training run, so restore can
+    chain the checkpoint hash without changing the run identity.
+    Per-run wall-clock identity lives in the telemetry stream / run
+    report, and model age is tracked from rollover time at serving."""
+    pdig = profile_digest(profile)
+    if not run_id:
+        seed = canonical_json({"params": str(params_digest),
+                               "source": str(source), "profile": pdig})
+        run_id = "r" + hashlib.sha256(seed.encode()).hexdigest()[:16]
+    return {
+        "schema": PROVENANCE_SCHEMA,
+        "run_id": str(run_id),
+        "params_digest": str(params_digest),
+        "source": str(source),
+        "parent_checkpoint": str(parent_checkpoint),
+        "profile_digest": pdig,
+    }
+
+
+def source_fingerprint(data, profile: Optional[Dict[str, Any]] = None
+                       ) -> str:
+    """Content fingerprint of the training data.  Given a profile the
+    identity is rows x features + the frozen mappers digest — stable
+    across ingestion paths (in-memory array, pushed rows, binary-cache
+    reload, streamed file), which the model-string parity contracts
+    require: the same data must serialize the same artifact no matter
+    how it arrived.  Path+mtime metadata would break that (and goes
+    stale on copy); it belongs to the ingest cache-hit layer
+    (``ingest.cache.source_fingerprint``), not the model artifact.
+    Without a profile, fall back to the container description."""
+    if profile:
+        return (f"data:{int(profile.get('rows', 0))}x"
+                f"{len(profile.get('features', []))}:"
+                f"m{str(profile.get('mappers_digest', ''))[:12]}")
+    try:
+        import os
+        if isinstance(data, str):
+            st = os.stat(data)
+            return f"file:{os.path.abspath(data)}:{st.st_size}:" \
+                   f"{int(st.st_mtime)}"
+        shape = getattr(data, "shape", None)
+        if shape is not None:
+            return "array:" + "x".join(str(int(s)) for s in shape)
+    except Exception:
+        pass
+    return f"object:{type(data).__name__}"
+
+
+# ---------------------------------------------------------------------
+# serving drift monitor
+class DriftMonitor:
+    """Host-side drift accumulator for one resident serving engine.
+
+    ``accumulate``/``accumulate_raw``/``accumulate_scores`` are called
+    by the serving engine on batches it ALREADY encoded/predicted (the
+    zero-extra-dispatch invariant); ``evaluate`` is called by the
+    micro-batcher's post-batch flush hook — off the request latency
+    path — and returns a result dict once enough rows accumulated
+    since the last evaluation, else ``None``.
+
+    Hysteresis: an alert arms only after ``hysteresis`` CONSECUTIVE
+    evaluations with ``psi_max`` over the threshold, fires once, and
+    cannot re-fire until the excursion fully clears (an evaluation back
+    under the threshold).  One sustained shift -> exactly one
+    ``drift_alert``.
+    """
+
+    def __init__(self, profile: Dict[str, Any], *,
+                 psi_threshold: float = 0.2, eval_rows: int = 512,
+                 hysteresis: int = 2):
+        self.profile = profile
+        self.psi_threshold = float(psi_threshold)
+        self.eval_rows = max(1, int(eval_rows))
+        self.hysteresis = max(1, int(hysteresis))
+        feats = profile.get("features", [])
+        self._ref = [np.asarray(f.get("counts", []), np.float64)
+                     for f in feats]
+        self._idx = [int(f.get("index", i)) for i, f in enumerate(feats)]
+        self._edges = [np.asarray(f.get("edges", []), np.float64)
+                       if not f.get("categorical") else None
+                       for f in feats]
+        self._counts = [np.zeros(max(1, r.size), np.int64)
+                        for r in self._ref]
+        self._score_ref = profile.get("score") or {}
+        self._score_counts = np.zeros(
+            len(self._score_ref.get("counts", [])) or 1, np.int64)
+        self._rows = 0
+        self._rows_since_eval = 0
+        self._over = 0
+        self._latched = False
+        self.alerts = 0
+        self.evaluations = 0
+        self.last: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------- accumulation
+    def accumulate(self, enc: np.ndarray) -> None:
+        """Binned rows (``[rows, F]`` integer bin indices — the binned
+        serving variant's encode output)."""
+        enc = np.asarray(enc)
+        if enc.ndim != 2 or enc.shape[0] == 0:
+            return
+        with self._lock:
+            for k, ref in enumerate(self._ref):
+                if k >= enc.shape[1]:
+                    break
+                nb = self._counts[k].size
+                col = np.clip(np.asarray(enc[:, k], np.int64), 0, nb - 1)
+                self._counts[k] += np.bincount(col, minlength=nb)
+            self._rows += int(enc.shape[0])
+            self._rows_since_eval += int(enc.shape[0])
+
+    def accumulate_raw(self, X: np.ndarray) -> None:
+        """Float rows (the raw serving variant): host-bin numeric
+        features against the profile's stored edges.  Categorical
+        features (no edges in the profile) are skipped — their PSI is
+        simply not monitored on raw engines."""
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[0] == 0:
+            return
+        with self._lock:
+            for k, edges in enumerate(self._edges):
+                if edges is None or self._idx[k] >= X.shape[1]:
+                    continue
+                col = X[:, self._idx[k]]
+                col = col[np.isfinite(col)]
+                nb = self._counts[k].size
+                b = np.clip(np.searchsorted(edges, col, side="left"),
+                            0, nb - 1)
+                self._counts[k] += np.bincount(b, minlength=nb)
+            self._rows += int(X.shape[0])
+            self._rows_since_eval += int(X.shape[0])
+
+    def accumulate_scores(self, raw) -> None:
+        if not self._score_ref.get("counts"):
+            return
+        c = sketch_counts(self._score_ref, np.asarray(raw))
+        with self._lock:
+            n = min(c.size, self._score_counts.size)
+            self._score_counts[:n] += c[:n]
+
+    # -------------------------------------------------- evaluation
+    def evaluate(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if not force and self._rows_since_eval < self.eval_rows:
+                return None
+            if self._rows == 0:
+                return None
+            per_feat = {self._idx[k]: psi(coarsen(ref),
+                                          coarsen(self._counts[k]))
+                        for k, ref in enumerate(self._ref) if ref.size}
+            score_psi = psi(coarsen(self._score_ref.get("counts", [])),
+                            coarsen(self._score_counts)) \
+                if self._score_ref.get("counts") else 0.0
+            psi_max = max(list(per_feat.values()) + [score_psi], default=0.0)
+            over = psi_max > self.psi_threshold
+            if over:
+                self._over += 1
+            else:
+                self._over = 0
+                self._latched = False
+            alert = False
+            if self._over >= self.hysteresis and not self._latched:
+                self._latched = True
+                self.alerts += 1
+                alert = True
+            self.evaluations += 1
+            self._rows_since_eval = 0
+            self.last = {"psi": per_feat, "score_psi": score_psi,
+                         "psi_max": psi_max, "rows": self._rows,
+                         "alert": alert, "over_count": self._over}
+            return dict(self.last)
+
+
+# ---------------------------------------------------------------------
+# ingest-side mapper drift (per-chunk, against the frozen mappers)
+def chunk_mapper_drift(mappers, used_features, Xf: np.ndarray
+                       ) -> Dict[str, Any]:
+    """Diff one raw ingest chunk against the frozen mappers: fraction
+    of finite values outside a numeric mapper's [min, max] training
+    range, and the unseen-category rate for categorical mappers.
+    Pure numpy over the chunk the pipeline already holds."""
+    from ..binning import mapper_drift_counts
+
+    out = new_cat = total = 0
+    worst_rate, worst_feat = 0.0, -1
+    for j in used_features:
+        if j >= Xf.shape[1]:
+            continue
+        o, nc, n = mapper_drift_counts(mappers[j], Xf[:, j])
+        out += o
+        new_cat += nc
+        total += n
+        rate = (o + nc) / n if n else 0.0
+        if rate > worst_rate:
+            worst_rate, worst_feat = rate, int(j)
+    return {"rows": int(Xf.shape[0]),
+            "out_of_range": int(out), "new_categories": int(new_cat),
+            "values": int(total),
+            "out_of_range_rate": out / total if total else 0.0,
+            "new_category_rate": new_cat / total if total else 0.0,
+            "worst_feature": worst_feat,
+            "worst_rate": round(worst_rate, 6)}

@@ -150,10 +150,14 @@ def render_openmetrics(snapshot: Dict[str, Any],
     for name, d in sorted(snapshot.get("dists", {}).items()):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} summary")
-        for qlabel, key in _QUANTILES:
-            if key in d:
-                lab = dict(labels, quantile=qlabel)
-                lines.append(f"{m}{_fmt_labels(lab)} {_num(d[key])}")
+        # quantile series render only off a non-empty sample ring: a
+        # fresh distribution (count 0, or a drained ring) exposes
+        # count/sum alone — a scraper must never see NaN quantiles
+        if d.get("count", 0) > 0:
+            for qlabel, key in _QUANTILES:
+                if key in d:
+                    lab = dict(labels, quantile=qlabel)
+                    lines.append(f"{m}{_fmt_labels(lab)} {_num(d[key])}")
         lines.append(f"{m}_count{_fmt_labels(labels)} "
                      f"{_num(d.get('count', 0))}")
         if "sum" in d:

@@ -220,12 +220,18 @@ class Telemetry:
     def _dist_summary(samples, totals=None) -> Dict[str, float]:
         vals = sorted(samples)
         n = len(vals)
+        count, total = (totals if totals is not None
+                        else (n, float(sum(vals))))
+        if n == 0:
+            # empty-ring-safe: a dist observed zero samples (or whose
+            # ring was drained) must summarize to count/sum only —
+            # NEVER NaN quantiles; the exporter renders quantile series
+            # only when count > 0
+            return {"count": int(count), "sum": float(total)}
 
         def q(p: float) -> float:
             return vals[min(n - 1, int(p * (n - 1) + 0.5))]
 
-        count, total = (totals if totals is not None
-                        else (n, float(sum(vals))))
         return {"count": int(count), "sum": float(total),
                 "min": vals[0], "max": vals[-1],
                 "p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}

@@ -168,6 +168,12 @@ def capture(gbdt) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
         "engine_extra": engine_extra,
         "telemetry_counters": counters,
         "sanity": {key: getattr(cfg, key, None) for key in _SANITY_KEYS},
+        # drift & lineage plane: the training DataProfile and provenance
+        # record ride every checkpoint manifest, so a booster resurrected
+        # from a checkpoint (rollover source) carries its training
+        # distribution and lineage exactly like a model-file booster
+        "data_profile": getattr(gbdt, "data_profile", None),
+        "provenance": getattr(gbdt, "provenance", None),
     }
     return payload, arrays
 
@@ -269,6 +275,12 @@ def restore(gbdt, payload: Dict[str, Any], arrays) -> int:
     gbdt._es_carry = None
     gbdt._epi_carry = None
     gbdt._last_ckpt_iter = gbdt.iter
+    # lineage: the resumed run descends from this checkpoint — chain the
+    # parent hash into the (freshly built) provenance record
+    gbdt._parent_ckpt_hash = str(want or got)
+    prov = getattr(gbdt, "provenance", None)
+    if prov is not None:
+        prov["parent_checkpoint"] = gbdt._parent_ckpt_hash
     gbdt.telemetry.event("resumed", iteration=gbdt.iter,
                          trees=len(models),
                          model_hash=got[:16])
@@ -410,6 +422,8 @@ def booster_from_checkpoint(path: str, rank: int = 0):
         obj = f"{obj} num_class:{b.num_class}"
     b._objective_str = obj
     b.objective = create_objective_from_string(obj)
+    b.data_profile = payload.get("data_profile")
+    b.provenance = payload.get("provenance")
     b.best_iteration = -1
     b._model_version += 1
     log.info("rollover source: checkpoint %s (iteration %s, %d trees, "

@@ -137,6 +137,10 @@ class MicroBatcher:
         # futures resolved — signature plumbing that keeps the request
         # latency path analysis-free
         self.cost_flush: Optional[Callable[[], None]] = None
+        # post-batch drift evaluation (obs/drift.py): the service wires
+        # this to the resident engines's monitors — PSI math runs on the
+        # worker after the batch resolved, never on the request path
+        self.drift_flush: Optional[Callable[[], None]] = None
         self._q: collections.deque = collections.deque()
         self._q_rows = 0
         self._cv = threading.Condition()
@@ -498,6 +502,7 @@ class MicroBatcher:
 
         self._record(_batch_telemetry)
         self._record(lambda: self.cost_flush and self.cost_flush())
+        self._record(lambda: self.drift_flush and self.drift_flush())
         # adaptive admission: evaluate AFTER the batch's latency samples
         # landed in the dist ring (time-gated inside the controller)
         self._record(lambda: self.on_batch_done and self.on_batch_done())

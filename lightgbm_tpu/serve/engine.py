@@ -57,7 +57,11 @@ class ServingEngine:
                  min_bucket_rows: int = 64,
                  start_iteration: int = 0,
                  num_iteration: Optional[int] = None,
-                 cost_ledger: str = "hlo"):
+                 cost_ledger: str = "hlo",
+                 drift_enabled: bool = True,
+                 drift_psi_threshold: float = 0.2,
+                 drift_eval_rows: int = 512,
+                 drift_hysteresis: int = 2):
         self.booster = booster
         self.model_id = model_id
         self.tel = telemetry
@@ -135,6 +139,26 @@ class ServingEngine:
                     trees=self.hi - self.lo,
                     bytes=self.packed_nbytes)
 
+        # drift monitor (obs/drift.py): fed host-side from batches this
+        # engine already encoded/predicted — zero extra device
+        # dispatches.  A pre-plane artifact (no embedded profile)
+        # degrades structurally: one drift_unavailable event, never an
+        # exception.
+        self.drift = None
+        self._warming = False
+        profile = getattr(booster, "data_profile", None)
+        if drift_enabled:
+            if profile:
+                from ..obs.drift import DriftMonitor
+                self.drift = DriftMonitor(
+                    profile, psi_threshold=drift_psi_threshold,
+                    eval_rows=drift_eval_rows,
+                    hysteresis=drift_hysteresis)
+            else:
+                self._event("drift_unavailable", model_id=model_id,
+                            reason="no_embedded_profile")
+                self._inc("drift.unavailable")
+
     # ------------------------------------------------------- telemetry
     def _inc(self, name: str, v: float = 1) -> None:
         if self.tel is not None:
@@ -180,14 +204,20 @@ class ServingEngine:
             return {"warmed": [], "compiles": 0, "degraded": True}
         compiles_before, dispatches_before = self.compiles, self.dispatches
         warmed = []
-        for b in sorted(set(buckets or self.buckets())):
-            b = self.bucket_for(b)
-            if b in warmed:
-                continue
-            enc = self._encode_pad(np.zeros(
-                (1, self.booster.max_feature_idx + 1), np.float32), b)
-            jax.block_until_ready(self._dispatch(enc, b))
-            warmed.append(b)
+        # warmup feeds synthetic zero rows — keep them out of the drift
+        # histograms (the monitor watches real traffic only)
+        self._warming = True
+        try:
+            for b in sorted(set(buckets or self.buckets())):
+                b = self.bucket_for(b)
+                if b in warmed:
+                    continue
+                enc = self._encode_pad(np.zeros(
+                    (1, self.booster.max_feature_idx + 1), np.float32), b)
+                jax.block_until_ready(self._dispatch(enc, b))
+                warmed.append(b)
+        finally:
+            self._warming = False
         n = self.compiles - compiles_before
         # warmup is the cold path: run the queued cost analyses inline
         # so steady-state traffic starts with the ledger settled
@@ -289,7 +319,8 @@ class ServingEngine:
             rows = Xc.shape[0]
             bucket = self.bucket_for(rows)
             t0 = time.perf_counter()
-            raw = self._dispatch(self._encode_pad(Xc, bucket), bucket)
+            enc = self._encode_pad(Xc, bucket)
+            raw = self._dispatch(enc, bucket)
             # np.asarray blocks on the device result, so this window is
             # the honest dispatch+execute wall the serve_access record
             # reports per request (summed across an oversized request's
@@ -297,7 +328,26 @@ class ServingEngine:
             out[:, sl] = np.asarray(raw, np.float64)[:, :rows]
             reqtrace.annotate(
                 dispatch_ms=(time.perf_counter() - t0) * 1000.0)
+            self._drift_accumulate(enc[:rows], Xc, out[:, sl])
         return out
+
+    def _drift_accumulate(self, enc, Xc, scores) -> None:
+        """Feed the drift monitor from a batch that was ALREADY encoded
+        and predicted — pure host numpy, zero device work (the serving
+        dispatch/recompile contracts are counter-asserted over this)."""
+        drift = self.drift
+        if drift is None or self._warming:
+            return
+        try:
+            if enc is not None and self.variant == "binned":
+                # binned encode output: int bin indices in used-feature
+                # order — exactly the profile's histogram layout
+                drift.accumulate(enc)
+            elif Xc is not None:
+                drift.accumulate_raw(np.asarray(Xc, np.float64))
+            drift.accumulate_scores(scores)
+        except Exception:
+            pass  # monitoring must never fail a prediction
 
     def _host_predict_raw(self, X) -> np.ndarray:
         """Degraded path: the exact float64 host walk (basic.py
@@ -312,6 +362,8 @@ class ServingEngine:
         with self._lock:
             self.host_rows += n
         self._inc("serve.host_rows", n)
+        if not _is_sparse(X):
+            self._drift_accumulate(None, X, out)
         reqtrace.annotate(degraded=True,
                           dispatch_ms=(time.perf_counter() - t0) * 1000.0)
         return out
@@ -351,13 +403,22 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"model_id": self.model_id, "variant": self.variant,
-                    "model_hash": self.model_hash[:16],
-                    "device": self.device_ok,
-                    "degraded_reason": self.degraded_reason,
-                    "trees": self.hi - self.lo,
-                    "packed_bytes": self.packed_nbytes,
-                    "compiles": self.compiles,
-                    "dispatches": self.dispatches,
-                    "host_rows": self.host_rows,
-                    "buckets": self.buckets()}
+            out = {"model_id": self.model_id, "variant": self.variant,
+                   "model_hash": self.model_hash[:16],
+                   "device": self.device_ok,
+                   "degraded_reason": self.degraded_reason,
+                   "trees": self.hi - self.lo,
+                   "packed_bytes": self.packed_nbytes,
+                   "compiles": self.compiles,
+                   "dispatches": self.dispatches,
+                   "host_rows": self.host_rows,
+                   "buckets": self.buckets()}
+        if self.drift is not None:
+            out["drift"] = {
+                "alerts": self.drift.alerts,
+                "evaluations": self.drift.evaluations,
+                "psi_max": round(float(
+                    self.drift.last.get("psi_max", 0.0)), 6),
+                "score_psi": round(float(
+                    self.drift.last.get("score_psi", 0.0)), 6)}
+        return out

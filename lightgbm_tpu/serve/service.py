@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -109,7 +110,11 @@ class PredictionService:
                  default_deadline_ms: Optional[float] = None,
                  target_p99_ms: Optional[float] = None,
                  retry_policy: Optional[RetryPolicy] = None,
-                 cost_ledger: Optional[str] = None):
+                 cost_ledger: Optional[str] = None,
+                 drift_enabled: Optional[bool] = None,
+                 drift_psi_threshold: Optional[float] = None,
+                 drift_eval_rows: Optional[int] = None,
+                 drift_hysteresis: Optional[int] = None):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -132,6 +137,17 @@ class PredictionService:
             target_p99_ms = param_default("serve_target_p99_ms")
         if cost_ledger is None:
             cost_ledger = param_default("cost_ledger")
+        # drift-monitor knobs (obs/drift.py), defaulted from the config
+        # registry: ON unless drift_profile=false, degrading
+        # structurally on profile-less artifacts
+        if drift_enabled is None:
+            drift_enabled = param_default("drift_profile")
+        if drift_psi_threshold is None:
+            drift_psi_threshold = param_default("drift_psi_threshold")
+        if drift_eval_rows is None:
+            drift_eval_rows = param_default("drift_eval_rows")
+        if drift_hysteresis is None:
+            drift_hysteresis = param_default("drift_hysteresis")
         self.retry_policy = retry_policy
 
         self.raw_score = bool(raw_score)
@@ -164,9 +180,17 @@ class PredictionService:
             max_batch_rows=max_batch_rows,
             min_bucket_rows=min_bucket_rows,
             num_iteration=num_iteration,
-            cost_ledger=str(cost_ledger or "hlo"))
+            cost_ledger=str(cost_ledger or "hlo"),
+            drift_enabled=bool(drift_enabled),
+            drift_psi_threshold=float(drift_psi_threshold),
+            drift_eval_rows=int(drift_eval_rows),
+            drift_hysteresis=int(drift_hysteresis))
+        # model freshness: birth instant per model_id, reset on rollover
+        # promotion -> the model_age_s gauge in the drift flush
+        self._model_born: Dict[str, float] = {}
         for mid, spec in specs.items():
             self.residency.register(str(mid), _as_booster(spec))
+            self._model_born[str(mid)] = time.time()
         self.batcher = MicroBatcher(
             self._dispatch_batch, max_batch_rows=max_batch_rows,
             max_delay_ms=max_delay_ms, telemetry=self.tel,
@@ -179,6 +203,9 @@ class PredictionService:
         # deferred HLO analyses run on the worker thread after the
         # batch's futures resolved (obs/cost.py; engine.flush_cost)
         self.batcher.cost_flush = self._flush_cost
+        # post-batch drift evaluation: PSI math + gauge/event export run
+        # on the worker thread after the batch's futures resolved
+        self.batcher.drift_flush = self._flush_drift
         # adaptive admission: armed only by a nonzero p99 target; runs
         # on the worker thread via the post-batch hook
         self.admission: Optional[AdmissionController] = None
@@ -399,12 +426,30 @@ class PredictionService:
             finally:
                 self._rollover_swapping = False
             self.tel.inc("serve.rollovers")
+            # lineage chain: the incumbent's provenance becomes the
+            # candidate's serving parent — training run_id -> checkpoint
+            # -> rollover is one reconstructible chain in the event log
+            old_b = None
+            try:
+                old_b = getattr(old_eng, "booster", None)
+            except Exception:
+                pass
+            old_prov = getattr(old_b, "provenance", None) or {}
+            new_prov = getattr(booster, "provenance", None) or {}
             self.tel.event("serve_rollover", model_id=model_id,
                            old_hash=old_hash[:16],
                            new_hash=cand.model_hash[:16],
                            source=source_kind,
                            warmed=bool(warm),
-                           shadow=report["shadow"])
+                           shadow=report["shadow"],
+                           old_run_id=str(old_prov.get("run_id", "")),
+                           new_run_id=str(new_prov.get("run_id", "")),
+                           new_parent_checkpoint=str(
+                               new_prov.get("parent_checkpoint", ""))[:16],
+                           new_profile_digest=str(
+                               new_prov.get("profile_digest", ""))[:16])
+            self._model_born[model_id] = time.time()
+            self.tel.gauge(f"serve.model_age_s.{model_id}", 0.0)
             report["promoted"] = True
             return report
 
@@ -451,6 +496,14 @@ class PredictionService:
         }
         if self.admission is not None:
             out["admission"] = self.admission.stats()
+        g = snap.get("gauges", {})
+        out["drift"] = {
+            "alerts": int(c.get("drift.alerts", 0)),
+            "evaluations": int(c.get("drift.evaluations", 0)),
+            "unavailable": int(c.get("drift.unavailable", 0)),
+            "psi_max": float(g.get("drift.psi_max", 0.0)),
+            "score_psi": float(g.get("drift.score_psi", 0.0)),
+        }
         if requests > 0:
             # steady-state rates: warmup's deliberate dispatches/compiles
             # must not read as a bucketing or recompile regression
@@ -472,6 +525,71 @@ class PredictionService:
         except Exception:
             pass
 
+    def _flush_drift(self) -> None:
+        """Batcher post-batch hook: evaluate every resident engine's
+        drift monitor (rate-limited inside the monitor by
+        drift_eval_rows) and export gauges/events.  Host-side numpy
+        only — the serving dispatch counters are untouched.  Must never
+        raise into the worker."""
+        try:
+            now = time.time()
+            for eng in self.residency.resident_engines():
+                age = now - self._model_born.get(eng.model_id, now)
+                self.tel.gauge(f"serve.model_age_s.{eng.model_id}",
+                               round(age, 3))
+                if eng.drift is None:
+                    continue
+                res = eng.drift.evaluate()
+                if res is None:
+                    continue
+                worst_feat, worst_psi = -1, 0.0
+                for fi, v in res["psi"].items():
+                    self.tel.gauge(f"drift.psi.f{fi}", round(v, 6))
+                    if v >= worst_psi:
+                        worst_feat, worst_psi = int(fi), float(v)
+                self.tel.gauge("drift.score_psi",
+                               round(res["score_psi"], 6))
+                self.tel.gauge("drift.psi_max", round(res["psi_max"], 6))
+                self.tel.inc("drift.evaluations")
+                self.tel.event("drift", model_id=eng.model_id,
+                               psi_max=round(res["psi_max"], 6),
+                               score_psi=round(res["score_psi"], 6),
+                               rows=int(res["rows"]),
+                               model_age_s=round(age, 3))
+                if res["alert"]:
+                    self.tel.inc("drift.alerts")
+                    self.tel.event(
+                        "drift_alert", model_id=eng.model_id,
+                        psi_max=round(res["psi_max"], 6),
+                        worst_feature=worst_feat,
+                        worst_psi=round(worst_psi, 6),
+                        score_psi=round(res["score_psi"], 6),
+                        threshold=eng.drift.psi_threshold,
+                        rows=int(res["rows"]))
+        except Exception:
+            pass
+
+    def lineage(self) -> Dict[str, Any]:
+        """Per-model provenance chain — the run report's / ``/snapshot``'s
+        ``lineage`` section: each model's embedded provenance record
+        (None for pre-plane artifacts) plus its birth time and current
+        age."""
+        now = time.time()
+        out: Dict[str, Any] = {}
+        for mid in self.residency.model_ids():
+            prov = None
+            try:
+                booster = self.residency._boosters.get(mid)
+                prov = getattr(booster, "provenance", None)
+            except Exception:
+                pass
+            born = self._model_born.get(mid)
+            out[mid] = {"provenance": prov,
+                        "born_ts": round(born, 3) if born else None,
+                        "model_age_s": round(now - born, 3) if born
+                        else None}
+        return out
+
     def run_report(self) -> Dict[str, Any]:
         """Consolidated run report over the serving registry — the
         exporter's ``GET /report`` source, same schema as training's
@@ -479,7 +597,8 @@ class PredictionService:
         from ..obs import report as report_mod
         return report_mod.build_report(
             self.tel.snapshot(), run_id=self.tel.run_id,
-            rank=self.tel.rank, extra={"serve": self.stats()})
+            rank=self.tel.rank,
+            extra={"serve": self.stats(), "lineage": self.lineage()})
 
     # ------------------------------------------------------------------
     def close(self, drain: bool = True,

@@ -190,6 +190,23 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # - hist_quant_bits / screening_active_features: the active cut
     #   configuration and the screening mask width — shape drifts
     #   flag.
+    # - drift_dispatches_per_iter (bench.py --micro drift leg):
+    #   training with data-profile capture on — the profile is pure
+    #   host numpy at dataset finalize, so this must EQUAL
+    #   dispatches_per_iter;
+    # - serve_drift_dispatches_per_request /
+    #   serve_drift_compiles_per_1k: the closed loop with the serving
+    #   DriftMonitor evaluating — accumulation rides the already-
+    #   encoded batch host-side, so exactly 1.0 / 0 like the bare
+    #   serving contract; an increase means drift monitoring started
+    #   paying device round trips or recompiles;
+    # - drift_alerts / drift_alerts_control: EXACTLY one hysteresis-
+    #   gated alert on the deterministically shifted feed, zero on the
+    #   in-distribution control (zero-to-nonzero always flags);
+    # - drift_psi_max: the shifted feed's PSI against the embedded
+    #   training profile — fixed seeds + integer bin counts make it
+    #   exactly reproducible, so any movement means the profile or
+    #   divergence arithmetic changed shape.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
@@ -203,7 +220,11 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
                  "dispatches_per_request", "compiles_per_1k_requests",
                  "shed_ratio", "reject_ratio", "overload_unresolved",
                  "overload_queue_overflow",
-                 "rollover_dropped_requests"):
+                 "rollover_dropped_requests",
+                 "drift_dispatches_per_iter",
+                 "serve_drift_dispatches_per_request",
+                 "serve_drift_compiles_per_1k", "drift_alerts",
+                 "drift_alerts_control", "drift_psi_max"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue

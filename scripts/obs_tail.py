@@ -12,8 +12,8 @@ shared reader:
     python scripts/obs_tail.py run.jsonl --event anomaly,straggler
     python scripts/obs_tail.py run.jsonl.rank1 --rank 1 --last 20
 
-    # per-event counts, iteration span, findings (plus cost:/hist:
-    # lines when the run emitted cost_ledger records)
+    # per-event counts, iteration span, findings (plus cost:/hist:/
+    # drift: lines when the run emitted cost_ledger/drift records)
     python scripts/obs_tail.py run.jsonl --summary
 
     # render a consolidated run report (run_report_out / GET /report)
@@ -112,6 +112,7 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     findings: List[Dict[str, Any]] = []
     ingest: List[Dict[str, Any]] = []
     cost: List[Dict[str, Any]] = []
+    drift: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -120,12 +121,15 @@ def summarize(records: List[Dict[str, Any]]) -> str:
         if isinstance(r.get("iter"), int):
             iters.append(r["iter"])
         if r.get("event") in ("anomaly", "rank_divergence", "straggler",
-                              "serve_batch_error", "recovery"):
+                              "serve_batch_error", "recovery",
+                              "drift_alert", "mapper_drift"):
             findings.append(r)
         if r.get("event") == "ingest":
             ingest.append(r)
         if r.get("event") == "cost_ledger":
             cost.append(r)
+        if r.get("event") == "drift":
+            drift.append(r)
     lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
     if iters:
         lines.append(f"iterations: {min(iters)}..{max(iters)}")
@@ -152,6 +156,22 @@ def summarize(records: List[Dict[str, Any]]) -> str:
                 f"hist: analytic bytes/iter={_mean(hist_b):.3e}"
                 + (f"  achieved_fraction={fracs[-1]:.4g} of HLO bytes"
                    if fracs else ""))
+    if drift or by_event.get("drift_alert"):
+        # one line for the drift & lineage plane (obs/drift.py): the
+        # latest periodic evaluation's PSI vs the training profile,
+        # the hysteresis-gated alert count, and resident model age
+        last = drift[-1] if drift else {}
+        parts = [f"drift: {len(drift)} evaluation(s)"]
+        if isinstance(last.get("psi_max"), (int, float)):
+            parts.append(f"psi_max={float(last['psi_max']):.4g}")
+        if isinstance(last.get("score_psi"), (int, float)):
+            parts.append(f"score_psi={float(last['score_psi']):.4g}")
+        parts.append(f"alerts={by_event.get('drift_alert', 0)}")
+        if isinstance(last.get("model_age_s"), (int, float)):
+            parts.append(f"model_age_s={float(last['model_age_s']):.4g}")
+        if by_event.get("drift_unavailable"):
+            parts.append(f"unavailable={by_event['drift_unavailable']}")
+        lines.append("  ".join(parts))
     if ingest:
         # one line per ingest (streamed/cached dataset build): source,
         # chunk arithmetic, the bounded-residency watermark, cache hit
