@@ -557,6 +557,26 @@ _PARAMS: List[_Param] = [
             "ring, with consecutive-evaluation hysteresis so it "
             "cannot flap. 0 = off (serving behavior unchanged). "
             "PredictionService(target_p99_ms=) overrides"),
+    _p("serve_devices", int, 0, ("serve_n_devices",), check=(">=", 0),
+       desc="serving fleet width: replicate each hot model's packed "
+            "tree tensors onto this many local devices, each with its "
+            "own dispatch queue + worker lane; the micro-batcher "
+            "routes each micro-batch to the least-loaded replica and "
+            "spills to the coldest lane before shedding. Per-device "
+            "LRU/budget residency and atomic all-replica rollover "
+            "apply, and predict_bulk shard-maps giant batches row-wise "
+            "over the fleet. 0 = all local devices; 1 = the "
+            "single-device pre-fleet serving plane (every legacy "
+            "contract byte-identical). "
+            "PredictionService(serve_devices=) overrides"),
+    _p("serve_routing", str, "least_loaded", (),
+       desc="fleet request routing across the per-device dispatch "
+            "lanes: 'least_loaded' scores each lane by queued + "
+            "in-flight rows weighted by its measured per-row dispatch "
+            "EWMA (all-idle ties rotate round-robin so every device "
+            "warms and stays measurable); 'round_robin' ignores load "
+            "entirely. Only meaningful when the serving fleet has more "
+            "than one device"),
     # ---- Resilience (docs/Reliability.md) ----
     _p("checkpoint_dir", str, "", ("checkpoint_path",),
        desc="directory for resumable training checkpoints "

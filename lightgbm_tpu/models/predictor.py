@@ -312,12 +312,17 @@ class DevicePredictor(_StackedPredictor):
         return self._bin_rows(X)
 
     def run_args(self, lo: int, hi: int) -> Tuple:
-        sel = slice(lo, hi)
+        # full-range slice: hand out the packed arrays themselves — a
+        # jnp slice materializes a device COPY, which doubled the serve
+        # engine's true residency (the budget accounting drift the
+        # serve fleet PR audited against live buffers)
+        full = lo == 0 and hi >= self.sf.shape[0]
+        sl = (lambda a: a) if full else (lambda a: a[lo:hi])
         tids = jnp.arange(lo, hi, dtype=jnp.int32) % self.k
-        return (self.sf[sel], self.tb[sel], self.dl[sel], self.lc[sel],
-                self.rc[sel], self.lv[sel], tids,
-                None if self.cf is None else self.cf[sel],
-                None if self.cm is None else self.cm[sel],
+        return (sl(self.sf), sl(self.tb), sl(self.dl), sl(self.lc),
+                sl(self.rc), sl(self.lv), tids,
+                None if self.cf is None else sl(self.cf),
+                None if self.cm is None else sl(self.cm),
                 self.num_bin, self.missing, self.default_bin)
 
 
@@ -461,9 +466,12 @@ class RawDevicePredictor(_StackedPredictor):
         return np.ascontiguousarray(X[:, :nf], np.float32)
 
     def run_args(self, lo: int, hi: int) -> Tuple:
-        sel = slice(lo, hi)
+        # full-range slice returns the packed arrays themselves (a jnp
+        # slice would allocate device copies — see DevicePredictor)
+        full = lo == 0 and hi >= self.sf.shape[0]
+        sl = (lambda a: a) if full else (lambda a: a[lo:hi])
         tids = jnp.arange(lo, hi, dtype=jnp.int32) % self.k
-        return (self.sf[sel], self.th[sel], self.dl[sel], self.mt[sel],
-                self.lc[sel], self.rc[sel], self.lv[sel], tids,
-                None if self.cf is None else self.cf[sel],
-                None if self.cm is None else self.cm[sel])
+        return (sl(self.sf), sl(self.th), sl(self.dl), sl(self.mt),
+                sl(self.lc), sl(self.rc), sl(self.lv), tids,
+                None if self.cf is None else sl(self.cf),
+                None if self.cm is None else sl(self.cm))

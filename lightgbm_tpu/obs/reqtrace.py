@@ -41,10 +41,15 @@ def mint_trace_id() -> str:
 
 
 # ------------------------------------------------------- batch context
-def begin_batch(model_id: str) -> Dict[str, Any]:
+def begin_batch(model_id: str,
+                device: Optional[int] = None) -> Dict[str, Any]:
     ctx = {"model_id": str(model_id), "bucket": None,
            "dispatch_ms": 0.0, "dispatches": 0, "compiles": 0,
-           "degraded": False, "model_version": None}
+           "degraded": False, "model_version": None,
+           # fleet lane index (None on a single-device batcher): which
+           # device replica served this batch — the serve_access field
+           # the fleet: summary's per-device request share reads
+           "device": device}
     _tls.batch = ctx
     return ctx
 
@@ -111,6 +116,8 @@ def emit_access(tel, req, ctx: Dict[str, Any], queue_ms: float,
         extra["model_version"] = str(ctx["model_version"])
     if ctx.get("shadow_divergence") is not None:
         extra["shadow_divergence"] = float(ctx["shadow_divergence"])
+    if ctx.get("device") is not None:
+        extra["device"] = int(ctx["device"])
     tel.inc("serve.access_records")
     tel.event("serve_access", trace_id=req.trace_id,
               model_id=req.model_id, rows=int(req.rows),

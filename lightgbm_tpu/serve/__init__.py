@@ -18,10 +18,24 @@ Layers (docs/Serving.md):
 - :class:`MicroBatcher` (batcher.py) — thread-safe request queue with
   ``max_batch_rows`` / ``max_delay_ms`` deadline coalescing, one device
   call per drained micro-batch, future-based responses;
-- :class:`ResidencyManager` (residency.py) — N models sharing a device
-  under a bytes budget with LRU eviction and pin/unpin;
+- :class:`ResidencyManager` (residency.py) — N models sharing the
+  serve devices under a per-device bytes budget with LRU eviction and
+  pin/unpin;
+- :class:`BulkScorer` (bulk.py) — row-sharded offline scoring: the
+  jitted traversal shard_mapped over the serve mesh with the packed
+  stacks as replicated read-only operands
+  (``PredictionService.predict_bulk``);
 - :class:`PredictionService` (service.py) — the public facade:
   ``PredictionService(boosters_or_paths).predict(model_id, X)``.
+
+Serving fleet (docs/Serving.md "Serving fleet"): with
+``serve_devices > 1`` each hot model's packed tensors replicate onto N
+local devices, each with its own dispatch lane (queue + worker); the
+micro-batcher routes micro-batches to the least-loaded replica, spills
+to the coldest lane before shedding, and keeps the per-device
+deterministic contract — exactly 1.0 dispatches/request, 0
+steady-state recompiles — that ``bench.py --serve`` gates per device.
+Rollover swaps all replicas atomically.
 
 Overload hardening (docs/Serving.md "Overload & rollover"): bounded
 queues with structured :class:`ServeRejected` admission refusals,
@@ -33,6 +47,7 @@ scoring, and wedged-worker detection (:class:`ServeWorkerWedged`).
 """
 from .admission import AdmissionController
 from .batcher import MicroBatcher
+from .bulk import BulkScorer
 from .engine import ServingEngine
 from .errors import (RetryPolicy, ServeClosed, ServeDeadlineExceeded,
                      ServeError, ServeRejected, ServeWorkerWedged)
@@ -40,6 +55,6 @@ from .residency import ResidencyManager
 from .service import PredictionService
 
 __all__ = ["PredictionService", "ServingEngine", "MicroBatcher",
-           "ResidencyManager", "AdmissionController", "RetryPolicy",
-           "ServeError", "ServeRejected", "ServeDeadlineExceeded",
-           "ServeClosed", "ServeWorkerWedged"]
+           "ResidencyManager", "BulkScorer", "AdmissionController",
+           "RetryPolicy", "ServeError", "ServeRejected",
+           "ServeDeadlineExceeded", "ServeClosed", "ServeWorkerWedged"]

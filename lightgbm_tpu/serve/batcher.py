@@ -1,4 +1,4 @@
-"""Micro-batching request queue with admission control.
+"""Micro-batching request queue with admission control and fleet lanes.
 
 Per-request dispatch is what makes naive serving slow: every request
 pays a host→device→host round trip.  The batcher coalesces concurrent
@@ -8,7 +8,7 @@ of the training megastep's dispatch amortization:
 - ``submit()`` enqueues a request and returns a
   ``concurrent.futures.Future`` immediately (the async form; ``predict``
   on the service is ``submit().result()``);
-- a single worker thread drains the queue: it takes the oldest request,
+- a worker thread drains its queue: it takes the oldest request,
   pulls every queued request for the SAME model, and keeps waiting for
   more until either ``max_batch_rows`` rows are assembled or
   ``max_delay_ms`` has passed since the oldest request arrived — the
@@ -16,6 +16,19 @@ of the training megastep's dispatch amortization:
 - the assembled batch is one engine call (≤1 host dispatch per
   micro-batch when the batch fits one bucket), and each requester's
   slice resolves its future.
+
+Fleet mode (``n_lanes > 1``, docs/Serving.md "Serving fleet"): one
+LANE — queue + condition + worker thread — per serve device.  A submit
+is routed to the least-loaded lane (queued + in-flight rows weighted by
+the lane's measured per-row dispatch EWMA; all-idle ties rotate
+round-robin so a sequential closed loop still exercises every device),
+and the dispatch callback receives the lane index so the service
+resolves it against that device's model replica.  Admission caps split
+evenly across lanes, and a submit its routed lane would reject SPILLS
+to the coldest lane with room before it is shed (``serve.spills``).
+Per-lane gauges (``serve.d<i>.queue_depth`` / ``queue_rows``) publish
+next to the aggregate ones.  With one lane the dispatch callback keeps
+its two-argument form and every pre-fleet contract is unchanged.
 
 Overload hardening (docs/Serving.md "Overload & rollover"):
 
@@ -41,9 +54,11 @@ Overload hardening (docs/Serving.md "Overload & rollover"):
   ``serve_wedge_worker``), the chaos CI's trigger points.
 
 Failures resolve the affected futures with the exception — a poisoned
-request cannot wedge the queue.  Telemetry: queue-depth/rows gauges
-(+ peak watermarks), batch-size and latency distributions,
-``serve.rejected``/``serve.shed`` counters, ``serve_batch`` events.
+request cannot wedge the queue.  Telemetry: queue-depth/rows gauges,
+refreshed on submit, drain AND shed so a stalled worker's backlog is
+visible between drains (+ peak watermarks), batch-size and latency
+distributions, ``serve.rejected``/``serve.shed``/``serve.spills``
+counters, ``serve_batch`` events.
 """
 from __future__ import annotations
 
@@ -59,12 +74,12 @@ from ..obs import reqtrace
 from .errors import (ServeClosed, ServeDeadlineExceeded, ServeRejected,
                      ServeWorkerWedged)
 
-# grace after an aborted drain before the worker is declared wedged:
+# grace after an aborted drain before a worker is declared wedged:
 # long enough for a healthy worker to notice the abort flag (it checks
 # between batches, and a batch is bounded by max_delay + one dispatch)
 _WEDGE_GRACE_S = 5.0
-# serve_rejected events are rate-limited (the counter is exact; the
-# event ring must not be flooded by an open-loop rejection storm)
+# serve_rejected / serve_spill events are rate-limited (the counters
+# are exact; the event ring must not be flooded by an open-loop storm)
 _REJECT_EVENT_PERIOD_S = 0.5
 
 
@@ -95,8 +110,8 @@ class _Request:
 
 def _resolve(future: Future, result=None, exc=None) -> None:
     """set_result/set_exception tolerant of a client cancel() racing the
-    delivery — an InvalidStateError here would kill the single worker
-    thread and wedge every future request behind it."""
+    delivery — an InvalidStateError here would kill a worker thread and
+    wedge every future request behind it."""
     try:
         if exc is not None:
             future.set_exception(exc)
@@ -106,15 +121,36 @@ def _resolve(future: Future, result=None, exc=None) -> None:
         pass   # cancelled between the done() check and delivery
 
 
+class _Lane:
+    """One dispatch queue + worker (one per serve device in fleet
+    mode).  The condition shares the batcher's single mutex: routing
+    reads every lane's load under one lock; workers only wake for
+    their own queue."""
+
+    __slots__ = ("index", "cv", "q", "q_rows", "inflight", "busy_rows",
+                 "ewma_ms_per_row", "worker")
+
+    def __init__(self, index: int, mu: threading.Lock):
+        self.index = index
+        self.cv = threading.Condition(mu)
+        self.q: collections.deque = collections.deque()
+        self.q_rows = 0
+        self.inflight: List[_Request] = []
+        self.busy_rows = 0          # rows of the batch being dispatched
+        self.ewma_ms_per_row: Optional[float] = None
+        self.worker: Optional[threading.Thread] = None
+
+
 class MicroBatcher:
     """Deadline-coalescing request queue in front of a dispatch fn."""
 
-    def __init__(self, dispatch: Callable[[str, Any], np.ndarray],
+    def __init__(self, dispatch: Callable[..., np.ndarray],
                  max_batch_rows: int = 8192, max_delay_ms: float = 2.0,
                  telemetry=None, batch_events: bool = True,
                  memory_watermarks: bool = True,
                  max_queue_rows: int = 0, max_queue_requests: int = 0,
-                 default_deadline_ms: float = 0.0):
+                 default_deadline_ms: float = 0.0,
+                 n_lanes: int = 1, routing: str = "least_loaded"):
         self._dispatch = dispatch
         self.max_batch_rows = int(max_batch_rows)
         self.max_delay_s = float(max_delay_ms) / 1000.0
@@ -141,23 +177,46 @@ class MicroBatcher:
         # this to the resident engines's monitors — PSI math runs on the
         # worker after the batch resolved, never on the request path
         self.drift_flush: Optional[Callable[[], None]] = None
-        self._q: collections.deque = collections.deque()
-        self._q_rows = 0
-        self._cv = threading.Condition()
+        self.n_lanes = max(1, int(n_lanes or 1))
+        self.routing = str(routing or "least_loaded")
+        self._mu = threading.Lock()
+        self._lanes = [_Lane(i, self._mu) for i in range(self.n_lanes)]
+        self._rr = self.n_lanes - 1   # rotating tie-break cursor
         self._stop = False
         self._abort_drain = False
         self._wedged = False
-        self._inflight: List[_Request] = []
         self._batch_seq = 0
-        # measured drain rate (EWMA over completed batches) feeding the
-        # retry_after_ms hint on rejections
+        # measured drain rate (EWMA over completed batches, all lanes)
+        # feeding the retry_after_ms hint on rejections
         self._ewma_batch_ms: Optional[float] = None
         self._ewma_batch_rows: Optional[float] = None
         self._last_reject_event = 0.0
+        self._last_spill_event = 0.0
         self._faults = None   # lazy: resilience.faults module
-        self._worker = threading.Thread(
-            target=self._loop, name="lgbm-serve-batcher", daemon=True)
-        self._worker.start()
+        for lane in self._lanes:
+            suffix = f"-d{lane.index}" if self.n_lanes > 1 else ""
+            lane.worker = threading.Thread(
+                target=self._loop, args=(lane,),
+                name=f"lgbm-serve-batcher{suffix}", daemon=True)
+            lane.worker.start()
+
+    # ---------------------------------------------------- introspection
+    @property
+    def _q(self) -> collections.deque:
+        """Lane 0's queue (THE queue when ``n_lanes == 1``) — legacy
+        single-queue attribute name, kept for callers/tests that
+        inspect it."""
+        return self._lanes[0].q
+
+    @property
+    def _q_rows(self) -> int:
+        """Aggregate queued rows across lanes (legacy single-queue
+        attribute name, kept for callers/tests that inspect it)."""
+        return sum(lane.q_rows for lane in self._lanes)
+
+    @property
+    def _inflight(self) -> List[_Request]:
+        return [r for lane in self._lanes for r in lane.inflight]
 
     # ------------------------------------------------------- admission
     def _retry_after_ms(self) -> float:
@@ -165,29 +224,82 @@ class MicroBatcher:
         should wait before resubmitting.  Before any batch completed,
         fall back to twice the coalescing delay."""
         if self._ewma_batch_ms and self._ewma_batch_rows:
-            rate = self._ewma_batch_rows / self._ewma_batch_ms  # rows/ms
+            # rows/ms per lane; the fleet drains n_lanes of them
+            rate = (self._ewma_batch_rows / self._ewma_batch_ms
+                    * self.n_lanes)
             if rate > 0:
                 return min(10_000.0, max(1.0, self._q_rows / rate))
         return max(1.0, self.max_delay_s * 2000.0)
 
-    def _admission_reason(self, rows: int) -> Optional[str]:
-        """Why this submit must be rejected, or None.  Caller holds the
-        lock.  A single oversized request against an EMPTY queue always
-        admits (it could otherwise never be served; the engine chunks
-        it), matching the max_batch_rows oversized-single semantics."""
-        if self.max_queue_requests \
-                and len(self._q) + 1 > self.max_queue_requests:
+    def _lane_caps(self) -> Tuple[int, int, Optional[int]]:
+        """Per-lane (row cap, request cap, watermark): the global
+        bounds split evenly (ceil) across lanes; 0/None = unbounded."""
+        n = self.n_lanes
+        cap_rows = -(-self.max_queue_rows // n) \
+            if self.max_queue_rows else 0
+        cap_reqs = -(-self.max_queue_requests // n) \
+            if self.max_queue_requests else 0
+        wm = self.shed_watermark_rows
+        wm_lane = None if wm is None else max(1, -(-int(wm) // n))
+        return cap_rows, cap_reqs, wm_lane
+
+    def _admission_reason(self, lane: _Lane, rows: int) -> Optional[str]:
+        """Why this submit must be rejected by ``lane``, or None.
+        Caller holds the lock.  A single oversized request against an
+        EMPTY lane always admits (it could otherwise never be served;
+        the engine chunks it), matching the max_batch_rows
+        oversized-single semantics."""
+        cap_rows, cap_reqs, wm = self._lane_caps()
+        if cap_reqs and len(lane.q) + 1 > cap_reqs:
             return "queue_requests"
         # effective row bound: the hard cap tightened by the adaptive
         # watermark (either may be unset)
-        cap = self.max_queue_rows
-        wm = self.shed_watermark_rows
-        eff = min(cap, wm) if (cap and wm is not None) \
-            else (wm if wm is not None else cap)
-        if eff and self._q_rows + rows > eff and (self._q or rows <= eff):
+        eff = min(cap_rows, wm) if (cap_rows and wm is not None) \
+            else (wm if wm is not None else cap_rows)
+        if eff and lane.q_rows + rows > eff and (lane.q or rows <= eff):
             return "shed_watermark" \
-                if wm is not None and eff != self.max_queue_rows \
-                else "queue_rows"
+                if wm is not None and eff != cap_rows else "queue_rows"
+        return None
+
+    # --------------------------------------------------------- routing
+    def _lane_load(self, lane: _Lane) -> float:
+        """Estimated ms of work ahead of a request routed here: queued
+        + in-flight rows weighted by the lane's measured per-row
+        dispatch EWMA (a neutral weight before any batch completed)."""
+        w = lane.ewma_ms_per_row
+        if w is None or w <= 0:
+            w = 1.0
+        return (lane.q_rows + lane.busy_rows) * w
+
+    def _pick_lane(self) -> _Lane:
+        """Least-loaded lane; ties (the all-idle closed loop) rotate
+        round-robin from the last pick so every device warms and the
+        fleet contract is measurable per device.  Caller holds the
+        lock."""
+        n = self.n_lanes
+        if n == 1:
+            return self._lanes[0]
+        if self.routing == "round_robin":
+            self._rr = (self._rr + 1) % n
+            return self._lanes[self._rr]
+        best, best_load = None, 0.0
+        for off in range(n):
+            lane = self._lanes[(self._rr + 1 + off) % n]
+            load = self._lane_load(lane)
+            if best is None or load < best_load:
+                best, best_load = lane, load
+        self._rr = best.index
+        return best
+
+    def _spill_lane(self, rows: int, exclude: int) -> Optional[_Lane]:
+        """Coldest OTHER lane that admits ``rows`` — tried before a
+        shed.  Caller holds the lock."""
+        cands = sorted((lane for lane in self._lanes
+                        if lane.index != exclude),
+                       key=self._lane_load)
+        for lane in cands:
+            if self._admission_reason(lane, rows) is None:
+                return lane
         return None
 
     # ------------------------------------------------------------------
@@ -211,7 +323,8 @@ class MicroBatcher:
         req = _Request(model_id, X, int(X.shape[0]), sparse, wall,
                        deadline_ms=eff_deadline)
         reject: Optional[ServeRejected] = None
-        with self._cv:
+        spilled = False
+        with self._mu:
             if self._stop or self._wedged:
                 exc = ServeWorkerWedged(
                     "MicroBatcher worker is wedged", model_id=model_id) \
@@ -220,12 +333,19 @@ class MicroBatcher:
                 req.future.set_exception(exc)
                 self._emit_failed(req, type(exc).__name__)
                 return req.future
-            reason = self._admission_reason(req.rows)
+            lane = self._pick_lane()
+            reason = self._admission_reason(lane, req.rows)
+            if reason is not None and self.n_lanes > 1:
+                # admission spill: the coldest lane with room takes the
+                # request before admission control sheds it
+                alt = self._spill_lane(req.rows, exclude=lane.index)
+                if alt is not None:
+                    lane, reason, spilled = alt, None, True
             if reason is None:
-                self._q.append(req)
-                self._q_rows += req.rows
-                depth, qrows = len(self._q), self._q_rows
-                self._cv.notify()
+                lane.q.append(req)
+                lane.q_rows += req.rows
+                gauges = self._queue_gauges_locked(lane)
+                lane.cv.notify()
             else:
                 reject = ServeRejected(
                     f"serving queue full ({reason}); retry after "
@@ -233,10 +353,11 @@ class MicroBatcher:
                     reason=reason,
                     retry_after_ms=self._retry_after_ms(),
                     queue_rows=self._q_rows,
-                    queue_requests=len(self._q), model_id=model_id)
+                    queue_requests=sum(len(ln.q) for ln in self._lanes),
+                    model_id=model_id)
         if reject is not None:
             # telemetry OUTSIDE the queue lock: a JSONL sink write must
-            # never serialize submitters against the worker
+            # never serialize submitters against the workers
             if self.tel is not None:
                 self.tel.inc("serve.rejected")
                 self.tel.inc("serve.rejected_rows", req.rows)
@@ -247,13 +368,54 @@ class MicroBatcher:
                         "serve_rejected", **reject.details()))
             raise reject
         if self.tel is not None:
-            self.tel.gauge("serve.queue_depth", depth)
-            self.tel.gauge("serve.queue_rows", qrows)
-            self.tel.gauge_max("serve.queue_peak_requests", depth)
-            self.tel.gauge_max("serve.queue_peak_rows", qrows)
+            self._publish_queue_gauges(gauges, peaks=True)
             self.tel.inc("serve.requests")
             self.tel.inc("serve.rows", req.rows)
+            if self.n_lanes > 1:
+                self.tel.inc(f"serve.d{lane.index}.requests")
+                self.tel.inc(f"serve.d{lane.index}.rows", req.rows)
+            if spilled:
+                self.tel.inc("serve.spills")
+                self.tel.inc(f"serve.d{lane.index}.spills")
+                now = time.perf_counter()
+                if now - self._last_spill_event > _REJECT_EVENT_PERIOD_S:
+                    self._last_spill_event = now
+                    self._record(lambda: self.tel.event(
+                        "serve_spill", model_id=model_id,
+                        rows=req.rows, to_device=lane.index))
         return req.future
+
+    # ---------------------------------------------------------- gauges
+    def _queue_gauges_locked(self, lane: Optional[_Lane] = None):
+        """Snapshot (aggregate depth, aggregate rows, [(lane, depth,
+        rows)]) under the lock; published outside it."""
+        agg_d = sum(len(ln.q) for ln in self._lanes)
+        agg_r = sum(ln.q_rows for ln in self._lanes)
+        per = None
+        if self.n_lanes > 1:
+            lanes = self._lanes if lane is None else [lane]
+            per = [(ln.index, len(ln.q), ln.q_rows) for ln in lanes]
+        return agg_d, agg_r, per
+
+    def _publish_queue_gauges(self, gauges, peaks: bool = False) -> None:
+        if self.tel is None:
+            return
+        agg_d, agg_r, per = gauges
+        self.tel.gauge("serve.queue_depth", agg_d)
+        self.tel.gauge("serve.queue_rows", agg_r)
+        if peaks:
+            self.tel.gauge_max("serve.queue_peak_requests", agg_d)
+            self.tel.gauge_max("serve.queue_peak_rows", agg_r)
+        for i, d, r in (per or ()):
+            self.tel.gauge(f"serve.d{i}.queue_depth", d)
+            self.tel.gauge(f"serve.d{i}.queue_rows", r)
+
+    def _regauge(self, lane: _Lane) -> None:
+        """Refresh the queue gauges from a worker (drain/shed paths) —
+        best-effort, never on the submit fast path's lock hold."""
+        with self._mu:
+            gauges = self._queue_gauges_locked(lane)
+        self._record(self._publish_queue_gauges, gauges)
 
     # ------------------------------------------------------- deadlines
     @staticmethod
@@ -282,7 +444,8 @@ class MicroBatcher:
             self._emit_failed(r, "ServeDeadlineExceeded")
 
     # ------------------------------------------------------------------
-    def _pull_same_model(self, model_id: str, cols: int, budget: int
+    def _pull_same_model(self, lane: _Lane, model_id: str, cols: int,
+                         budget: int
                          ) -> Tuple[List[_Request], List[_Request]]:
         """Remove queued DENSE requests for ``model_id`` with the SAME
         column count (a width mismatch must fail only its own request,
@@ -292,10 +455,10 @@ class MicroBatcher:
         happens outside the lock).  Caller holds the lock."""
         got, expired, keep = [], [], collections.deque()
         now = time.perf_counter()
-        while self._q:
-            r = self._q.popleft()
+        while lane.q:
+            r = lane.q.popleft()
             if self._expired(r, now):
-                self._q_rows -= r.rows
+                lane.q_rows -= r.rows
                 expired.append(r)
             elif (r.model_id == model_id and not r.sparse
                     and r.cols == cols and r.rows <= budget):
@@ -303,34 +466,34 @@ class MicroBatcher:
                 # so one micro-batch is one bucketed device dispatch
                 # (an oversized SINGLE request still chunks in the
                 # engine, but never drags neighbors past the cap)
-                self._q_rows -= r.rows
+                lane.q_rows -= r.rows
                 got.append(r)
                 budget -= r.rows
             else:
                 keep.append(r)
-        self._q = keep
+        lane.q = keep
         return got, expired
 
-    def _drain_queue_locked(self) -> List[_Request]:
-        drop = list(self._q)
-        self._q.clear()
-        self._q_rows = 0
+    def _drain_lane_locked(self, lane: _Lane) -> List[_Request]:
+        drop = list(lane.q)
+        lane.q.clear()
+        lane.q_rows = 0
         return drop
 
-    def _loop(self) -> None:
+    def _loop(self, lane: _Lane) -> None:
         while True:
             drop: Optional[List[_Request]] = None
-            with self._cv:
-                while not self._q and not self._stop \
+            with self._mu:
+                while not lane.q and not self._stop \
                         and not self._abort_drain:
-                    self._cv.wait()
+                    lane.cv.wait()
                 if self._abort_drain:
-                    drop = self._drain_queue_locked()
-                elif not self._q and self._stop:
+                    drop = self._drain_lane_locked(lane)
+                elif not lane.q and self._stop:
                     return
                 else:
-                    first = self._q.popleft()
-                    self._q_rows -= first.rows
+                    first = lane.q.popleft()
+                    lane.q_rows -= first.rows
             if drop is not None:
                 # bounded drain expired: shutdown must shed the
                 # remaining queue with structured errors, not block
@@ -344,23 +507,24 @@ class MicroBatcher:
             now = time.perf_counter()
             if self._expired(first, now):
                 self._shed([first])
+                self._regauge(lane)
                 continue
             batch = [first]
             rows = first.rows
             if not first.sparse:
                 deadline = first.t_submit + self.max_delay_s
                 while rows < self.max_batch_rows:
-                    with self._cv:
+                    with self._mu:
                         more, expired = self._pull_same_model(
-                            first.model_id, first.cols,
+                            lane, first.model_id, first.cols,
                             self.max_batch_rows - rows)
                         if not more and not expired:
                             remaining = deadline - time.perf_counter()
                             if remaining <= 0:
                                 break
-                            self._cv.wait(remaining)
+                            lane.cv.wait(remaining)
                             more, expired = self._pull_same_model(
-                                first.model_id, first.cols,
+                                lane, first.model_id, first.cols,
                                 self.max_batch_rows - rows)
                     if expired:
                         self._shed(expired)
@@ -369,7 +533,7 @@ class MicroBatcher:
                         rows += sum(r.rows for r in more)
                     elif time.perf_counter() >= deadline:
                         break
-            self._run_batch(first.model_id, batch, rows)
+            self._run_batch(lane, first.model_id, batch, rows)
 
     def _emit_failed(self, req: "_Request", error: str) -> None:
         """serve_access for a request that never reached a dispatch
@@ -388,9 +552,9 @@ class MicroBatcher:
         self._record(_go)
 
     def _record(self, fn, *args, **kwargs) -> None:
-        """Telemetry from the worker thread must be best-effort: a
+        """Telemetry from a worker thread must be best-effort: a
         failing sink (disk full under telemetry_out) would otherwise
-        unwind _loop, kill the only worker and wedge every future
+        unwind _loop, kill the lane's worker and wedge every future
         request behind a healthy device."""
         if self.tel is None:
             return
@@ -409,28 +573,32 @@ class MicroBatcher:
             self._faults = faults
         self._faults.on_serve_batch(self.tel, seq)
 
-    def _run_batch(self, model_id: str, batch: List[_Request],
-                   rows: int) -> None:
+    def _run_batch(self, lane: _Lane, model_id: str,
+                   batch: List[_Request], rows: int) -> None:
         # re-gauge on drain too: submit-only updates would leave an
         # idle service reporting its last (peak) backlog forever
-        self._record(lambda: (self.tel.gauge("serve.queue_depth",
-                                             len(self._q)),
-                              self.tel.gauge("serve.queue_rows",
-                                             self._q_rows)))
-        self._batch_seq += 1
-        seq = self._batch_seq
-        self._inflight = batch
+        self._regauge(lane)
+        with self._mu:
+            self._batch_seq += 1
+            seq = self._batch_seq
+        lane.inflight = batch
+        lane.busy_rows = rows
         t0 = time.perf_counter()
         wait_ms = (t0 - batch[0].t_submit) * 1000.0
         # request-scoped batch context: the engine annotates bucket /
         # dispatch wall / degradation from inside the dispatch without
         # the batcher knowing its internals (obs/reqtrace.py)
-        reqtrace.begin_batch(model_id)
+        reqtrace.begin_batch(model_id,
+                             device=lane.index if self.n_lanes > 1
+                             else None)
         try:
             self._fault_hook(seq)
             X = batch[0].X if len(batch) == 1 else np.concatenate(
                 [r.X for r in batch], axis=0)
-            out = self._dispatch(model_id, X)
+            if self.n_lanes > 1:
+                out = self._dispatch(model_id, X, lane.index)
+            else:
+                out = self._dispatch(model_id, X)
             out = np.asarray(out)
         except Exception as exc:  # resolve, don't wedge
             ctx = reqtrace.end_batch()
@@ -438,7 +606,8 @@ class MicroBatcher:
                          else time.time())
             for r in batch:
                 _resolve(r.future, exc=exc)
-            self._inflight = []
+            lane.inflight = []
+            lane.busy_rows = 0
 
             def _error_telemetry():
                 self.tel.inc("serve.batch_errors")
@@ -465,20 +634,30 @@ class MicroBatcher:
         for r in batch:
             _resolve(r.future, result=out[c0:c0 + r.rows])
             c0 += r.rows
-        self._inflight = []
+        lane.inflight = []
+        lane.busy_rows = 0
         batch_ms = (done - t0) * 1000.0
-        # drain-rate EWMA feeding the rejection retry_after hint (plain
-        # attributes: worker-written, submitter-read, GIL-atomic)
+        # drain-rate EWMAs: the global pair feeds the rejection
+        # retry_after hint; the per-lane ms/row feeds least-loaded
+        # routing (plain attributes: worker-written, submitter-read,
+        # GIL-atomic)
         a = 0.2
         self._ewma_batch_ms = batch_ms if self._ewma_batch_ms is None \
             else (1 - a) * self._ewma_batch_ms + a * batch_ms
         self._ewma_batch_rows = float(rows) \
             if self._ewma_batch_rows is None \
             else (1 - a) * self._ewma_batch_rows + a * rows
+        ms_per_row = batch_ms / max(1, rows)
+        lane.ewma_ms_per_row = ms_per_row \
+            if lane.ewma_ms_per_row is None \
+            else (1 - a) * lane.ewma_ms_per_row + a * ms_per_row
 
         def _batch_telemetry():
             self.tel.inc("serve.batches")
             self.tel.dist("serve.batch_rows", rows)
+            if self.n_lanes > 1:
+                self.tel.inc(f"serve.d{lane.index}.batches")
+                self.tel.dist(f"serve.d{lane.index}.batch_ms", batch_ms)
             for r in batch:
                 self.tel.dist("serve.latency_ms",
                               (done - r.t_submit) * 1000.0)
@@ -491,7 +670,9 @@ class MicroBatcher:
                                rows=rows, requests=len(batch),
                                wait_ms=round(wait_ms, 3),
                                exec_ms=round(batch_ms, 3),
-                               trace_ids=[r.trace_id for r in batch])
+                               trace_ids=[r.trace_id for r in batch],
+                               **({} if self.n_lanes == 1
+                                  else {"device": lane.index}))
             if self.memory_watermarks:
                 # serving dispatch boundary: the allocator peak just
                 # moved (or didn't) — refresh the per-device HBM gauges
@@ -510,49 +691,63 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     def close(self, drain: bool = True,
               drain_timeout_s: Optional[float] = None) -> None:
-        """Stop the worker.  ``drain=True`` serves what is already
-        queued first, bounded by ``drain_timeout_s`` (default 30 s):
-        when the bound expires, the remaining queue is shed with
-        structured ``ServeClosed`` errors instead of blocking shutdown
-        indefinitely.  ``drain=False`` fails queued requests
-        immediately.  A worker that does not exit even after the
-        aborted drain (stuck inside a device dispatch) is declared
-        WEDGED: queued + in-flight futures are failed with
+        """Stop the workers.  ``drain=True`` serves what is already
+        queued first, bounded by ``drain_timeout_s`` (default 30 s,
+        shared across lanes): when the bound expires, the remaining
+        queues are shed with structured ``ServeClosed`` errors instead
+        of blocking shutdown indefinitely.  ``drain=False`` fails
+        queued requests immediately.  A worker that does not exit even
+        after the aborted drain (stuck inside a device dispatch) is
+        declared WEDGED: queued + in-flight futures are failed with
         ``ServeWorkerWedged`` and a ``serve_worker_wedged`` event fires
         — never a silent leak of unresolved futures."""
-        with self._cv:
+        with self._mu:
             self._stop = True
-            dropped = []
+            dropped: List[_Request] = []
             if not drain:
-                dropped = self._drain_queue_locked()
+                for lane in self._lanes:
+                    dropped.extend(self._drain_lane_locked(lane))
                 for r in dropped:
                     _resolve(r.future,
                              exc=ServeClosed("MicroBatcher closed",
                                              model_id=r.model_id))
-            self._cv.notify_all()
+            for lane in self._lanes:
+                lane.cv.notify_all()
         for r in dropped:
             self._emit_failed(r, "MicroBatcherClosed")
         timeout = 30.0 if drain_timeout_s is None \
             else max(0.0, float(drain_timeout_s))
-        self._worker.join(timeout=timeout)
-        if not self._worker.is_alive():
+        # one shared deadline: the drain bound covers the whole fleet,
+        # not timeout × n_lanes
+        deadline = time.perf_counter() + timeout
+        for lane in self._lanes:
+            lane.worker.join(
+                timeout=max(0.0, deadline - time.perf_counter()))
+        if not any(lane.worker.is_alive() for lane in self._lanes):
             return
-        # bounded drain expired: tell the worker to stop serving the
-        # backlog and shed it (structured errors) on its way out
-        with self._cv:
+        # bounded drain expired: tell the workers to stop serving the
+        # backlog and shed it (structured errors) on their way out
+        with self._mu:
             self._abort_drain = True
-            self._cv.notify_all()
-        self._worker.join(timeout=_WEDGE_GRACE_S)
-        if not self._worker.is_alive():
+            for lane in self._lanes:
+                lane.cv.notify_all()
+        grace = time.perf_counter() + _WEDGE_GRACE_S
+        for lane in self._lanes:
+            if lane.worker.is_alive():
+                lane.worker.join(
+                    timeout=max(0.0, grace - time.perf_counter()))
+        if not any(lane.worker.is_alive() for lane in self._lanes):
             return
-        # the worker ignored the abort: it is wedged inside a dispatch
+        # a worker ignored the abort: it is wedged inside a dispatch
         # (hung device, injected serve_wedge_worker).  Fail everything
         # it will never serve — _resolve is race-tolerant, so if the
         # worker ever does come back its own delivery no-ops.
         self._wedged = True
-        with self._cv:
-            drop = self._drain_queue_locked()
-        inflight = list(self._inflight)
+        with self._mu:
+            drop = []
+            for lane in self._lanes:
+                drop.extend(self._drain_lane_locked(lane))
+        inflight = self._inflight
         exc = ServeWorkerWedged(
             "serving worker did not exit within the close timeout "
             "(wedged inside a dispatch); queued and in-flight requests "

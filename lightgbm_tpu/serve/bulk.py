@@ -1,0 +1,170 @@
+"""Row-sharded bulk scoring over the serve mesh.
+
+The micro-batcher's latency path tops out at one device per dispatch —
+right for small online requests, wasteful for the offline/giant-batch
+jobs (backfills, batch re-scoring) the fleet could swallow whole.
+:class:`BulkScorer` shard_maps the SAME jitted stacked-tree traversal
+the serving engine dispatches (models/predictor ``_run_*_body``)
+row-wise over a 1-D mesh of the serve devices — the packed tree
+tensors ride as replicated read-only operands, the exact shape of the
+PR 12 training megastep:
+
+- rows are chunked to ``n_devices × max_shard_rows``, each chunk's
+  per-device shard padded up to a power of two (its own compile-cache
+  bucket, so a steady bulk stream recompiles nothing);
+- per-row math is the identical f32 scan the single-device dispatch
+  runs, so ``predict_bulk`` is numerically interchangeable with the
+  online path (asserted in tests/test_serve_fleet.py);
+- compiles/dispatches count against the engine's process-wide
+  signature registry under ``serve.bulk_*`` counters, and every call
+  emits one ``serve_bulk`` event (rows, devices, wall, rows/s) — the
+  ``fleet:`` summary line's bulk throughput source.
+
+Eligibility: a device-routable engine (``engine.device_ok``); degraded
+models fall back to the engine's host walk in the service before a
+scorer is ever built.
+"""
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..models.predictor import (_round_up_pow2, _run_binned_body,
+                                _run_raw_body)
+from .engine import _COMPILED_SIGS, _SIG_LOCK
+
+# per-device shard-rows cap (power of two): bounds a single sharded
+# dispatch's padded buffer; chunks beyond n_devices × this loop
+_MAX_SHARD_ROWS = 1 << 16
+
+
+class BulkScorer:
+    """shard_map'ed scorer for ONE packed model over the serve mesh."""
+
+    def __init__(self, engine, devices: Sequence,
+                 telemetry=None, max_shard_rows: int = _MAX_SHARD_ROWS):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import mesh as mesh_mod
+        if engine.pred is None:
+            raise ValueError("BulkScorer needs a device-routable engine")
+        self.eng = engine
+        self.pred = engine.pred
+        self.k = engine.k
+        self.model_hash = engine.model_hash
+        self.tel = telemetry
+        self.devices = list(devices)
+        self.n_devices = len(self.devices)
+        self.max_shard_rows = _round_up_pow2(max(2, int(max_shard_rows)))
+        self.mesh = mesh_mod.make_mesh(devices=self.devices)
+        self.dispatches = 0
+        self.compiles = 0
+
+        ops = self.pred.run_args(engine.lo, engine.hi)
+        mask = tuple(a is not None for a in ops)
+        # replicate the packed stacks once (read-only operands on every
+        # device — the grower-megastep layout); scalar statics ride
+        # along un-placed, jit re-stages them
+        rep = NamedSharding(self.mesh, P())
+        self._present = tuple(
+            jax.device_put(a, rep) if hasattr(a, "shape")
+            and getattr(a, "ndim", 0) > 0 else a
+            for a in ops if a is not None)
+        body = _run_binned_body if self.pred.variant == "binned" \
+            else _run_raw_body
+        k, max_steps = self.k, self.pred.max_steps
+
+        def _shard(enc, *present):
+            it = iter(present)
+            full = [next(it) if m else None for m in mask]
+            return body(enc, *full, k=k, max_steps=max_steps)
+
+        in_specs = (P(mesh_mod.DATA_AXIS, None),) \
+            + tuple(P() for _ in self._present)
+        out_specs = P(None, mesh_mod.DATA_AXIS)
+        self._fn = jax.jit(mesh_mod.shard_map(
+            _shard, self.mesh, in_specs, out_specs))
+        # deterministic compile accounting: same process-wide registry
+        # the online engines count against, "bulk"-prefixed so a bulk
+        # bucket never aliases an online one
+        self._sig_base = (
+            "bulk", self.pred.variant, self.k, self.pred.max_steps,
+            self.pred.enc_width, self.pred.enc_dtype,
+            tuple(getattr(d, "id", i)
+                  for i, d in enumerate(self.devices)),
+            tuple((tuple(a.shape), str(a.dtype))
+                  if hasattr(a, "shape") else None
+                  for a in self._present))
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X) -> np.ndarray:
+        """Raw scores [k, n] float64 — one sharded dispatch per
+        ``n_devices × shard`` chunk, each device traversing its own
+        row shard against the replicated tree stacks."""
+        from ..basic import _is_scipy_sparse
+        sparse_in = _is_scipy_sparse(X)
+        if sparse_in:
+            X = X.tocsr()
+        n = int(X.shape[0])
+        out = np.zeros((self.k, n), np.float64)
+        if n == 0:
+            return out
+        d = self.n_devices
+        step = d * self.max_shard_rows
+        t_all = time.perf_counter()
+        compiles = dispatches = 0
+        for c0 in range(0, n, step):
+            sl = slice(c0, min(n, c0 + step))
+            Xc = X[sl].toarray() if sparse_in else X[sl]
+            rows = Xc.shape[0]
+            shard = min(self.max_shard_rows,
+                        _round_up_pow2(max(2, -(-rows // d))))
+            padded = shard * d
+            enc = self.pred.encode(np.asarray(Xc))
+            if enc.shape[0] < padded:
+                pad = np.zeros((padded - enc.shape[0], enc.shape[1]),
+                               enc.dtype)
+                enc = np.concatenate([enc, pad], axis=0)
+            sig = self._sig_base + (shard,)
+            with _SIG_LOCK:
+                fresh = sig not in _COMPILED_SIGS
+            raw = self._fn(enc, *self._present)
+            out[:, sl] = np.asarray(raw, np.float64)[:, :rows]
+            # register only after the call returned (same rule as the
+            # engine: a failed first dispatch must not blind the gates)
+            if fresh:
+                with _SIG_LOCK:
+                    if sig in _COMPILED_SIGS:
+                        fresh = False
+                    else:
+                        _COMPILED_SIGS.add(sig)
+            if fresh:
+                compiles += 1
+            dispatches += 1
+        self.dispatches += dispatches
+        self.compiles += compiles
+        wall = time.perf_counter() - t_all
+        if self.tel is not None:
+            try:
+                self.tel.inc("serve.bulk_dispatches", dispatches)
+                self.tel.inc("serve.bulk_rows", n)
+                if compiles:
+                    self.tel.inc("serve.bulk_compiles", compiles)
+                self.tel.event(
+                    "serve_bulk", model_id=self.eng.model_id,
+                    rows=n, devices=d, dispatches=dispatches,
+                    compiles=compiles, wall_ms=round(wall * 1000.0, 3),
+                    rows_per_s=round(n / wall, 1) if wall > 0 else 0.0)
+            except Exception:
+                pass   # monitoring must never fail a prediction
+        return out
+
+    def stats(self) -> dict:
+        return {"model_hash": self.model_hash[:16],
+                "devices": self.n_devices,
+                "dispatches": self.dispatches,
+                "compiles": self.compiles,
+                "max_shard_rows": self.max_shard_rows}

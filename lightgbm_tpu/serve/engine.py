@@ -49,6 +49,22 @@ def _is_sparse(X) -> bool:
     return _is_scipy_sparse(X)
 
 
+def _commit(a, device):
+    """Place one packed operand on ``device`` (committed), skipping the
+    copy when the buffer already lives there — identity is what the
+    byte accounting below keys on, so an alias must stay an alias."""
+    if a is None or not hasattr(a, "devices"):
+        return a           # python/np scalar operand: jit re-stages it
+    try:
+        devs = a.devices()
+        if len(devs) == 1 and next(iter(devs)) == device:
+            return a
+    except Exception:
+        pass
+    import jax
+    return jax.device_put(a, device)
+
+
 class ServingEngine:
     """Device-resident predictor for ONE booster state."""
 
@@ -61,17 +77,32 @@ class ServingEngine:
                  drift_enabled: bool = True,
                  drift_psi_threshold: float = 0.2,
                  drift_eval_rows: int = 512,
-                 drift_hysteresis: int = 2):
+                 drift_hysteresis: int = 2,
+                 device=None, device_index: int = 0,
+                 shared: Optional["ServingEngine"] = None):
         self.booster = booster
         self.model_id = model_id
         self.tel = telemetry
+        # fleet placement: ``device`` commits this engine's operand
+        # copies (and every dispatch) to ONE local device; ``shared``
+        # points at the base replica whose host-side packing this one
+        # reuses — one pack per model, N device placements.  Both None
+        # = the single-device pre-fleet engine, byte-for-byte.
+        self.device = device
+        self.device_index = int(device_index)
+        self._dtag = None if device is None else f"d{self.device_index}"
+        self._owns_pred = shared is None
         booster._drain()
-        # version identity: every response is attributable to exactly
-        # one packed model state (serve_access model_version field, the
-        # serve_rollover old/new hashes).  rank=-1 skips the health
-        # fault salt — this must describe the REAL state.
-        from ..obs.health import model_state_hash
-        self.model_hash = model_state_hash(booster.models, rank=-1)
+        if shared is not None:
+            self.model_hash = shared.model_hash
+        else:
+            # version identity: every response is attributable to
+            # exactly one packed model state (serve_access
+            # model_version field, the serve_rollover old/new hashes).
+            # rank=-1 skips the health fault salt — this must describe
+            # the REAL state.
+            from ..obs.health import model_state_hash
+            self.model_hash = model_state_hash(booster.models, rank=-1)
         self.k = max(1, booster.num_tree_per_iteration)
         total_iter = len(booster.models) // self.k
         if num_iteration is None:
@@ -99,45 +130,82 @@ class ServingEngine:
         # warmup flushes inline (cold path anyway).  Mode follows the
         # cost_ledger config key like training's ledger does.
         self._cost = None
+        # fleet: post-batch flushes run on EVERY lane worker while other
+        # lanes keep dispatching — note/flush serialize on this lock
+        self._cost_lock = threading.Lock()
         if telemetry is not None and cost_ledger != "off":
             from ..obs.cost import CostLedger
             self._cost = CostLedger(telemetry, cost_ledger)
 
-        ts = getattr(booster, "train_set", None)
-        if ts is not None and getattr(ts, "_inner", None) is not None:
-            self.variant = "binned"
-            self.pred = DevicePredictor(booster.models, ts._inner, self.k)
+        if shared is not None:
+            self.variant = shared.variant
+            self.pred = shared.pred
+            self.device_ok = self.pred is not None and num_iteration > 0
+            self.degraded_reason = "" if self.device_ok else \
+                (shared.degraded_reason or "no_trees")
         else:
-            self.variant = "raw"
-            self.pred = RawDevicePredictor(
-                booster.models, booster.max_feature_idx + 1, self.k)
-        self.device_ok = bool(self.pred.ok) and num_iteration > 0
-        self.degraded_reason = "" if self.device_ok else \
-            (self.pred.reason or "no_trees")
+            ts = getattr(booster, "train_set", None)
+            if ts is not None and getattr(ts, "_inner", None) is not None:
+                self.variant = "binned"
+                self.pred = DevicePredictor(booster.models, ts._inner,
+                                            self.k)
+            else:
+                self.variant = "raw"
+                self.pred = RawDevicePredictor(
+                    booster.models, booster.max_feature_idx + 1, self.k)
+            self.device_ok = bool(self.pred.ok) and num_iteration > 0
+            self.degraded_reason = "" if self.device_ok else \
+                (self.pred.reason or "no_trees")
         if not self.device_ok:
             self.pred = None
-            self._event("serve_degradation", model_id=model_id,
-                        reason=self.degraded_reason)
-            self._inc("serve.degradations")
+            self._resident_nbytes = 0
+            if shared is None:
+                self._event("serve_degradation", model_id=model_id,
+                            reason=self.degraded_reason)
+                self._inc("serve.degradations")
         else:
             # [lo, hi) is fixed for the engine's lifetime: slice the
             # packed operands ONCE (per-dispatch re-slicing would be
             # ~10 eager device ops per micro-batch — the exact overhead
             # this engine exists to amortize) and derive the signature
             # base the per-bucket compile-cache key extends
-            self._operands = self.pred.run_args(self.lo, self.hi)
+            ops = self.pred.run_args(self.lo, self.hi)
+            if device is not None:
+                ops = tuple(_commit(a, device) for a in ops)
+            self._operands = ops
+            # honest byte accounting (audited against live device
+            # buffers in tests/test_serve_fleet.py): the base packing
+            # is charged once, to the engine that owns it; operand
+            # buffers that are NOT the packed arrays themselves
+            # (sub-range slices, replica copies on another device) are
+            # charged on top.  The old estimate summed pred.packed
+            # regardless, missing the duplicate-slice / replica bytes.
+            packed_ids = {id(x) for x in self.pred._packed
+                          if x is not None}
+            extra = sum(int(a.nbytes) for a in self._operands
+                        if a is not None and hasattr(a, "devices")
+                        and id(a) not in packed_ids)
+            self._resident_nbytes = extra + (
+                self.pred.packed_nbytes if self._owns_pred else 0)
             self._sig_base = (
                 self.pred.variant, self.k, self.pred.max_steps,
                 # the encoded-rows operand's width/dtype fork compiled
                 # programs too — tree-stack shapes alone are not enough
                 self.pred.enc_width, self.pred.enc_dtype,
-                tuple(None if a is None
-                      else (tuple(a.shape), str(a.dtype))
+                # committed placements fork executables per device —
+                # the registry must model that or the per-replica
+                # warmup compiles would read as cache hits
+                None if device is None else getattr(
+                    device, "id", self.device_index),
+                tuple(None if a is None or not hasattr(a, "shape")
+                      else (tuple(a.shape), str(getattr(a, "dtype", "")))
                       for a in self._operands))
         self._event("serve_model_loaded", model_id=model_id,
                     variant=self.variant, device=self.device_ok,
                     trees=self.hi - self.lo,
-                    bytes=self.packed_nbytes)
+                    bytes=self.packed_nbytes,
+                    **({} if self._dtag is None
+                       else {"device_index": self.device_index}))
 
         # drift monitor (obs/drift.py): fed host-side from batches this
         # engine already encoded/predicted — zero extra device
@@ -147,7 +215,11 @@ class ServingEngine:
         self.drift = None
         self._warming = False
         profile = getattr(booster, "data_profile", None)
-        if drift_enabled:
+        if shared is not None:
+            # replicas share ONE monitor (it locks internally): drift
+            # is a per-model signal, not a per-device one
+            self.drift = shared.drift
+        elif drift_enabled:
             if profile:
                 from ..obs.drift import DriftMonitor
                 self.drift = DriftMonitor(
@@ -171,7 +243,10 @@ class ServingEngine:
     # ------------------------------------------------------------------
     @property
     def packed_nbytes(self) -> int:
-        return 0 if self.pred is None else self.pred.packed_nbytes
+        """Device bytes THIS engine keeps alive (base packing if it
+        owns it + any slice/replica operand copies) — the residency
+        manager's per-device accounting unit."""
+        return 0 if self.pred is None else self._resident_nbytes
 
     def buckets(self) -> List[int]:
         """All power-of-two bucket sizes this engine pads into."""
@@ -225,11 +300,18 @@ class ServingEngine:
         # warmup activity is accounted separately so steady-state rates
         # (dispatches_per_request, compiles_per_1k_requests) can be
         # computed off the lifetime counters without warmup skew
+        nd = self.dispatches - dispatches_before
         self._inc("serve.warmup_compiles", n)
-        self._inc("serve.warmup_dispatches",
-                  self.dispatches - dispatches_before)
+        self._inc("serve.warmup_dispatches", nd)
+        if self._dtag:
+            # per-device warmup accounting: the fleet's per-device
+            # steady-state rates subtract these, same as the aggregate
+            self._inc(f"serve.{self._dtag}.warmup_compiles", n)
+            self._inc(f"serve.{self._dtag}.warmup_dispatches", nd)
         self._event("serve_warmup", model_id=self.model_id,
-                    buckets=warmed, compiles=n)
+                    buckets=warmed, compiles=n,
+                    **({} if self._dtag is None
+                       else {"device_index": self.device_index}))
         return {"warmed": warmed, "compiles": n, "degraded": False}
 
     def _encode_pad(self, Xc: np.ndarray, bucket: int) -> np.ndarray:
@@ -241,6 +323,7 @@ class ServingEngine:
         return enc
 
     def _dispatch(self, enc: np.ndarray, bucket: int):
+        import jax
         import jax.numpy as jnp
 
         from ..models.predictor import stacked_run_fn
@@ -248,8 +331,12 @@ class ServingEngine:
         with _SIG_LOCK:
             fresh = sig not in _COMPILED_SIGS
         t0 = time.perf_counter() if fresh else 0.0
+        # committed request buffer: the computation follows the replica's
+        # device, not the process default
+        enc_dev = jnp.asarray(enc) if self.device is None \
+            else jax.device_put(enc, self.device)
         out = stacked_run_fn(self.pred.variant)(
-            jnp.asarray(enc), *self._operands, k=self.k,
+            enc_dev, *self._operands, k=self.k,
             max_steps=self.pred.max_steps)
         # register only AFTER the call returns: a failed first dispatch
         # (transient device error) must not mark the signature compiled,
@@ -266,6 +353,8 @@ class ServingEngine:
                 with self._lock:
                     self.compiles += 1
                 self._inc("serve.compiles")
+                if self._dtag:
+                    self._inc(f"serve.{self._dtag}.compiles")
                 reqtrace.annotate(compiles=1)
                 # per-executable compile record: the jit cache key,
                 # the first-call wall (trace + XLA compile — the call
@@ -288,17 +377,20 @@ class ServingEngine:
                 if self._cost is not None:
                     # avals only (shape/dtype) — the np buffer itself
                     # never reaches the ledger, donation-safe
-                    self._cost.note(
-                        stacked_run_fn(self.pred.variant),
-                        (enc,) + tuple(self._operands),
-                        sig_str, kind="serve_bucket", scale=bucket,
-                        kwargs={"k": self.k,
-                                "max_steps": self.pred.max_steps},
-                        operand_bytes=op_bytes,
-                        model_id=self.model_id, bucket=bucket)
+                    with self._cost_lock:
+                        self._cost.note(
+                            stacked_run_fn(self.pred.variant),
+                            (enc,) + tuple(self._operands),
+                            sig_str, kind="serve_bucket", scale=bucket,
+                            kwargs={"k": self.k,
+                                    "max_steps": self.pred.max_steps},
+                            operand_bytes=op_bytes,
+                            model_id=self.model_id, bucket=bucket)
         with self._lock:
             self.dispatches += 1
         self._inc("serve.dispatches")
+        if self._dtag:
+            self._inc(f"serve.{self._dtag}.dispatches")
         reqtrace.annotate(dispatches=1, bucket=bucket)
         return out
 
@@ -326,8 +418,10 @@ class ServingEngine:
             # reports per request (summed across an oversized request's
             # chunks)
             out[:, sl] = np.asarray(raw, np.float64)[:, :rows]
-            reqtrace.annotate(
-                dispatch_ms=(time.perf_counter() - t0) * 1000.0)
+            disp_ms = (time.perf_counter() - t0) * 1000.0
+            reqtrace.annotate(dispatch_ms=disp_ms)
+            if self._dtag and self.tel is not None:
+                self.tel.dist(f"serve.{self._dtag}.dispatch_ms", disp_ms)
             self._drift_accumulate(enc[:rows], Xc, out[:, sl])
         return out
 
@@ -392,7 +486,14 @@ class ServingEngine:
         cost = self._cost
         if cost is None or not cost.has_pending:
             return
-        cost.flush()
+        # best-effort under contention: another lane worker mid-flush
+        # keeps the pending entries for the next post-batch hook
+        if not self._cost_lock.acquire(blocking=False):
+            return
+        try:
+            cost.flush()
+        finally:
+            self._cost_lock.release()
         ent = cost.entry("serve_bucket")
         if ent is not None and self.tel is not None and ent["scale"] > 0:
             self.tel.gauge("cost.serve.flops_per_row",
@@ -413,6 +514,8 @@ class ServingEngine:
                    "dispatches": self.dispatches,
                    "host_rows": self.host_rows,
                    "buckets": self.buckets()}
+            if self._dtag is not None:
+                out["device_index"] = self.device_index
         if self.drift is not None:
             out["drift"] = {
                 "alerts": self.drift.alerts,

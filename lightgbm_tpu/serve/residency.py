@@ -1,8 +1,9 @@
-"""Multi-model device residency under a bytes budget.
+"""Multi-model device residency under a bytes budget, per device.
 
-One process serves N boosters off one device.  Packed tree tensors are
-small relative to training state but not free — a fleet of wide
-multiclass models can exceed device memory — so residency is explicit:
+One process serves N boosters off the serve fleet.  Packed tree
+tensors are small relative to training state but not free — a fleet of
+wide multiclass models can exceed device memory — so residency is
+explicit:
 
 - engines build lazily on first use and stay resident;
 - every build charges the engine's ``packed_nbytes`` against
@@ -17,37 +18,62 @@ multiclass models can exceed device memory — so residency is explicit:
   ``serve_budget_exceeded`` event (the operator's signal to raise the
   budget or unpin).
 
+Fleet mode (``devices=[...]``): one replica table per serve device.
+``get(model_id, device)`` returns that device's replica, building it
+from the device-0 replica's host-side packing (one pack per model, N
+placements — ``ServingEngine(shared=...)``); LRU recency, eviction and
+``budget_bytes`` apply PER DEVICE (the budget is each device's
+memory, not the fleet's sum).  ``swap`` installs a full replica set in
+one critical section, so a fleet rollover is atomic: no mix of model
+versions across devices is ever observable.  With ``devices=None``
+everything collapses to the single-device pre-fleet behavior.
+
 Telemetry: ``serve.evictions`` / ``serve.rebuilds`` counters,
-``serve.resident_bytes`` / ``serve.resident_models`` gauges,
+``serve.resident_bytes`` / ``serve.resident_models`` gauges (plus
+``serve.d<i>.resident_bytes`` / ``resident_models`` in fleet mode),
 ``serve_eviction`` events.
 """
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from .engine import ServingEngine
 
 
 class ResidencyManager:
-    """LRU cache of :class:`ServingEngine` instances under a budget."""
+    """LRU cache of :class:`ServingEngine` replicas under a per-device
+    budget."""
 
     def __init__(self, budget_bytes: Optional[int] = None,
                  telemetry=None,
                  engine_factory: Optional[Callable[..., ServingEngine]]
-                 = None, **engine_knobs: Any):
+                 = None, devices: Optional[Sequence] = None,
+                 **engine_knobs: Any):
         self.budget_bytes = None if budget_bytes is None \
             else int(budget_bytes)
         self.tel = telemetry
         self._factory = engine_factory or ServingEngine
         self._knobs = engine_knobs
+        # fleet placement: one replica table per device; devices=None
+        # = the legacy single-device manager (engines built without
+        # placement kwargs, so custom factories keep working unchanged)
+        self.devices = list(devices) if devices else None
+        self.n_devices = len(self.devices) if self.devices else 1
         self._boosters: Dict[str, Any] = {}
-        self._engines: "collections.OrderedDict[str, ServingEngine]" = \
+        self._tables: List[
+            "collections.OrderedDict[str, ServingEngine]"] = [
             collections.OrderedDict()      # LRU: oldest first
+            for _ in range(self.n_devices)]
         self._pinned = set()
         self._builds: Dict[str, int] = {}
         self._lock = threading.RLock()
+
+    # legacy single-table alias (tests/tools introspect it)
+    @property
+    def _engines(self) -> "collections.OrderedDict[str, ServingEngine]":
+        return self._tables[0]
 
     # ------------------------------------------------------------------
     def register(self, model_id: str, booster) -> None:
@@ -65,36 +91,64 @@ class ResidencyManager:
     @property
     def resident_bytes(self) -> int:
         with self._lock:
-            return sum(e.packed_nbytes for e in self._engines.values())
+            return sum(e.packed_nbytes for t in self._tables
+                       for e in t.values())
+
+    def resident_bytes_on(self, device: int) -> int:
+        with self._lock:
+            return sum(e.packed_nbytes
+                       for e in self._tables[device].values())
 
     # ------------------------------------------------------------------
-    def get(self, model_id: str) -> ServingEngine:
-        """The engine for ``model_id``, building (or re-building after an
-        eviction) on demand and touching LRU recency."""
+    def _build_key(self, model_id: str, device: int) -> str:
+        return model_id if self.devices is None \
+            else f"{model_id}@d{device}"
+
+    def _build_locked(self, model_id: str, device: int) -> ServingEngine:
+        booster = self._boosters.get(model_id)
+        if booster is None:
+            raise KeyError(f"unknown model_id: {model_id!r}")
+        kw = dict(self._knobs)
+        if self.devices is not None:
+            kw["device"] = self.devices[device]
+            kw["device_index"] = device
+            # reuse an existing replica's host-side packing: one pack
+            # per model, N device placements
+            for t in self._tables:
+                if model_id in t:
+                    kw["shared"] = t[model_id]
+                    break
+        eng = self._factory(booster, model_id=model_id,
+                            telemetry=self.tel, **kw)
+        bk = self._build_key(model_id, device)
+        self._builds[bk] = self._builds.get(bk, 0) + 1
+        if self._builds[bk] > 1 and self.tel is not None:
+            self.tel.inc("serve.rebuilds")
+        return eng
+
+    def get(self, model_id: str, device: int = 0) -> ServingEngine:
+        """The engine replica for ``model_id`` on ``device``, building
+        (or re-building after an eviction) on demand and touching LRU
+        recency."""
         with self._lock:
-            eng = self._engines.get(model_id)
+            table = self._tables[device]
+            eng = table.get(model_id)
             if eng is not None:
-                self._engines.move_to_end(model_id)
+                table.move_to_end(model_id)
                 return eng
-            booster = self._boosters.get(model_id)
-            if booster is None:
-                raise KeyError(f"unknown model_id: {model_id!r}")
-            eng = self._factory(booster, model_id=model_id,
-                                telemetry=self.tel, **self._knobs)
-            self._builds[model_id] = self._builds.get(model_id, 0) + 1
-            if self._builds[model_id] > 1 and self.tel is not None:
-                self.tel.inc("serve.rebuilds")
-            self._engines[model_id] = eng
-            self._evict_to_budget(keep=model_id)
+            eng = self._build_locked(model_id, device)
+            table[model_id] = eng
+            self._evict_to_budget(device, keep=model_id)
             self._update_gauges()
             return eng
 
-    def _evict_to_budget(self, keep: str) -> None:
+    def _evict_to_budget(self, device: int, keep: str) -> None:
         if self.budget_bytes is None:
             return
-        total = sum(e.packed_nbytes for e in self._engines.values())
+        table = self._tables[device]
+        total = sum(e.packed_nbytes for e in table.values())
         while total > self.budget_bytes:
-            victim = next((mid for mid in self._engines
+            victim = next((mid for mid in table
                            if mid != keep and mid not in self._pinned),
                           None)
             if victim is None:
@@ -103,55 +157,101 @@ class ResidencyManager:
                 if self.tel is not None:
                     self.tel.event("serve_budget_exceeded",
                                    resident_bytes=total,
-                                   budget_bytes=self.budget_bytes)
+                                   budget_bytes=self.budget_bytes,
+                                   device=device)
                 return
-            freed = self._engines.pop(victim).packed_nbytes
+            freed = table.pop(victim).packed_nbytes
             total -= freed
             if self.tel is not None:
                 self.tel.inc("serve.evictions")
                 self.tel.event("serve_eviction", model_id=victim,
                                bytes=freed, resident_bytes=total,
-                               budget_bytes=self.budget_bytes)
+                               budget_bytes=self.budget_bytes,
+                               **({} if self.devices is None
+                                  else {"device": device}))
+
+    def _resident_ids(self) -> List[str]:
+        seen: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        for t in self._tables:
+            for mid in t:
+                seen.setdefault(mid)
+        return list(seen)
 
     def _update_gauges(self) -> None:
-        if self.tel is not None:
-            self.tel.gauge("serve.resident_models", len(self._engines))
-            self.tel.gauge("serve.resident_bytes", self.resident_bytes)
+        if self.tel is None:
+            return
+        self.tel.gauge("serve.resident_models",
+                       len(self._resident_ids()))
+        self.tel.gauge("serve.resident_bytes", self.resident_bytes)
+        if self.devices is not None:
+            for d, t in enumerate(self._tables):
+                self.tel.gauge(f"serve.d{d}.resident_models", len(t))
+                self.tel.gauge(f"serve.d{d}.resident_bytes",
+                               sum(e.packed_nbytes for e in t.values()))
 
     # ------------------------------------------------------- rollover
-    def build_candidate(self, model_id: str, booster) -> ServingEngine:
-        """Engine for a rollover candidate, built OUTSIDE the resident
-        table and WITHOUT the lock held (packing + warmup are the slow
-        part and must not stall live dispatches) — install it with
-        :meth:`swap`."""
-        return self._factory(booster, model_id=model_id,
-                             telemetry=self.tel, **self._knobs)
+    def build_candidate(self, model_id: str, booster
+                        ) -> Union[ServingEngine,
+                                   Dict[int, ServingEngine]]:
+        """Engine(s) for a rollover candidate, built OUTSIDE the
+        resident tables and WITHOUT the lock held (packing + warmup are
+        the slow part and must not stall live dispatches) — install
+        with :meth:`swap`.  Fleet mode returns the full replica set
+        ``{device_index: engine}`` (one shared packing); legacy mode a
+        single engine."""
+        if self.devices is None:
+            return self._factory(booster, model_id=model_id,
+                                 telemetry=self.tel, **self._knobs)
+        base = self._factory(booster, model_id=model_id,
+                             telemetry=self.tel,
+                             device=self.devices[0], device_index=0,
+                             **self._knobs)
+        replicas = {0: base}
+        for d in range(1, self.n_devices):
+            replicas[d] = self._factory(
+                booster, model_id=model_id, telemetry=self.tel,
+                device=self.devices[d], device_index=d, shared=base,
+                **self._knobs)
+        return replicas
 
-    def swap(self, model_id: str, booster, engine: ServingEngine
+    def swap(self, model_id: str, booster,
+             engine: Union[ServingEngine, Dict[int, ServingEngine]]
              ) -> Optional[ServingEngine]:
-        """Atomically replace ``model_id``'s booster + engine (the
-        rollover promotion).  The swap is one dict assignment under the
-        residency lock: a dispatch already in flight keeps resolving
-        against the OLD engine object it holds, every dispatch that
-        dequeues after the swap gets the new one — so each request
-        resolves against exactly one consistent model version.  Pin
-        state is preserved; returns the old engine (dropped by the
+        """Atomically replace ``model_id``'s booster + engine replicas
+        (the rollover promotion).  The swap is one critical section
+        under the residency lock covering EVERY device's table: a
+        dispatch already in flight keeps resolving against the OLD
+        engine object it holds, every dispatch that dequeues after the
+        swap — on any device — gets the new version; no device ever
+        serves a different version than its peers.  Pin state is
+        preserved; returns the old device-0 engine (dropped by the
         caller once its event is emitted)."""
+        replicas = engine if isinstance(engine, dict) else {0: engine}
         with self._lock:
             if model_id not in self._boosters:
                 raise KeyError(f"unknown model_id: {model_id!r}")
-            old = self._engines.pop(model_id, None)
             self._boosters[model_id] = booster
-            self._engines[model_id] = engine
-            self._builds[model_id] = self._builds.get(model_id, 0) + 1
-            self._evict_to_budget(keep=model_id)
+            old = None
+            for d, t in enumerate(self._tables):
+                o = t.pop(model_id, None)
+                if d == 0:
+                    old = o
+            for d, eng in replicas.items():
+                self._tables[d][model_id] = eng
+                bk = self._build_key(model_id, d)
+                self._builds[bk] = self._builds.get(bk, 0) + 1
+            for d in replicas:
+                self._evict_to_budget(d, keep=model_id)
             self._update_gauges()
             return old
 
     # ------------------------------------------------------------------
     def pin(self, model_id: str) -> None:
-        """Exempt from eviction (and make resident now)."""
-        self.get(model_id)
+        """Exempt from eviction (and make resident now, on every
+        device)."""
+        for d in range(self.n_devices):
+            self.get(model_id, d)
         with self._lock:
             self._pinned.add(model_id)
 
@@ -160,33 +260,44 @@ class ResidencyManager:
             self._pinned.discard(model_id)
 
     def evict(self, model_id: str) -> bool:
-        """Explicitly drop a model's device tensors (host booster stays
-        registered; the next request re-packs)."""
+        """Explicitly drop a model's device tensors — every replica
+        (host booster stays registered; the next request re-packs)."""
         with self._lock:
-            eng = self._engines.pop(model_id, None)
+            hit = False
+            for t in self._tables:
+                if t.pop(model_id, None) is not None:
+                    hit = True
             self._update_gauges()
-            return eng is not None
+            return hit
 
     def resident(self) -> List[str]:
         with self._lock:
-            return list(self._engines)
+            return self._resident_ids()
 
     def resident_engines(self) -> List["ServingEngine"]:
-        """Snapshot of the live engine objects (no LRU touch, no
-        rebuild) — the batcher's post-batch cost-flush hook iterates
-        this off the request latency path."""
+        """Snapshot of the live engine objects — every replica — (no
+        LRU touch, no rebuild); the batcher's post-batch cost/drift
+        flush hooks iterate this off the request latency path."""
         with self._lock:
-            return list(self._engines.values())
+            return [e for t in self._tables for e in t.values()]
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "models": list(self._boosters),
-                "resident": list(self._engines),
+                "resident": self._resident_ids(),
                 "pinned": sorted(self._pinned),
                 "resident_bytes": self.resident_bytes,
                 "budget_bytes": self.budget_bytes,
                 "builds": dict(self._builds),
                 "engines": {mid: e.stats()
-                            for mid, e in self._engines.items()},
+                            for mid, e in self._tables[0].items()},
             }
+            if self.devices is not None:
+                out["devices"] = self.n_devices
+                out["per_device"] = [
+                    {"device": d, "resident": list(t),
+                     "resident_bytes": sum(e.packed_nbytes
+                                           for e in t.values())}
+                    for d, t in enumerate(self._tables)]
+            return out

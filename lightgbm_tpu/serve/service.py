@@ -114,7 +114,9 @@ class PredictionService:
                  drift_enabled: Optional[bool] = None,
                  drift_psi_threshold: Optional[float] = None,
                  drift_eval_rows: Optional[int] = None,
-                 drift_hysteresis: Optional[int] = None):
+                 drift_hysteresis: Optional[int] = None,
+                 serve_devices: Optional[int] = None,
+                 routing: Optional[str] = None):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -150,6 +152,27 @@ class PredictionService:
             drift_hysteresis = param_default("drift_hysteresis")
         self.retry_policy = retry_policy
 
+        # serving fleet (docs/Serving.md "Serving fleet"): replicate
+        # each hot model's packed tensors onto N local devices, one
+        # dispatch lane per device.  0 = all local devices; 1 = the
+        # single-device pre-fleet plane (every legacy contract intact).
+        if serve_devices is None:
+            serve_devices = param_default("serve_devices")
+        if routing is None:
+            routing = param_default("serve_routing")
+        self.routing = str(routing or "least_loaded")
+        import jax
+        local = list(jax.local_devices())
+        nd = int(serve_devices or 0)
+        if nd <= 0:
+            nd = len(local)
+        nd = max(1, min(nd, len(local)))
+        self.n_devices = nd
+        self.devices = local[:nd] if nd > 1 else None
+        # sharded bulk scorers, built lazily per (model, packed hash)
+        self._bulk: Dict[str, Any] = {}
+        self._bulk_lock = threading.Lock()
+
         self.raw_score = bool(raw_score)
         self.tel = Telemetry(enabled=True)
         if telemetry_out:
@@ -177,6 +200,7 @@ class PredictionService:
             self._metrics.start()
         self.residency = ResidencyManager(
             budget_bytes=device_budget_bytes, telemetry=self.tel,
+            devices=self.devices,
             max_batch_rows=max_batch_rows,
             min_bucket_rows=min_bucket_rows,
             num_iteration=num_iteration,
@@ -198,7 +222,8 @@ class PredictionService:
             memory_watermarks=memory_watermarks,
             max_queue_rows=int(max_queue_rows or 0),
             max_queue_requests=int(max_queue_requests or 0),
-            default_deadline_ms=float(default_deadline_ms or 0.0))
+            default_deadline_ms=float(default_deadline_ms or 0.0),
+            n_lanes=self.n_devices, routing=self.routing)
         # post-batch cost-ledger flush: fresh bucket signatures'
         # deferred HLO analyses run on the worker thread after the
         # batch's futures resolved (obs/cost.py; engine.flush_cost)
@@ -221,7 +246,8 @@ class PredictionService:
                        max_queue_requests=int(max_queue_requests or 0),
                        default_deadline_ms=float(default_deadline_ms
                                                  or 0.0),
-                       target_p99_ms=float(target_p99_ms or 0.0))
+                       target_p99_ms=float(target_p99_ms or 0.0),
+                       devices=self.n_devices, routing=self.routing)
 
     # ------------------------------------------------------------------
     @property
@@ -244,8 +270,9 @@ class PredictionService:
             return False, "warmup_pending"
         return True, "ready"
 
-    def _dispatch_batch(self, model_id: str, X) -> np.ndarray:
-        eng = self.residency.get(model_id)
+    def _dispatch_batch(self, model_id: str, X,
+                        device: int = 0) -> np.ndarray:
+        eng = self.residency.get(model_id, device)
         out = eng.predict(X, raw_score=self.raw_score)
         st = self._shadow.get(model_id)
         if st is not None and st["remaining"] > 0:
@@ -322,6 +349,45 @@ class PredictionService:
             return _once()
         return policy.call(_once, telemetry=self.tel)
 
+    def predict_bulk(self, model_id: str, X,
+                     raw_score: Optional[bool] = None) -> np.ndarray:
+        """Offline/giant-batch scoring: shard_map the jitted traversal
+        row-wise over the serve mesh (serve/bulk.py) — every device
+        traverses its own row shard against replicated tree stacks,
+        bypassing the online micro-batch queues entirely.  Numerically
+        interchangeable with :meth:`predict` on the same rows (the f32
+        tolerance contract).  Falls back to the single-device engine
+        path when the fleet has one device or the model serves
+        degraded (host walk)."""
+        if self._closed:
+            raise RuntimeError("PredictionService is closed")
+        model_id = str(model_id)
+        if not self.residency.has(model_id):
+            raise KeyError(f"unknown model_id: {model_id!r}")
+        rs = self.raw_score if raw_score is None else bool(raw_score)
+        eng = self.residency.get(model_id, 0)
+        if self.devices is None or not eng.device_ok:
+            return eng.predict(X, raw_score=rs)
+        scorer = self._bulk_scorer(model_id, eng)
+        raw = scorer.predict_raw(X)
+        from ..basic import finalize_raw_predictions
+        b = eng.booster
+        return finalize_raw_predictions(raw, eng.k, b.objective,
+                                        b.average_output,
+                                        eng.num_iteration, rs)
+
+    def _bulk_scorer(self, model_id: str, eng):
+        """The cached sharded scorer for ``model_id``, rebuilt whenever
+        the resident packed state changed (rollover/refresh)."""
+        with self._bulk_lock:
+            sc = self._bulk.get(model_id)
+            if sc is not None and sc.model_hash == eng.model_hash:
+                return sc
+            from .bulk import BulkScorer
+            sc = BulkScorer(eng, self.devices, telemetry=self.tel)
+            self._bulk[model_id] = sc
+            return sc
+
     def warmup(self, buckets: Optional[List[int]] = None,
                model_ids: Optional[List[str]] = None) -> Dict[str, Any]:
         """Pack + AOT-compile every model (or ``model_ids``) for every
@@ -330,7 +396,16 @@ class PredictionService:
         ready."""
         out = {}
         for mid in (model_ids or self.model_ids()):
-            out[str(mid)] = self.residency.get(str(mid)).warmup(buckets)
+            if self.devices is None:
+                out[str(mid)] = self.residency.get(str(mid)) \
+                    .warmup(buckets)
+            else:
+                # every replica warms: per-device executables are
+                # distinct jit cache entries, so an unwarmed replica
+                # would recompile on its first routed request
+                out[str(mid)] = [
+                    self.residency.get(str(mid), d).warmup(buckets)
+                    for d in range(self.n_devices)]
         self._warmed = True
         return out
 
@@ -339,7 +414,8 @@ class PredictionService:
         further since its engine was built — engines pack a snapshot;
         they do not track later updates."""
         self.residency.evict(str(model_id))
-        self.residency.get(str(model_id))
+        for d in range(self.n_devices):
+            self.residency.get(str(model_id), d)
 
     # ------------------------------------------------------- rollover
     def rollover(self, model_id: str, new_source,
@@ -378,22 +454,28 @@ class PredictionService:
             booster = _as_booster(new_source)
             old_eng = self.residency.get(model_id)
             old_hash = old_eng.model_hash
-            # pack + warm on THIS thread: the serving worker keeps
-            # dispatching against the old engine the whole time
+            # pack + warm on THIS thread: the serving workers keep
+            # dispatching against the old engines the whole time.
+            # Fleet mode builds + warms the FULL replica set before the
+            # swap — the promotion installs every device's replica in
+            # one critical section, never a mixed-version fleet.
             cand = self.residency.build_candidate(model_id, booster)
+            replicas = cand if isinstance(cand, dict) else {0: cand}
+            cand0 = replicas[0]
             if warm:
-                cand.warmup()
+                for eng in replicas.values():
+                    eng.warmup()
             report: Dict[str, Any] = {
                 "model_id": model_id, "promoted": False,
                 "old_hash": old_hash[:16],
-                "new_hash": cand.model_hash[:16], "shadow": None}
+                "new_hash": cand0.model_hash[:16], "shadow": None}
             if isinstance(new_source, (str, os.PathLike)):
                 source_kind = "checkpoint" \
                     if os.path.isdir(str(new_source)) else "file"
             else:
                 source_kind = type(new_source).__name__
             if int(shadow_requests) > 0:
-                st = {"engine": cand, "remaining": int(shadow_requests),
+                st = {"engine": cand0, "remaining": int(shadow_requests),
                       "requests": 0, "max_divergence": 0.0,
                       "done": threading.Event()}
                 self._shadow[model_id] = st
@@ -414,7 +496,7 @@ class PredictionService:
                     self.tel.event(
                         "serve_rollover_aborted", model_id=model_id,
                         old_hash=old_hash[:16],
-                        new_hash=cand.model_hash[:16],
+                        new_hash=cand0.model_hash[:16],
                         **{f"shadow_{k}": v
                            for k, v in shadow_rep.items()})
                     return report
@@ -425,6 +507,10 @@ class PredictionService:
                 self.residency.swap(model_id, booster, cand)
             finally:
                 self._rollover_swapping = False
+            with self._bulk_lock:
+                # the packed state changed: the sharded bulk scorer
+                # rebuilds from the new replica on its next call
+                self._bulk.pop(model_id, None)
             self.tel.inc("serve.rollovers")
             # lineage chain: the incumbent's provenance becomes the
             # candidate's serving parent — training run_id -> checkpoint
@@ -438,9 +524,10 @@ class PredictionService:
             new_prov = getattr(booster, "provenance", None) or {}
             self.tel.event("serve_rollover", model_id=model_id,
                            old_hash=old_hash[:16],
-                           new_hash=cand.model_hash[:16],
+                           new_hash=cand0.model_hash[:16],
                            source=source_kind,
                            warmed=bool(warm),
+                           devices=len(replicas),
                            shadow=report["shadow"],
                            old_run_id=str(old_prov.get("run_id", "")),
                            new_run_id=str(new_prov.get("run_id", "")),
@@ -513,6 +600,43 @@ class PredictionService:
             out["compiles_per_1k_requests"] = round(
                 max(0, out["compiles"] - out["warmup_compiles"])
                 * 1000.0 / requests, 6)
+        if self.devices is not None:
+            # fleet view: the per-device deterministic contract
+            # (dispatches_per_request == 1.0, compiles_per_1k == 0 on
+            # EVERY device that took traffic) the serve-fleet CI gates
+            per = []
+            for i in range(self.n_devices):
+                d_req = int(c.get(f"serve.d{i}.requests", 0))
+                d_disp = int(c.get(f"serve.d{i}.dispatches", 0))
+                d_comp = int(c.get(f"serve.d{i}.compiles", 0))
+                d_wd = int(c.get(f"serve.d{i}.warmup_dispatches", 0))
+                d_wc = int(c.get(f"serve.d{i}.warmup_compiles", 0))
+                ent: Dict[str, Any] = {
+                    "device": i, "requests": d_req,
+                    "rows": int(c.get(f"serve.d{i}.rows", 0)),
+                    "batches": int(c.get(f"serve.d{i}.batches", 0)),
+                    "dispatches": d_disp, "compiles": d_comp,
+                    "warmup_dispatches": d_wd, "warmup_compiles": d_wc,
+                    "spills": int(c.get(f"serve.d{i}.spills", 0)),
+                    "queue_depth": snap.get("gauges", {}).get(
+                        f"serve.d{i}.queue_depth", 0)}
+                if d_req > 0:
+                    ent["dispatches_per_request"] = round(
+                        max(0, d_disp - d_wd) / d_req, 6)
+                    ent["compiles_per_1k_requests"] = round(
+                        max(0, d_comp - d_wc) * 1000.0 / d_req, 6)
+                per.append(ent)
+            out["fleet"] = {
+                "devices": self.n_devices,
+                "routing": self.routing,
+                "routed_devices": sum(1 for e in per
+                                      if e["requests"] > 0),
+                "spills": int(c.get("serve.spills", 0)),
+                "bulk_rows": int(c.get("serve.bulk_rows", 0)),
+                "bulk_dispatches": int(
+                    c.get("serve.bulk_dispatches", 0)),
+                "bulk_compiles": int(c.get("serve.bulk_compiles", 0)),
+                "per_device": per}
         return out
 
     def _flush_cost(self) -> None:
@@ -533,12 +657,16 @@ class PredictionService:
         raise into the worker."""
         try:
             now = time.time()
+            seen_monitors = set()
             for eng in self.residency.resident_engines():
                 age = now - self._model_born.get(eng.model_id, now)
                 self.tel.gauge(f"serve.model_age_s.{eng.model_id}",
                                round(age, 3))
-                if eng.drift is None:
+                # fleet replicas share one monitor per model — evaluate
+                # it once per flush, not once per device
+                if eng.drift is None or id(eng.drift) in seen_monitors:
                     continue
+                seen_monitors.add(id(eng.drift))
                 res = eng.drift.evaluate()
                 if res is None:
                     continue

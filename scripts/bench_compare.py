@@ -207,6 +207,17 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   training profile — fixed seeds + integer bin counts make it
     #   exactly reproducible, so any movement means the profile or
     #   divergence arithmetic changed shape.
+    # - fleet_dispatches_per_request_worst /
+    #   fleet_compiles_per_1k_worst (bench.py --serve fleet leg): the
+    #   PER-DEVICE deterministic serving contract — the device farthest
+    #   off 1.0 dispatches/request and the worst per-device compile
+    #   rate; a routing or per-replica-warmup regression moves them;
+    # - fleet_unrouted_devices: devices the closed-loop round-robin
+    #   tie-break never routed — MUST stay 0 (a device the fleet pays
+    #   residency for but never serves from); zero-to-nonzero flags;
+    # - bulk_identity_mismatch: 0.0 while predict_bulk (row-sharded
+    #   over the mesh) stays numerically identical to the
+    #   single-device dispatch path; zero-to-nonzero always flags.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
@@ -224,7 +235,10 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
                  "drift_dispatches_per_iter",
                  "serve_drift_dispatches_per_request",
                  "serve_drift_compiles_per_1k", "drift_alerts",
-                 "drift_alerts_control", "drift_psi_max"):
+                 "drift_alerts_control", "drift_psi_max",
+                 "fleet_dispatches_per_request_worst",
+                 "fleet_compiles_per_1k_worst",
+                 "fleet_unrouted_devices", "bulk_identity_mismatch"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue

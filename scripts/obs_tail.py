@@ -113,6 +113,8 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     ingest: List[Dict[str, Any]] = []
     cost: List[Dict[str, Any]] = []
     drift: List[Dict[str, Any]] = []
+    fleet_access: List[Dict[str, Any]] = []
+    bulk: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -130,6 +132,10 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             cost.append(r)
         if r.get("event") == "drift":
             drift.append(r)
+        if r.get("event") == "serve_access" and "device" in r:
+            fleet_access.append(r)
+        if r.get("event") == "serve_bulk":
+            bulk.append(r)
     lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
     if iters:
         lines.append(f"iterations: {min(iters)}..{max(iters)}")
@@ -171,6 +177,38 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             parts.append(f"model_age_s={float(last['model_age_s']):.4g}")
         if by_event.get("drift_unavailable"):
             parts.append(f"unavailable={by_event['drift_unavailable']}")
+        lines.append("  ".join(parts))
+    if fleet_access or bulk or by_event.get("serve_spill"):
+        # one line for the serving fleet (serve/ "Serving fleet"):
+        # per-device request share from the device-attributed
+        # serve_access records, queue-wait p95 across the fleet,
+        # admission spills, and row-sharded bulk throughput
+        parts = ["fleet:"]
+        if fleet_access:
+            per_dev: Dict[int, int] = {}
+            for r in fleet_access:
+                per_dev[int(r["device"])] = \
+                    per_dev.get(int(r["device"]), 0) + 1
+            total = sum(per_dev.values())
+            share = " ".join(
+                f"d{d}={100.0 * n / total:.0f}%"
+                for d, n in sorted(per_dev.items()))
+            parts.append(f"{total} request(s) [{share}]")
+            waits = sorted(float(r["queue_ms"]) for r in fleet_access
+                           if isinstance(r.get("queue_ms"),
+                                         (int, float)))
+            if waits:
+                p95 = waits[min(len(waits) - 1,
+                                int(0.95 * (len(waits) - 1) + 0.5))]
+                parts.append(f"queue_p95_ms={p95:.4g}")
+        parts.append(f"spills={by_event.get('serve_spill', 0)}")
+        if bulk:
+            rows = sum(int(r.get("rows", 0)) for r in bulk)
+            rates = [float(r["rows_per_s"]) for r in bulk
+                     if isinstance(r.get("rows_per_s"), (int, float))]
+            parts.append(f"bulk_rows={rows}")
+            if rates:
+                parts.append(f"bulk_rows_per_s={_mean(rates):.4g}")
         lines.append("  ".join(parts))
     if ingest:
         # one line per ingest (streamed/cached dataset build): source,

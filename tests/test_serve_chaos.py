@@ -62,7 +62,7 @@ def test_overload_acceptance_bounded_queue_and_p99(tmp_path):
     q_bound = 16
     svc = PredictionService(
         {"m": bst}, max_batch_rows=64, max_delay_ms=0.5,
-        min_bucket_rows=16, batch_events=False,
+        min_bucket_rows=16, batch_events=False, serve_devices=1,
         max_queue_requests=q_bound, default_deadline_ms=300.0,
         telemetry_out=str(tmp_path / "overload.jsonl"))
     svc.warmup()
@@ -231,7 +231,7 @@ def test_slow_dispatch_fault_absorbed_by_shedding(monkeypatch, tmp_path):
     bst = _train(seed=4)
     svc = PredictionService(
         {"m": bst}, max_batch_rows=32, max_delay_ms=0.5,
-        min_bucket_rows=16, batch_events=False,
+        min_bucket_rows=16, batch_events=False, serve_devices=1,
         default_deadline_ms=250.0,
         telemetry_out=str(tmp_path / "slow.jsonl"))
     svc.warmup()
@@ -262,3 +262,86 @@ def test_slow_dispatch_fault_absorbed_by_shedding(monkeypatch, tmp_path):
     assert any(r.get("event") == "fault_injected"
                and r.get("kind") == "serve_slow_dispatch" for r in recs)
     svc.close()
+
+
+@pytest.mark.chaos
+def test_fleet_rollover_atomic_one_version_per_device(tmp_path):
+    """Multi-replica rollover under live fleet traffic: the all-replica
+    swap is ONE critical section, so each device's response stream
+    flips old->new at most once and never back — no mixed-version
+    window on any lane.  Every successful response attributes to
+    exactly one of the two hashes over the full serve_access JSONL,
+    and every fleet access record carries its routed device."""
+    import jax
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs >= 2 local devices "
+                    "(tests/conftest.py forces 8 on CPU)")
+    b_old = _train(seed=2, rounds=6)
+    b_new = _train(seed=2, rounds=8, learning_rate=0.35)
+    sink = str(tmp_path / "fleet_rollover.jsonl")
+    svc = PredictionService(
+        {"m": b_old}, max_batch_rows=64, max_delay_ms=0.5,
+        min_bucket_rows=16, batch_events=False, telemetry_out=sink)
+    svc.warmup()
+    n_dev = svc.n_devices
+    assert n_dev >= 2
+    h_old = svc.residency.get("m").model_hash[:16]
+
+    stop = threading.Event()
+    failures, outcomes = [], []
+
+    def traffic(seed):
+        r = np.random.RandomState(seed)
+        while not stop.is_set():
+            Xq = r.rand(int(r.randint(1, 5)), F).astype(np.float32)
+            try:
+                fut = svc.submit("m", Xq)
+                fut.result(timeout=60)
+                outcomes.append(fut.trace_id)
+            except Exception as e:
+                failures.append(repr(e))
+    threads = [threading.Thread(target=traffic, args=(31 + i,),
+                                daemon=True) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    # shadow-score the candidate on mirrored fleet traffic, then swap
+    rep = svc.rollover("m", b_new, shadow_requests=5)
+    assert rep["promoted"]
+    assert rep["shadow"]["completed"]
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    svc.close(drain_timeout_s=30)
+
+    assert failures == [], failures[:5]
+    assert len(outcomes) > 50, "traffic generator barely ran"
+    h_new = rep["new_hash"]
+
+    recs = [json.loads(ln) for ln in open(sink) if ln.strip()]
+    rolls = [r for r in recs if r.get("event") == "serve_rollover"]
+    assert len(rolls) == 1
+    assert rolls[0]["devices"] == n_dev     # the FULL replica set swapped
+
+    acc, per_dev = {}, {}
+    for r in recs:
+        if r.get("event") == "serve_access" and not r.get("error"):
+            assert r["trace_id"] not in acc, "duplicate access record"
+            assert "device" in r, "fleet access record must carry device"
+            acc[r["trace_id"]] = r.get("model_version")
+            per_dev.setdefault(int(r["device"]), []).append(
+                r.get("model_version"))
+    for tid in outcomes:
+        assert tid in acc, f"response {tid} has no access record"
+        assert acc[tid] in {h_old, h_new}, acc[tid]
+    assert len(per_dev) >= 2, "traffic reached only one device"
+    # per-lane atomicity: batches dispatch serially on a lane and each
+    # resolves against residency at dispatch time, so the per-device
+    # version sequence (JSONL order = lane completion order) is
+    # old...old, new...new — one transition, never a flap back
+    for d, seq in sorted(per_dev.items()):
+        flips = sum(1 for a, b in zip(seq, seq[1:]) if a != b)
+        assert flips <= 1, f"device {d} mixed versions: {seq}"
+        if flips == 1:
+            assert seq[0] == h_old and seq[-1] == h_new, (d, seq)

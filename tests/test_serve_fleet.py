@@ -1,0 +1,406 @@
+"""Serving fleet (docs/Serving.md "Serving fleet").
+
+Covers the multi-device serving plane: per-device replication of the
+packed tree tensors (one host-side pack, N committed placements),
+honest per-device byte accounting audited against the live device
+buffers, least-loaded lane routing with the per-device deterministic
+contract (dispatches_per_request == 1.0, compiles_per_1k == 0 on every
+routed device), admission spill to the coldest lane before a shed,
+queue-depth gauges published on submit (a stalled worker's backlog is
+visible between drains), atomic all-replica rollover, and row-sharded
+``predict_bulk`` numerical identity with the single-device dispatch.
+
+tests/conftest.py forces ``--xla_force_host_platform_device_count=8``,
+so the whole suite runs these paths on a real multi-device topology;
+tests that NEED more than one device skip gracefully elsewhere.
+"""
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import Telemetry
+from lightgbm_tpu.serve import (MicroBatcher, PredictionService,
+                                ResidencyManager, ServingEngine)
+from lightgbm_tpu.serve.errors import ServeRejected
+
+TOL = dict(rtol=1e-5, atol=1e-6)   # f32 device accumulation vs f64 host
+F = 8
+NDEV = len(jax.local_devices())
+fleet = pytest.mark.skipif(
+    NDEV < 2, reason="needs >= 2 local devices (tests/conftest.py "
+    "forces 8 on the CPU backend)")
+
+
+def _train(seed=0, n=400, f=F, rounds=6, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.2, "verbose": -1, "min_data_in_leaf": 5}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def bst():
+    return _train(seed=0)
+
+
+@pytest.fixture(scope="module")
+def bst_multi():
+    rng = np.random.RandomState(7)
+    X = rng.rand(300, F).astype(np.float32)
+    y = rng.randint(0, 3, 300).astype(np.float32)
+    return lgb.train({"objective": "multiclass", "num_class": 3,
+                      "num_leaves": 15, "verbose": -1,
+                      "min_data_in_leaf": 5},
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+
+
+def _svc(models, **kw):
+    kw.setdefault("max_batch_rows", 64)
+    kw.setdefault("min_bucket_rows", 16)
+    kw.setdefault("max_delay_ms", 1.0)
+    kw.setdefault("batch_events", False)
+    return PredictionService(models, **kw)
+
+
+# ----------------------------------------------------------- accounting
+@fleet
+def test_residency_bytes_match_live_device_buffers(bst):
+    """The budget accounting charges what the device actually holds:
+    per device, ``resident_bytes_on(d)`` must land within 10% of the
+    bytes of the live jax buffers the build placed there (the old
+    estimate summed the base packing per replica and missed the
+    slice/copy operands entirely)."""
+    devices = jax.local_devices()[:2]
+    gc.collect()
+    # keep the baseline arrays alive so their ids cannot be recycled
+    baseline = list(jax.live_arrays())
+    before = {id(a) for a in baseline}
+    rm = ResidencyManager(devices=devices, max_batch_rows=128,
+                          min_bucket_rows=32)
+    rm.register("m", bst)
+    rm.get("m", 0)
+    rm.get("m", 1)
+    gc.collect()
+    fresh = [a for a in jax.live_arrays() if id(a) not in before]
+    for d, dev in enumerate(devices):
+        actual = sum(int(a.nbytes) for a in fresh
+                     if a.devices() == {dev})
+        est = rm.resident_bytes_on(d)
+        assert est > 0 and actual > 0
+        assert abs(actual - est) <= 0.10 * actual, \
+            f"device {d}: actual={actual} est={est}"
+    del baseline
+
+
+def test_full_range_engine_aliases_packed_no_copy(bst):
+    """A full-tree-range engine hands the packed arrays straight to the
+    runner — run_args must NOT materialize slice copies (which would
+    double true residency), and the charge is the owned packing plus
+    only the small derived operands (tree-id vector)."""
+    eng = ServingEngine(bst, max_batch_rows=128, min_bucket_rows=32)
+    packed = [x for x in eng.pred._packed if x is not None]
+    packed_ids = {id(x) for x in packed}
+    aliased = [a for a in eng._operands
+               if a is not None and id(a) in packed_ids]
+    assert len(aliased) == len(packed)
+    assert eng.packed_nbytes < 1.10 * eng.pred.packed_nbytes
+
+
+def test_sub_range_engine_charges_its_slices(bst):
+    """num_iteration < total forces real slice copies — the accounting
+    must charge them on top of the base packing, not pretend the engine
+    costs the same as the full-range one."""
+    eng = ServingEngine(bst, max_batch_rows=128, min_bucket_rows=32,
+                        num_iteration=3)
+    assert eng.num_iteration == 3
+    assert eng.packed_nbytes > eng.pred.packed_nbytes
+
+
+@fleet
+def test_replica_shares_packing_and_charges_copies(bst):
+    """A replica on another device reuses the base engine's host-side
+    packing (one pack per model) but its committed operand copies are
+    its own bytes — charged to ITS device."""
+    devices = jax.local_devices()[:2]
+    rm = ResidencyManager(devices=devices, max_batch_rows=128,
+                          min_bucket_rows=32)
+    rm.register("m", bst)
+    base = rm.get("m", 0)
+    rep = rm.get("m", 1)
+    assert rep.pred is base.pred          # shared packing, no re-pack
+    assert rep.model_hash == base.model_hash
+    assert base._owns_pred and not rep._owns_pred
+    assert rep.packed_nbytes > 0          # the replica copies are real
+    assert rm.resident_bytes_on(1) == rep.packed_nbytes
+    # and every replica operand actually lives on its device
+    for a in rep._operands:
+        if a is not None and hasattr(a, "devices"):
+            assert a.devices() == {devices[1]}
+
+
+# -------------------------------------------------------------- routing
+@fleet
+def test_fleet_routes_every_device_with_per_device_contract(bst):
+    """A sequential closed loop must still exercise EVERY device (idle
+    ties rotate), and after warmup every routed device honors the
+    deterministic contract: exactly 1.0 dispatches/request, 0
+    steady-state recompiles."""
+    svc = _svc({"m": bst})
+    try:
+        assert svc.n_devices == NDEV
+        svc.warmup()
+        rng = np.random.RandomState(3)
+        n_req = 4 * NDEV
+        for _ in range(n_req):
+            Xq = rng.rand(16, F).astype(np.float32)
+            np.testing.assert_allclose(svc.predict("m", Xq),
+                                       bst.predict(Xq), **TOL)
+        st = svc.stats()
+        fl = st["fleet"]
+        assert fl["devices"] == NDEV
+        assert fl["routed_devices"] == NDEV
+        per = fl["per_device"]
+        assert sum(e["requests"] for e in per) == n_req
+        for e in per:
+            assert e["requests"] > 0
+            assert e["dispatches_per_request"] == 1.0, e
+            assert e["compiles_per_1k_requests"] == 0.0, e
+        # the aggregate contract holds too
+        assert st["dispatches_per_request"] == 1.0
+        assert st["compiles_per_1k_requests"] == 0.0
+    finally:
+        svc.close()
+
+
+@fleet
+def test_round_robin_routing_spreads_exactly(bst):
+    svc = _svc({"m": bst}, routing="round_robin")
+    try:
+        svc.warmup()
+        rng = np.random.RandomState(5)
+        for _ in range(3 * NDEV):
+            svc.predict("m", rng.rand(8, F).astype(np.float32))
+        fl = svc.stats()["fleet"]
+        assert fl["routing"] == "round_robin"
+        assert [e["requests"] for e in fl["per_device"]] == [3] * NDEV
+    finally:
+        svc.close()
+
+
+def test_single_device_plane_has_no_fleet_surface(bst):
+    """serve_devices=1 is the pre-fleet plane: one lane, two-argument
+    dispatch callback, no fleet stats section."""
+    svc = _svc({"m": bst}, serve_devices=1)
+    try:
+        assert svc.devices is None and svc.n_devices == 1
+        assert svc.batcher.n_lanes == 1
+        svc.warmup()
+        rng = np.random.RandomState(9)
+        Xq = rng.rand(10, F).astype(np.float32)
+        np.testing.assert_allclose(svc.predict("m", Xq),
+                                   bst.predict(Xq), **TOL)
+        assert "fleet" not in svc.stats()
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------- spill & admission
+def _wedge_lanes(batcher, n, gate, rows=1):
+    """Occupy every lane's worker inside a gated dispatch and wait
+    until all of them are busy."""
+    futs = [batcher.submit("m", np.zeros((rows, F), np.float32))
+            for _ in range(n)]
+    deadline = time.time() + 10.0
+    while any(lane.busy_rows == 0 for lane in batcher._lanes):
+        assert time.time() < deadline, "workers never picked up"
+        time.sleep(0.005)
+    return futs
+
+
+def test_spill_to_coldest_lane_before_shed():
+    """A submit its routed lane must reject goes to the coldest lane
+    with room (counted, evented) — only when EVERY lane is full does
+    admission control shed."""
+    tel = Telemetry(enabled=True)
+    gate = threading.Event()
+
+    def dispatch(model_id, X, device):
+        gate.wait(10.0)
+        return np.zeros((X.shape[0],))
+
+    b = MicroBatcher(dispatch, max_batch_rows=8, max_delay_ms=1.0,
+                     telemetry=tel, max_queue_rows=4, n_lanes=2)
+    try:
+        busy = _wedge_lanes(b, 2, gate)
+        # pin routing to lane 0: the spill mechanics, not the routing
+        # policy, are under test here
+        b._pick_lane = lambda: b._lanes[0]
+        f1 = b.submit("m", np.zeros((2, F), np.float32))
+        assert b._lanes[0].q_rows == 2       # lane cap = ceil(4/2) = 2
+        f2 = b.submit("m", np.zeros((2, F), np.float32))
+        assert b._lanes[1].q_rows == 2       # spilled, not shed
+        c = tel.snapshot()["counters"]
+        assert c.get("serve.spills") == 1
+        assert c.get("serve.d1.spills") == 1
+        with pytest.raises(ServeRejected):   # both lanes full now
+            b.submit("m", np.zeros((2, F), np.float32))
+        gate.set()
+        for f in busy + [f1, f2]:
+            f.result(timeout=10.0)
+        events = [e for e in tel.snapshot()["events"]
+                  if e["event"] == "serve_spill"]
+        assert events and events[0]["to_device"] == 1
+    finally:
+        gate.set()
+        b.close(drain_timeout_s=5.0)
+        tel.close()
+
+
+def test_queue_gauges_published_on_submit_while_worker_stalled():
+    """The backlog behind a stalled worker must be visible WITHOUT a
+    drain: submit itself refreshes the aggregate and per-lane
+    queue-depth/rows gauges."""
+    tel = Telemetry(enabled=True)
+    gate = threading.Event()
+
+    def dispatch(model_id, X, device):
+        gate.wait(10.0)
+        return np.zeros((X.shape[0],))
+
+    b = MicroBatcher(dispatch, max_batch_rows=4, max_delay_ms=1.0,
+                     telemetry=tel, n_lanes=2)
+    try:
+        busy = _wedge_lanes(b, 2, gate)
+        b._pick_lane = lambda: b._lanes[0]
+        queued = [b.submit("m", np.zeros((2, F), np.float32))
+                  for _ in range(3)]
+        g = tel.snapshot()["gauges"]
+        assert g["serve.queue_depth"] == 3
+        assert g["serve.queue_rows"] == 6
+        assert g["serve.d0.queue_depth"] == 3
+        assert g["serve.d0.queue_rows"] == 6
+        gate.set()
+        for f in busy + queued:
+            f.result(timeout=10.0)
+    finally:
+        gate.set()
+        b.close(drain_timeout_s=5.0)
+        tel.close()
+
+
+# ------------------------------------------------------------- rollover
+@fleet
+def test_fleet_rollover_swaps_every_replica_atomically(bst):
+    b2 = _train(seed=1, rounds=8)
+    svc = _svc({"m": bst})
+    try:
+        svc.warmup()
+        rng = np.random.RandomState(13)
+        X = rng.rand(200, F).astype(np.float32)
+        old_hash = svc.residency.get("m", 0).model_hash
+        rep = svc.rollover("m", b2)
+        assert rep["promoted"]
+        hashes = {svc.residency.get("m", d).model_hash
+                  for d in range(svc.n_devices)}
+        assert len(hashes) == 1 and old_hash not in hashes
+        np.testing.assert_allclose(svc.predict("m", X), b2.predict(X),
+                                   **TOL)
+        # the cached bulk scorer rebuilt from the promoted replica
+        np.testing.assert_allclose(svc.predict_bulk("m", X),
+                                   b2.predict(X), **TOL)
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------- bulk
+@fleet
+def test_predict_bulk_identical_to_single_device_dispatch(bst):
+    svc = _svc({"m": bst}, max_batch_rows=256, min_bucket_rows=32)
+    try:
+        svc.warmup()
+        rng = np.random.RandomState(11)
+        X = rng.rand(1000, F).astype(np.float32)
+        single = svc.residency.get("m", 0).predict(X)
+        bulk = svc.predict_bulk("m", X)
+        assert bulk.shape == single.shape
+        np.testing.assert_allclose(bulk, single, **TOL)
+        np.testing.assert_allclose(bulk, bst.predict(X), **TOL)
+        sp = pytest.importorskip("scipy.sparse")
+        np.testing.assert_allclose(
+            svc.predict_bulk("m", sp.csr_matrix(X)), single, **TOL)
+        fl = svc.stats()["fleet"]
+        assert fl["bulk_rows"] == 2 * X.shape[0]
+        assert fl["bulk_dispatches"] >= 2
+    finally:
+        svc.close()
+
+
+@fleet
+def test_predict_bulk_multiclass_and_raw_score(bst_multi):
+    svc = _svc({"mc": bst_multi}, max_batch_rows=128)
+    try:
+        svc.warmup()
+        rng = np.random.RandomState(17)
+        X = rng.rand(500, F).astype(np.float32)
+        eng = svc.residency.get("mc", 0)
+        np.testing.assert_allclose(svc.predict_bulk("mc", X),
+                                   eng.predict(X), **TOL)
+        np.testing.assert_allclose(
+            svc.predict_bulk("mc", X, raw_score=True),
+            eng.predict(X, raw_score=True), **TOL)
+    finally:
+        svc.close()
+
+
+@fleet
+def test_predict_bulk_degraded_model_falls_back_to_host_walk():
+    """A model the device path cannot represent (linear trees) must
+    serve predict_bulk through the exact host walk — never a sharded
+    dispatch, never an error."""
+    rng = np.random.RandomState(8)
+    X = rng.rand(300, 4)
+    y = X @ np.array([1.0, 2.0, -1.0, 0.5]) + 0.05 * rng.randn(300)
+    blin = lgb.train({"objective": "regression", "num_leaves": 5,
+                      "verbose": -1, "linear_tree": True,
+                      "min_data_in_leaf": 10},
+                     lgb.Dataset(X, label=y), num_boost_round=2)
+    svc = _svc({"lin": blin})
+    try:
+        Xq = rng.rand(50, 4)
+        np.testing.assert_allclose(svc.predict_bulk("lin", Xq),
+                                   blin.predict(Xq),
+                                   rtol=1e-9, atol=1e-12)
+        assert svc.stats()["fleet"]["bulk_rows"] == 0
+    finally:
+        svc.close()
+
+
+@fleet
+def test_bulk_steady_stream_recompiles_nothing(bst):
+    """Repeat bulk calls with the same shard bucket must be pure cache
+    hits — the bulk signatures live in the same process-wide registry
+    the online engines gate on."""
+    svc = _svc({"m": bst}, max_batch_rows=128)
+    try:
+        svc.warmup()
+        rng = np.random.RandomState(19)
+        X = rng.rand(800, F).astype(np.float32)
+        svc.predict_bulk("m", X)
+        c0 = svc.stats()["fleet"]["bulk_compiles"]
+        for _ in range(3):
+            svc.predict_bulk("m", X)
+        fl = svc.stats()["fleet"]
+        assert fl["bulk_compiles"] == c0
+        assert fl["bulk_dispatches"] >= 4
+    finally:
+        svc.close()

@@ -56,6 +56,10 @@ def _svc(bst, **kw):
     kw.setdefault("min_bucket_rows", 16)
     kw.setdefault("max_delay_ms", 0.5)
     kw.setdefault("batch_events", False)
+    # single lane: these tests specify the overload semantics of ONE
+    # bounded queue (gated-dispatch backlogs, watermark math, wedge
+    # sequencing); fleet admission/spill is tests/test_serve_fleet.py
+    kw.setdefault("serve_devices", 1)
     return PredictionService({"m": bst}, **kw)
 
 
