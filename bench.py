@@ -807,6 +807,32 @@ def run_micro() -> None:
     _RESULT["drift_psi_max_control"] = ctrl["psi_max"]
     _emit()   # the drift-plane counters are on stdout now
 
+    # ---- slo leg: the SLO plane (obs/slo.py) armed with the BUILT-IN
+    # objective catalog on a clean training run. The engine evaluates
+    # host-side telemetry snapshots on its daemon ticker plus the drain
+    # boundaries the driver already owns, so arming it is
+    # dispatch-neutral: slo_dispatches_per_iter must EQUAL
+    # dispatches_per_iter EXACTLY (bench_compare deterministic counter
+    # + the perf-smoke absolute assertion). The finalize force-tick
+    # makes slo_ticks >= 1 deterministic, and a healthy run must
+    # produce ZERO alerts — slo_alerts is the false-positive gate.
+    tel_slo = tel_path + ".slo"
+    ds_slo = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    t0 = time.perf_counter()
+    bst_slo = lgb.train(dict(params, telemetry_out=tel_slo,
+                             slo_enabled=True),
+                        ds_slo, num_boost_round=n_iters)
+    slo_wall = time.perf_counter() - t0
+    _phase("micro_slo_train_ok")
+    c8 = bst_slo.telemetry().get("counters", {})
+    slo_iters = max(1, int(c8.get("iterations", n_iters)))
+    _RESULT["slo_sec_per_iter"] = round(slo_wall / slo_iters, 5)
+    _RESULT["slo_dispatches_per_iter"] = round(
+        float(c8.get("train.dispatches", 0)) / slo_iters, 4)
+    _RESULT["slo_ticks"] = int(c8.get("slo.ticks", 0))
+    _RESULT["slo_alerts"] = int(c8.get("slo.alerts_fired", 0))
+    _emit()   # the slo-plane counters are on stdout now
+
     # ---- multiproc leg: 2 REAL processes x 2 virtual CPU devices over
     # one gloo mesh, tree_learner=data on the fused engine with the
     # megastep armed — the pod-scale fast path. The deterministic gate
@@ -835,7 +861,7 @@ def run_micro() -> None:
     except Exception as e:
         print(f"multiproc leg failed: {e}", file=sys.stderr)
     for p in (tel_path, tel_eval, tel_ckpt, tel_obs, tel_ctl, tel_ing,
-              tel_hb, tel_hc, tel_drift):
+              tel_hb, tel_hc, tel_drift, tel_slo):
         try:
             os.remove(p)
         except OSError:
@@ -1329,6 +1355,110 @@ def run_serve() -> None:
     _RESULT["bulk_identity_mismatch"] = float(not bulk_ok)
     svcF.close()
     _phase("serve_fleet_ok")
+    _emit()
+
+    # ---- slo forced-alert leg: deterministic alert lifecycle ---------
+    # A serve_slow_dispatch fault injects ONE ~400 ms dispatch into an
+    # slo-armed service whose latency objective is overridden down to
+    # 50 ms with hysteresis 2 (the rest of the built-in catalog stays
+    # armed, so any OTHER objective firing here is a false positive).
+    # tick_period 0 disables the ticker — every evaluation below is an
+    # explicit forced step, which makes the lifecycle exact on any
+    # runner: two breaching evaluations fire the alert and capture the
+    # incident artifact, ~300 fast requests push the slow sample past
+    # the p99 index, two clean evaluations resolve it. Exactly one
+    # firing->resolved cycle, a schema-valid incident and
+    # slo_false_positives == 0 are gated absolutely by the
+    # serve-alert-smoke CI job and bench_compare's deterministic set.
+    import tempfile
+    slo_dir = tempfile.mkdtemp(prefix="bench_serve_slo_")
+    slo_cfg = os.path.join(slo_dir, "slo.json")
+    slo_tel = os.path.join(slo_dir, "tel.jsonl")
+    with open(slo_cfg, "w") as fh:
+        json.dump({"objectives": [
+            {"id": "serve.latency_p99", "target": 50.0,
+             "hysteresis": 2, "resolve_hysteresis": 2}]}, fh)
+    svc5 = PredictionService({"m0": models["m0"]}, max_batch_rows=64,
+                             max_delay_ms=0.5, min_bucket_rows=16,
+                             batch_events=False, serve_devices=1,
+                             slo_config=slo_cfg, slo_tick_period_s=0.0,
+                             metrics_port=_free_port(),
+                             telemetry_out=slo_tel)
+    svc5.warmup()
+    s5_warm = svc5.stats()              # baseline: warmup dispatches
+    # arm the fault only AFTER warmup so the slow dispatch lands on the
+    # measured request (the hook re-reads the env per batch); restore
+    # the previous value either way
+    prev_faults = os.environ.get("LIGHTGBM_TPU_FAULTS")
+    os.environ["LIGHTGBM_TPU_FAULTS"] = "serve_slow_dispatch@1:ms=400"
+    Xs = np.random.RandomState(31).rand(4, n_feat).astype(np.float32)
+    try:
+        svc5.predict("m0", Xs)          # ~400 ms: the breaching sample
+    finally:
+        if prev_faults is None:
+            os.environ.pop("LIGHTGBM_TPU_FAULTS", None)
+        else:
+            os.environ["LIGHTGBM_TPU_FAULTS"] = prev_faults
+    eng = svc5.slo
+    eng.step(force=True)
+    eng.step(force=True)                # hysteresis 2 -> firing
+    for _ in range(300):                # refill the latency ring fast
+        svc5.predict("m0", Xs)
+    eng.step(force=True)
+    eng.step(force=True)                # resolve_hysteresis 2 -> clear
+    # live /alerts endpoint + build-info series while the svc is up
+    try:
+        from lightgbm_tpu.obs.export import scrape as _scr5
+        base5 = svc5.metrics_url.rsplit("/metrics", 1)[0]
+        _, abody = _scr5(f"{base5}/alerts", timeout=10)
+        _RESULT["slo_alerts_endpoint_ok"] = float(
+            int(json.loads(abody).get("fired", 0)) >= 1)
+        _, mbody = _scr5(svc5.metrics_url, timeout=10)
+        _RESULT["slo_build_info_ok"] = float(any(
+            l.startswith("lgbm_build_info{") and l.rstrip().endswith(" 1")
+            for l in mbody.splitlines()))
+    except Exception as e:
+        print(f"slo endpoint scrape failed: {e}", file=sys.stderr)
+        _RESULT["slo_alerts_endpoint_ok"] = 0.0
+        _RESULT["slo_build_info_ok"] = 0.0
+    pay = eng.alerts_payload()
+    s5 = svc5.stats()
+    svc5.close()
+    hist = pay.get("history", [])
+    fired5 = [h for h in hist if h.get("state") == "firing"]
+    _RESULT["slo_alert_fired"] = len(fired5)
+    _RESULT["slo_alert_resolved"] = len(
+        [h for h in hist if h.get("state") == "resolved"])
+    _RESULT["slo_false_positives"] = len(
+        [h for h in fired5
+         if h.get("objective") != "serve.latency_p99"])
+    inc_ok = 0.0
+    try:
+        with open(pay["incidents"][0]) as fh:
+            inc = json.load(fh)
+        inc_ok = float(
+            inc.get("schema") == "lightgbm_tpu.incident/1"
+            and inc.get("alert", {}).get("objective")
+            == "serve.latency_p99"
+            and isinstance(inc.get("telemetry"), dict)
+            and isinstance(inc.get("context"), dict))
+    except Exception as e:
+        print(f"slo incident check failed: {e}", file=sys.stderr)
+    _RESULT["slo_incident_valid"] = inc_ok
+    # inverted forms for bench_compare's zero-to-nonzero gate (the
+    # ratio gate only flags increases, so "must stay 1" contracts are
+    # expressed as "must stay 0" failures)
+    _RESULT["slo_incident_invalid"] = 1.0 - inc_ok
+    _RESULT["slo_alert_missed"] = float(
+        _RESULT["slo_alert_fired"] != 1)
+    _RESULT["slo_alert_unresolved"] = float(
+        _RESULT["slo_alert_resolved"] != _RESULT["slo_alert_fired"])
+    _RESULT["slo_dispatches_per_request"] = round(
+        (s5["dispatches"] - s5_warm["dispatches"])
+        / max(1, s5["requests"] - s5_warm["requests"]), 6)
+    import shutil as _sh5
+    _sh5.rmtree(slo_dir, ignore_errors=True)
+    _phase("serve_slo_alert_ok")
     _emit()
 
 

@@ -277,6 +277,11 @@ class GBDT:
         self._profile_ctl = None
         self._ctl_window = None
         self._ctl_no_open = False
+        # SLO plane (obs/slo.py): declarative objectives evaluated on a
+        # host-side ticker plus at the same drain-boundary sync points
+        # the profile control polls — dispatch-neutral by the same
+        # construction
+        self._slo = None
         # device-time cost ledger (obs/cost.py): fresh executable
         # signatures queue here at dispatch, analyses run at drains
         self._cost = None
@@ -542,6 +547,27 @@ class GBDT:
         elif self._cost is None or self._cost.mode != cost_mode:
             from ..obs.cost import CostLedger
             self._cost = CostLedger(tel, cost_mode)
+        # SLO plane (obs/slo.py): one engine per registry lifetime,
+        # rebuilt when a reset_config changes the arming keys.  The
+        # engine only reads host-side snapshots — arming it is
+        # dispatch-neutral exactly like the profile control.
+        slo_cfg = str(getattr(config, "slo_config", "") or "")
+        slo_on = bool(getattr(config, "slo_enabled", False)) or bool(slo_cfg)
+        if self._slo is not None:
+            self._slo.stop()
+            self._slo = None
+        if slo_on and tel.enabled:
+            from ..obs.slo import SloEngine
+            self._slo = SloEngine(
+                tel, source="train", config_path=slo_cfg,
+                tick_period_s=float(getattr(config, "slo_tick_period_s",
+                                            5.0)),
+                incident_base=out,
+                context_fn=self._slo_context)
+            self._slo.start()
+        if self._metrics is not None:
+            self._metrics.alerts_fn = (self._slo.alerts_payload
+                                       if self._slo is not None else None)
         # streamed/cached datasets carry their ingest counters from
         # before the registry existed; fold them in now (init and any
         # reset_config that turns telemetry on)
@@ -549,6 +575,25 @@ class GBDT:
             self._publish_ingest(self.train_data)
             for vd in getattr(self, "valid_data", []) or []:
                 self._publish_ingest(vd)
+
+    def _slo_context(self):
+        """Incident-artifact context: where training stood when the
+        alert fired (host attribute reads only)."""
+        return {
+            "iteration": int(getattr(self, "iter", 0)),
+            "models": len(getattr(self, "models", []) or []),
+            "last_checkpoint_iter": int(self._last_ckpt_iter),
+        }
+
+    def _slo_step(self) -> None:
+        """Heartbeat + time-gated SLO evaluation at the drain-boundary
+        sync points the driver already owns (same contract as
+        _profile_ctl_step: host flags only, no dispatch)."""
+        slo = self._slo
+        if slo is None:
+            return
+        slo.note_training_heartbeat(self.iter)
+        slo.step()
 
     def _tel_granularity(self) -> str:
         """Effective time-attribution granularity. trace_out (spans come
@@ -615,6 +660,7 @@ class GBDT:
         a TensorBoard/Perfetto trace of iterations K..K+n is one config
         key away)."""
         self._profile_ctl_step()
+        self._slo_step()
         if self._prof_done or not self._prof_dir \
                 or self._ctl_window is not None:
             return
@@ -746,6 +792,15 @@ class GBDT:
             self._close_ctl_window("closed_at_finalize")
             return
         self.drain_pending()
+        if self._slo is not None:
+            # one forced final evaluation so even a sub-tick-period run
+            # gets a non-vacuous slo.ticks count, then disarm the
+            # training-liveness watchdog (clean finalize is not a stall)
+            # and the ticker thread
+            self._slo.note_training_heartbeat(self.iter)
+            self._slo.step(force=True)
+            self._slo.note_training_done()
+            self._slo.stop()
         # the tail drain may have closed an elapsed window at its
         # boundary; anything still open ends here, after the last
         # iterations it covered are drained
@@ -3849,9 +3904,11 @@ class GBDT:
         if flat and self._ckpt is not None:
             self.maybe_checkpoint()
         # ... and the on-demand profiling window (POST /profile) opens
-        # and closes at exactly these boundaries on the megastep driver
+        # and closes at exactly these boundaries on the megastep driver,
+        # and the SLO watchdogs take their training-liveness heartbeat
         if flat:
             self._profile_ctl_step()
+            self._slo_step()
 
     def _replay_drained_eval(self, flat_metrics, base_iter: int,
                              n_flat: int, stop_i: Optional[int],

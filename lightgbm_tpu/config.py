@@ -523,6 +523,33 @@ _PARAMS: List[_Param] = [
             "above which the chunk is flagged in the mapper_drift "
             "event — the rebuild-vs-append trigger for continuous "
             "learning"),
+    # ---- SLO plane (docs/Observability.md §14) ----
+    _p("slo_enabled", bool, False, ("enable_slo",),
+       desc="arm the SloEngine with the built-in objective catalog "
+            "(serve latency p99, shed ratio, lane/worker liveness, "
+            "shadow divergence, model age, drift ceiling, training "
+            "liveness, straggler skew, checkpoint age, prefetch "
+            "starvation, scrape staleness). The evaluator is host-side "
+            "and dispatch-neutral: it reads telemetry snapshots on a "
+            "daemon ticker and never touches device arrays "
+            "(counter-asserted in bench like the profile control)"),
+    _p("slo_config", str, "", ("slo_objectives",),
+       desc="path to a JSON objective spec file ({'objectives': "
+            "[{id, target, hysteresis, ...}]}); entries matching a "
+            "built-in id override it, new ids must carry a known "
+            "'kind'. Setting this implies slo_enabled"),
+    _p("slo_tick_period_s", float, 5.0, ("slo_period_s",),
+       check=(">=", 0.0),
+       desc="SLO evaluation cadence in seconds for the daemon ticker; "
+            "0 disables the thread — the engine then evaluates only at "
+            "the driver's drain boundaries (training) or on explicit "
+            "step() calls (tests/bench)"),
+    _p("slo_readyz_gating", bool, False, (),
+       desc="let /readyz report 503 while a PAGE-severity serving "
+            "alert is firing, so a load balancer drains a replica that "
+            "is alive but violating its latency/liveness objectives. "
+            "Default OFF: alerting observes, readiness gates only on "
+            "structural state (warmup/rollover/wedge)"),
     # ---- Serving admission control (docs/Serving.md) ----
     _p("serve_max_queue_rows", int, 0, ("serve_queue_rows",),
        check=(">=", 0),

@@ -40,7 +40,12 @@ package is the permanent, low-overhead replacement:
 - drift.py — the drift & lineage plane: training-data profiles
   (embedded in model artifacts + checkpoints), PSI/JS divergence, the
   serving-side :class:`DriftMonitor` and the provenance record chained
-  through rollovers (docs/Observability.md §13).
+  through rollovers (docs/Observability.md §13);
+- :class:`SloEngine` (slo.py) — the SLO plane: declarative objectives
+  (built-in catalog + ``slo_config=<path>``) evaluated on a host-side
+  ticker with multi-window burn-rate alerting, ``alert`` events,
+  fleet/liveness watchdogs and bounded incident artifacts
+  (docs/Observability.md §14).
 
 Every recording method is a no-op behind a single attribute check while
 the registry is disabled, so instrumentation stays in the hot driver
@@ -56,6 +61,7 @@ from .jaxmon import device_memory_stats, memory_watermarks
 from .registry import Telemetry, allgather_json
 from .report import (build_report, compare_reports, load_report,
                      render_markdown, write_report)
+from .slo import BUILTIN_OBJECTIVES, SloEngine, SloSpec
 from .trace import chrome_trace_events, write_trace
 
 __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
@@ -65,4 +71,5 @@ __all__ = ["Telemetry", "JsonlSink", "device_memory_stats",
            "CostLedger", "build_report", "compare_reports",
            "load_report", "render_markdown", "write_report",
            "DriftMonitor", "build_profile", "build_provenance",
-           "canonical_json", "js_divergence", "profile_digest", "psi"]
+           "canonical_json", "js_divergence", "profile_digest", "psi",
+           "SloEngine", "SloSpec", "BUILTIN_OBJECTIVES"]

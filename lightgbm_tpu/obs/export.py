@@ -50,7 +50,11 @@ exporter is the RUNNING job's control surface —
   dispatch-neutrality contract ``bench.py --micro`` gates;
 - ``GET /report`` — the consolidated run report (obs/report.py) built
   from the live registry, same schema as the ``run_report_out``
-  artifact.
+  artifact;
+- ``GET /alerts`` — the SLO plane's alert view (obs/slo.py): active
+  alerts, per-objective status, burn rates and the recent transition
+  history; 404 until an SloEngine is armed (``slo_enabled`` /
+  ``slo_config``).
 
 ``/metrics`` bodies are cached for ``cache_ttl`` (~1 s): a tight
 external scrape loop re-reads the cached rendering instead of
@@ -99,14 +103,37 @@ def _num(v: Any) -> str:
     return repr(f)
 
 
+def build_info_labels() -> Dict[str, Any]:
+    """Deploy-identifying labels for the ``lgbm_build_info`` series:
+    package version, jax version and active backend.  Cheap host
+    lookups, computed once per exporter."""
+    info: Dict[str, Any] = {}
+    try:
+        from .. import __version__
+        info["version"] = __version__
+    except Exception:
+        info["version"] = "unknown"
+    try:
+        import jax
+        info["jax_version"] = getattr(jax, "__version__", "unknown")
+        info["backend"] = jax.default_backend()
+    except Exception:
+        info.setdefault("jax_version", "unknown")
+        info.setdefault("backend", "unknown")
+    return info
+
+
 def render_openmetrics(snapshot: Dict[str, Any],
                        labels: Optional[Dict[str, Any]] = None,
-                       fleet: Optional[List[Dict[str, Any]]] = None
+                       fleet: Optional[List[Dict[str, Any]]] = None,
+                       build_info: Optional[Dict[str, Any]] = None
                        ) -> str:
     """Registry snapshot (Telemetry.snapshot schema) → OpenMetrics
     exposition text.  ``fleet`` entries (``{"rank": r, "counters":
     {...}}``) add per-rank counter series under the same families —
-    the aggregated view rank 0 serves for the whole cohort."""
+    the aggregated view rank 0 serves for the whole cohort.
+    ``build_info`` labels add a constant ``lgbm_build_info 1`` series
+    so scrapes are joinable across deploys."""
     labels = dict(labels or {})
     lines: List[str] = []
     local_rank = labels.get("rank")
@@ -163,6 +190,12 @@ def render_openmetrics(snapshot: Dict[str, Any],
         if "sum" in d:
             lines.append(f"{m}_sum{_fmt_labels(labels)} "
                          f"{_num(d['sum'])}")
+
+    if build_info:
+        lab = dict(labels)
+        lab.update(build_info)
+        lines.append("# TYPE lgbm_build_info gauge")
+        lines.append(f"lgbm_build_info{_fmt_labels(lab)} 1")
 
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
@@ -292,6 +325,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500, str(e)[:200])
                 return
             self._send_json(200, rep)
+        elif path == "/alerts":
+            fn = self.exporter.alerts_fn
+            if fn is None:
+                self._send_json(404, {"error": "no slo engine attached "
+                                               "(set slo_enabled or "
+                                               "slo_config)"})
+                return
+            try:
+                payload = fn()
+            except Exception as e:
+                self.send_error(500, str(e)[:200])
+                return
+            self._send_json(200, payload)
         elif path == "/healthz":
             body = b"ok\n"
             self.send_response(200)
@@ -362,7 +408,7 @@ class MetricsExporter:
     def __init__(self, telemetry, port: int, host: str = "127.0.0.1",
                  extra_labels: Optional[Dict[str, Any]] = None,
                  ready_check=None, profile_control=None, report_fn=None,
-                 cache_ttl: float = 1.0):
+                 alerts_fn=None, cache_ttl: float = 1.0):
         self.telemetry = telemetry
         self.requested_port = int(port)
         self.host = host
@@ -375,6 +421,10 @@ class MetricsExporter:
         # source (GET /report)
         self.profile_control = profile_control
         self.report_fn = report_fn
+        # the SLO plane's alert view (GET /alerts) — an SloEngine's
+        # alerts_payload when one is armed, else 404
+        self.alerts_fn = alerts_fn
+        self.build_info = build_info_labels()
         # /metrics body cache: a tight external scrape loop re-reads
         # the cached rendering for cache_ttl seconds instead of
         # re-snapshotting the registry under its lock per request
@@ -393,9 +443,14 @@ class MetricsExporter:
         labels = {"rank": tel.rank, "run_id": tel.run_id}
         labels.update(self.extra_labels)
         fleet = tel.fleet_counters() if tel.rank == 0 else None
+        # scrape-staleness feed for the SLO plane: the gauge records
+        # when /metrics last produced a fresh body (TTL-cached re-reads
+        # don't move it, which bounds its resolution at cache_ttl)
+        tel.gauge("export.last_scrape_ts", tel.wall_now())
         # the events-free view: a scrape must not deep-copy the event
         # rings under the registry lock (metrics_snapshot docstring)
-        return render_openmetrics(tel.metrics_snapshot(), labels, fleet)
+        return render_openmetrics(tel.metrics_snapshot(), labels, fleet,
+                                  build_info=self.build_info)
 
     def render_cached(self) -> str:
         """The /metrics serving path: one fresh render per ``cache_ttl``

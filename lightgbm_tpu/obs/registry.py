@@ -40,7 +40,7 @@ _SPAN_RING = 16384      # trace spans awaiting export (a few per iteration)
 _FINDING_RING = 1024    # health/guard findings kept for the whole run
 _DIST_RING = 8192       # recent samples per value distribution
 _FINDING_EVENTS = frozenset(
-    {"anomaly", "rank_divergence", "straggler"})
+    {"anomaly", "rank_divergence", "straggler", "alert"})
 
 
 class Telemetry:

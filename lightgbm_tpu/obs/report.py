@@ -114,6 +114,27 @@ def build_report(snapshot: Dict[str, Any], *,
     }
     hist = {k[len("hist."):]: v for k, v in gauges.items()
             if k.startswith("hist.")}
+    # SLO plane: alert transitions live in the findings ring (they are
+    # finding events, so they survive the whole run even after the
+    # general event ring evicts them).  "active" is the last state seen
+    # per objective — run_diff treats a newly-active id as a regression.
+    slo_transitions: List[Dict[str, Any]] = []
+    last_state: Dict[str, str] = {}
+    for ev in snapshot.get("findings", []) or []:
+        if str(ev.get("event")) != "alert":
+            continue
+        slo_transitions.append({k: v for k, v in ev.items()
+                                if k not in ("ts", "rank", "event")})
+        last_state[str(ev.get("objective", "?"))] = str(ev.get("state"))
+    alerts = {
+        "fired": int(counters.get("slo.alerts_fired", 0)),
+        "resolved": int(counters.get("slo.alerts_resolved", 0)),
+        "incidents": int(counters.get("slo.incidents", 0)),
+        "ticks": int(counters.get("slo.ticks", 0)),
+        "active": sorted(o for o, s in last_state.items()
+                         if s == "firing"),
+        "transitions": slo_transitions[-32:],
+    }
     # drift & lineage plane: PSI gauges + the alert/mapper-drift record
     # families, so run_diff flags a new drift alert exactly like a new
     # eviction reason (docs/Observability.md §13)
@@ -152,6 +173,7 @@ def build_report(snapshot: Dict[str, Any], *,
         "cost": cost,
         "hist": hist,
         "drift": drift,
+        "alerts": alerts,
         "collectives": {
             "count": counters.get("collectives.count", 0),
             "bytes": counters.get("collectives.bytes", 0),
@@ -283,6 +305,17 @@ def render_markdown(report: Dict[str, Any]) -> str:
         for a in dr.get("alerts", [])[:8]:
             lines.append("- " + "  ".join(f"{k}={_fmt(v)}"
                                           for k, v in sorted(a.items())))
+    al = report.get("alerts", {})
+    if al.get("fired") or al.get("active") or al.get("ticks"):
+        lines += ["", "## SLO alerts",
+                  f"- fired: {al.get('fired', 0)}   resolved: "
+                  f"{al.get('resolved', 0)}   incidents: "
+                  f"{al.get('incidents', 0)}   ticks: "
+                  f"{al.get('ticks', 0)}   active: "
+                  f"{al.get('active', []) or 'none'}"]
+        for t in al.get("transitions", [])[:8]:
+            lines.append("- " + "  ".join(f"{k}={_fmt(v)}"
+                                          for k, v in sorted(t.items())))
     pw = report.get("profile_windows", [])
     if pw:
         lines += ["", "## Profile windows"]
@@ -406,6 +439,21 @@ def compare_reports(prev: Dict[str, Any], cur: Dict[str, Any],
         return keys
     for key in sorted(_alert_keys(cur) - _alert_keys(prev)):
         ent = {"name": f"drift_alert:{key}", "prev": 0.0, "cur": 1.0,
+               "ratio": None, "regressed": True}
+        rep["new_reasons"].append(ent)
+        rep["regressions"].append(ent)
+
+    # SLO plane: an alert OBJECTIVE that fired in the candidate but not
+    # in the baseline is a regression — baseline-clean vs
+    # candidate-firing always flags, no threshold.  Resolved-by-run-end
+    # alerts count too (the fire happened); only objectives the
+    # baseline also fired are considered steady-state.
+    def _slo_fired(r: Dict[str, Any]) -> set:
+        return {str(t.get("objective", "?"))
+                for t in (_g(r, "alerts.transitions") or [])
+                if t.get("state") == "firing"}
+    for oid in sorted(_slo_fired(cur) - _slo_fired(prev)):
+        ent = {"name": f"slo_alert:{oid}", "prev": 0.0, "cur": 1.0,
                "ratio": None, "regressed": True}
         rep["new_reasons"].append(ent)
         rep["regressions"].append(ent)

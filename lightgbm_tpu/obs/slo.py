@@ -1,0 +1,696 @@
+# coding: utf-8
+"""Declarative SLO plane: objectives, burn-rate alerting, incident capture.
+
+The :class:`SloEngine` closes the observability loop in-process.  Every
+earlier plane (counters/gauges/dists, health audits, the exporter, the
+cost ledger, drift monitors, per-lane fleet stats) is passive — something
+external has to scrape or tail it to notice a problem.  The engine instead
+evaluates a catalog of declarative objectives against live
+``Telemetry`` snapshots on a host-side daemon ticker:
+
+- each objective is an :class:`SloSpec` (id, signal kind, target,
+  comparison, severity, fast/slow windows, hysteresis);
+- each tick appends a ``(ts, measured, breach)`` sample to the
+  objective's ring buffer and recomputes multi-window burn rates (the
+  fraction of breaching samples inside the fast and slow windows);
+- transitions use consecutive-breach hysteresis mirroring the
+  AdmissionController's flap-proofing: ``hysteresis`` breaching ticks in
+  a row (with both burn rates past ``burn_threshold``) fire the alert,
+  ``resolve_hysteresis`` clean ticks in a row resolve it — a single
+  outlier sample never pages;
+- transitions emit structured ``alert`` events (state firing/resolved,
+  objective id, measured vs target, burn rates, window) which land in
+  the findings ring, the JSONL sink, and — via the ``slo.*`` counter
+  namespace — the ``lgbm_slo_*`` Prometheus series;
+- a firing alert captures a bounded incident artifact
+  ``<telemetry_out>.incident.<id>.json`` reusing the crash
+  flight-recorder payload (recent event/finding rings, counters,
+  gauges) plus per-device memory + fragmentation and a caller-supplied
+  context snapshot (per-lane serving stats, training iteration).
+
+The evaluator is host-flag-only and dispatch-neutral by construction: it
+reads host-side telemetry snapshots and never touches device arrays, so
+an armed ticker adds zero dispatches (counter-asserted in bench + CI
+exactly like the profile control).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import log
+
+__all__ = ["SloSpec", "SloEngine", "BUILTIN_OBJECTIVES", "INCIDENT_SCHEMA"]
+
+INCIDENT_SCHEMA = "lightgbm_tpu.incident/1"
+
+# Samples kept per objective; windows select a suffix of this ring.
+_SAMPLE_RING = 512
+# Alert transitions kept for /alerts and the run report.
+_HISTORY_RING = 64
+# Incident artifacts are bounded per engine so a flapping objective
+# cannot fill a disk.
+_MAX_INCIDENTS = 8
+
+_SEVERITIES = ("page", "ticket")
+_COMPARISONS = ("above", "below")
+
+
+class SloSpec:
+    """One declarative objective.
+
+    ``kind`` selects the signal extractor (see ``SloEngine._measure``);
+    ``target`` is the threshold; ``comparison`` says which side of it is
+    a breach (``"above"``: measured > target breaches).  ``hysteresis``
+    consecutive breaching ticks fire, ``resolve_hysteresis`` clean ticks
+    resolve.  ``plane`` gates which engines evaluate the objective
+    (``"serve"``, ``"train"`` or ``"any"``).
+    """
+
+    __slots__ = ("id", "kind", "target", "comparison", "severity",
+                 "hysteresis", "resolve_hysteresis", "fast_window_s",
+                 "slow_window_s", "burn_threshold", "plane", "enabled",
+                 "description")
+
+    def __init__(self, id, kind, target, comparison="above",
+                 severity="ticket", hysteresis=3, resolve_hysteresis=None,
+                 fast_window_s=60.0, slow_window_s=600.0,
+                 burn_threshold=0.5, plane="any", enabled=True,
+                 description=""):
+        if comparison not in _COMPARISONS:
+            raise ValueError(f"slo comparison must be one of {_COMPARISONS}")
+        if severity not in _SEVERITIES:
+            raise ValueError(f"slo severity must be one of {_SEVERITIES}")
+        self.id = str(id)
+        self.kind = str(kind)
+        self.target = float(target)
+        self.comparison = comparison
+        self.severity = severity
+        self.hysteresis = max(1, int(hysteresis))
+        self.resolve_hysteresis = max(1, int(
+            self.hysteresis if resolve_hysteresis is None
+            else resolve_hysteresis))
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.plane = str(plane)
+        self.enabled = bool(enabled)
+        self.description = str(description)
+
+    def breaches(self, measured: float) -> bool:
+        if self.comparison == "above":
+            return measured > self.target
+        return measured < self.target
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _spec(**kw) -> SloSpec:
+    return SloSpec(**kw)
+
+
+# Built-in catalog.  Targets are deliberately conservative: a healthy
+# run (bench --micro / --serve clean legs) must produce zero alerts.
+# Objectives whose feed is absent simply skip the tick (measured=None).
+BUILTIN_OBJECTIVES: Tuple[SloSpec, ...] = (
+    _spec(id="serve.latency_p99", kind="latency_p99", target=250.0,
+          comparison="above", severity="page", hysteresis=2, plane="serve",
+          description="serve.latency_ms p99 (ms) vs target"),
+    _spec(id="serve.shed_ratio", kind="shed_ratio", target=0.05,
+          comparison="above", severity="page", hysteresis=3, plane="serve",
+          description="(shed+rejected)/offered request ratio per tick"),
+    _spec(id="serve.lane_liveness", kind="lane_liveness", target=30.0,
+          comparison="above", severity="page", hysteresis=2, plane="serve",
+          description="seconds a lane queue has been non-empty with no "
+                      "dispatch progress"),
+    _spec(id="serve.spill_imbalance", kind="spill_ratio", target=0.25,
+          comparison="above", severity="ticket", hysteresis=3, plane="serve",
+          description="cross-lane spills per offered request"),
+    _spec(id="serve.worker_liveness", kind="worker_wedged", target=0.0,
+          comparison="above", severity="page", hysteresis=1, plane="serve",
+          description="wedged (non-exiting) lane worker threads"),
+    _spec(id="serve.shadow_divergence", kind="shadow_divergence",
+          target=1e-3, comparison="above", severity="ticket", hysteresis=3,
+          plane="serve",
+          description="max |candidate - live| during rollover shadow scoring"),
+    _spec(id="serve.model_age", kind="model_age", target=86400.0,
+          comparison="above", severity="ticket", hysteresis=2, plane="serve",
+          description="seconds since the freshest resident model was loaded"),
+    _spec(id="serve.drift_score", kind="drift_ceiling", target=0.5,
+          comparison="above", severity="ticket", hysteresis=3, plane="any",
+          description="drift monitor PSI ceiling (drift.psi_max gauge)"),
+    _spec(id="train.liveness", kind="train_liveness", target=600.0,
+          comparison="above", severity="page", hysteresis=2, plane="train",
+          description="seconds since the training loop last advanced "
+                      "(drain-granularity heartbeat)"),
+    _spec(id="train.iteration_rate", kind="iteration_rate", target=0.0,
+          comparison="below", severity="ticket", hysteresis=3, plane="train",
+          description="iterations/s floor; default 0 disables — set a "
+                      "positive target via slo_config to arm"),
+    _spec(id="train.straggler_skew", kind="straggler_skew", target=5.0,
+          comparison="above", severity="ticket", hysteresis=3, plane="train",
+          description="max cross-rank section skew ratio (health.skew.*)"),
+    _spec(id="train.checkpoint_age", kind="checkpoint_age", target=3600.0,
+          comparison="above", severity="ticket", hysteresis=2, plane="train",
+          description="seconds since the last successful checkpoint write"),
+    _spec(id="ingest.prefetch_starvation", kind="prefetch_starvation",
+          target=0.5, comparison="above", severity="ticket", hysteresis=3,
+          plane="train",
+          description="fraction of wall time the host blocked on prefetch "
+                      "transfer slots"),
+    _spec(id="obs.scrape_staleness", kind="scrape_staleness", target=900.0,
+          comparison="above", severity="ticket", hysteresis=2, plane="any",
+          description="seconds since the exporter last served /metrics "
+                      "(only once it has been scraped at all)"),
+)
+
+_BUILTIN_KINDS = frozenset(s.kind for s in BUILTIN_OBJECTIVES)
+
+
+def load_slo_config(path: str) -> List[Dict[str, Any]]:
+    """Parse a ``slo_config`` JSON file into raw objective dicts.
+
+    Accepts either ``{"objectives": [...]}`` or a bare list.  Raises
+    ``ValueError`` on malformed structure so callers can surface a
+    config error instead of silently running without objectives.
+    """
+    with open(path, "r") as fh:
+        raw = json.load(fh)
+    if isinstance(raw, dict):
+        raw = raw.get("objectives", [])
+    if not isinstance(raw, list):
+        raise ValueError("slo_config must be a list or {'objectives': [...]}")
+    out = []
+    for entry in raw:
+        if not isinstance(entry, dict) or "id" not in entry:
+            raise ValueError("each slo_config objective needs an 'id'")
+        out.append(dict(entry))
+    return out
+
+
+class _ObjectiveState:
+    __slots__ = ("spec", "samples", "over", "under", "firing", "alert_seq",
+                 "fired_ts", "last_measured", "last_burn")
+
+    def __init__(self, spec: SloSpec):
+        self.spec = spec
+        self.samples = collections.deque(maxlen=_SAMPLE_RING)
+        self.over = 0
+        self.under = 0
+        self.firing = False
+        self.alert_seq = 0
+        self.fired_ts = None
+        self.last_measured = None
+        self.last_burn = (0.0, 0.0)
+
+    def burn_rates(self, now: float) -> Tuple[float, float]:
+        fast_n = fast_b = slow_n = slow_b = 0
+        fast_cut = now - self.spec.fast_window_s
+        slow_cut = now - self.spec.slow_window_s
+        for ts, _m, breach in self.samples:
+            if ts >= slow_cut:
+                slow_n += 1
+                slow_b += breach
+                if ts >= fast_cut:
+                    fast_n += 1
+                    fast_b += breach
+        fast = (fast_b / fast_n) if fast_n else 0.0
+        slow = (slow_b / slow_n) if slow_n else 0.0
+        return fast, slow
+
+
+class SloEngine:
+    """Evaluates declarative SLOs over live telemetry snapshots.
+
+    Host-flag-only: ``step()`` reads ``telemetry.metrics_snapshot()``
+    (pure host dicts), updates per-objective rings/streaks, and emits
+    events/counters.  It never touches a device array, so arming the
+    engine is dispatch-neutral.
+
+    ``source`` selects which catalog planes are active (``"train"`` or
+    ``"serve"``; objectives with ``plane="any"`` always run).
+    ``context_fn`` is an optional zero-arg callable whose return value
+    is embedded in incident artifacts (e.g. per-lane serving stats).
+    """
+
+    def __init__(self, telemetry, *, source="train", specs=None,
+                 config_path="", tick_period_s=5.0, incident_base="",
+                 context_fn: Optional[Callable[[], Any]] = None):
+        self.tel = telemetry
+        self.source = str(source)
+        self.tick_period_s = float(tick_period_s)
+        self.incident_base = str(incident_base or "")
+        self.context_fn = context_fn
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_tick = 0.0
+        self._prev_counters: Dict[str, float] = {}
+        self._prev_tick_ts: Optional[float] = None
+        self._lane_stall: Dict[str, float] = {}
+        self._history: collections.deque = collections.deque(
+            maxlen=_HISTORY_RING)
+        self._incidents: List[str] = []
+        self._fired = 0
+        self._resolved = 0
+        self._ticks = 0
+        self._train_active = False
+        self._last_heartbeat = None
+        self._last_heartbeat_iter = None
+        self._closed = False
+
+        merged = self._build_specs(specs, config_path)
+        self._objs: Dict[str, _ObjectiveState] = collections.OrderedDict(
+            (s.id, _ObjectiveState(s)) for s in merged)
+        self.tel.gauge("slo.objectives", float(len(self._objs)))
+
+    # ------------------------------------------------------------ specs
+    def _build_specs(self, specs, config_path) -> List[SloSpec]:
+        catalog = collections.OrderedDict(
+            (s.id, s) for s in (specs if specs is not None
+                                else BUILTIN_OBJECTIVES))
+        if config_path:
+            try:
+                entries = load_slo_config(config_path)
+            except Exception as exc:  # malformed file: run the catalog
+                log.warning("slo_config %s unreadable: %s", config_path, exc)
+                self.tel.event("slo_config_error", path=str(config_path),
+                               error=str(exc))
+                entries = []
+            for entry in entries:
+                oid = str(entry.pop("id"))
+                base = catalog.get(oid)
+                if base is not None:
+                    merged = base.to_dict()
+                    merged.update(entry)
+                elif "kind" in entry:
+                    merged = dict(entry, id=oid)
+                else:
+                    log.warning("slo_config: new objective %r needs a "
+                                "'kind'; skipped", oid)
+                    self.tel.event("slo_config_error", objective=oid,
+                                   error="missing kind")
+                    continue
+                if merged.get("kind") not in _BUILTIN_KINDS:
+                    log.warning("slo_config: objective %r has unknown kind "
+                                "%r; skipped", oid, merged.get("kind"))
+                    self.tel.event("slo_config_error", objective=oid,
+                                   error=f"unknown kind {merged.get('kind')}")
+                    continue
+                disabled = bool(merged.pop("disabled", False))
+                merged.setdefault("id", oid)
+                try:
+                    spec = SloSpec(**{k: v for k, v in merged.items()
+                                      if k in SloSpec.__slots__})
+                except Exception as exc:
+                    log.warning("slo_config: objective %r invalid: %s",
+                                oid, exc)
+                    self.tel.event("slo_config_error", objective=oid,
+                                   error=str(exc))
+                    continue
+                spec.enabled = spec.enabled and not disabled
+                catalog[oid] = spec
+            self.tel.event("slo_config_loaded", path=str(config_path),
+                           objectives=len(catalog))
+        active = [s for s in catalog.values()
+                  if s.enabled and s.plane in ("any", self.source)]
+        return active
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Start the daemon ticker; no-op when tick_period_s <= 0."""
+        if self.tick_period_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="slo-ticker", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_period_s):
+            try:
+                self.step(force=True)
+            except Exception as exc:  # never kill the ticker
+                log.warning("slo tick failed: %s", exc)
+
+    def stop(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    close = stop
+
+    # ------------------------------------------------------ train feed
+    def note_training_heartbeat(self, iteration=None) -> None:
+        """Called by the trainer at drain granularity; arms train.liveness."""
+        with self._mu:
+            self._train_active = True
+            self._last_heartbeat = self.tel.wall_now()
+            if iteration is not None:
+                self._last_heartbeat_iter = iteration
+
+    def note_training_done(self) -> None:
+        """Disarms the training liveness watchdog (clean finalize)."""
+        with self._mu:
+            self._train_active = False
+
+    # ------------------------------------------------------------ step
+    def step(self, now: Optional[float] = None, force: bool = False) -> bool:
+        """Evaluate every objective once.  Time-gated unless ``force``.
+
+        ``now`` is injectable for deterministic tests.  Returns True if
+        a tick actually ran.
+        """
+        if self._closed and not force:
+            return False
+        if now is None:
+            now = self.tel.wall_now()
+        with self._mu:
+            if not force and (now - self._last_tick) < self.tick_period_s:
+                return False
+            self._last_tick = now
+            snap = self.tel.metrics_snapshot()
+            counters = snap.get("counters", {}) or {}
+            gauges = snap.get("gauges", {}) or {}
+            dists = snap.get("dists", {}) or {}
+            dt = (now - self._prev_tick_ts) if self._prev_tick_ts else 0.0
+            transitions = []
+            evaluated = 0
+            for st in self._objs.values():
+                measured = self._measure(st.spec, counters, gauges, dists,
+                                         dt, now)
+                if measured is None:
+                    continue
+                evaluated += 1
+                tr = self._observe(st, measured, now)
+                if tr is not None:
+                    transitions.append(tr)
+            self._prev_counters = dict(counters)
+            self._prev_tick_ts = now
+            self._ticks += 1
+            active = sum(1 for st in self._objs.values() if st.firing)
+        # Telemetry writes and incident capture outside the engine lock:
+        # tel has its own mutex and incident capture does file I/O.
+        self.tel.inc("slo.ticks")
+        if evaluated:
+            self.tel.inc("slo.evaluations", evaluated)
+        self.tel.gauge("slo.active_alerts", float(active))
+        for tr in transitions:
+            self._emit_transition(tr)
+        return True
+
+    def _delta(self, counters: Dict[str, float], name: str) -> float:
+        return float(counters.get(name, 0.0)) - float(
+            self._prev_counters.get(name, 0.0))
+
+    @staticmethod
+    def _prefix_gauges(gauges: Dict[str, float], prefix: str):
+        return [(k, v) for k, v in gauges.items() if k.startswith(prefix)]
+
+    def _measure(self, spec: SloSpec, counters, gauges, dists, dt, now):
+        """Extract the objective's signal; None = feed absent, skip tick."""
+        kind = spec.kind
+        if kind == "latency_p99":
+            d = dists.get("serve.latency_ms")
+            if not d or not d.get("count"):
+                return None
+            return float(d.get("p99", 0.0))
+        if kind == "shed_ratio":
+            shed = self._delta(counters, "serve.shed") + self._delta(
+                counters, "serve.rejected")
+            offered = self._delta(counters, "serve.requests") + self._delta(
+                counters, "serve.rejected")
+            if offered <= 0:
+                return None
+            return max(0.0, shed) / offered
+        if kind == "spill_ratio":
+            offered = self._delta(counters, "serve.requests")
+            if offered <= 0:
+                return None
+            return max(0.0, self._delta(counters, "serve.spills")) / offered
+        if kind == "worker_wedged":
+            if "serve.requests" not in counters:
+                return None
+            return float(counters.get("serve.worker_wedged", 0.0))
+        if kind == "lane_liveness":
+            return self._lane_stall_seconds(counters, gauges, now)
+        if kind == "shadow_divergence":
+            v = gauges.get("serve.shadow_divergence")
+            return None if v is None else float(v)
+        if kind == "model_age":
+            ages = self._prefix_gauges(gauges, "serve.model_age_s.")
+            if not ages:
+                return None
+            return max(float(v) for _k, v in ages)
+        if kind == "drift_ceiling":
+            v = gauges.get("drift.psi_max")
+            return None if v is None else float(v)
+        if kind == "train_liveness":
+            if not self._train_active or self._last_heartbeat is None:
+                return None
+            return max(0.0, now - self._last_heartbeat)
+        if kind == "iteration_rate":
+            if spec.target <= 0 or dt <= 0:
+                return None
+            it = self._delta(counters, "iterations")
+            return it / dt
+        if kind == "straggler_skew":
+            skews = self._prefix_gauges(gauges, "health.skew.")
+            if not skews:
+                return None
+            return max(float(v) for _k, v in skews)
+        if kind == "checkpoint_age":
+            ts = gauges.get("ckpt.last_write_ts")
+            if ts is None:
+                return None
+            return max(0.0, now - float(ts))
+        if kind == "prefetch_starvation":
+            if dt <= 0 or "prefetch.chunks" not in counters:
+                return None
+            wait_ms = self._delta(counters, "prefetch.host_wait_ms")
+            return max(0.0, wait_ms) / (dt * 1000.0)
+        if kind == "scrape_staleness":
+            ts = gauges.get("export.last_scrape_ts")
+            if ts is None:
+                return None
+            return max(0.0, now - float(ts))
+        return None
+
+    def _lane_stall_seconds(self, counters, gauges, now) -> Optional[float]:
+        """Max seconds any lane queue has been non-empty without dispatch
+        progress.  Lanes are discovered from ``serve.d{i}.queue_depth``
+        gauges; single-lane deployments fall back to the aggregates."""
+        lanes = []
+        for k, v in gauges.items():
+            if k.startswith("serve.d") and k.endswith(".queue_depth"):
+                lane = k[len("serve."):-len(".queue_depth")]
+                lanes.append((lane, float(v),
+                              float(counters.get(f"serve.{lane}.dispatches",
+                                                 0.0))))
+        if not lanes:
+            if "serve.queue_depth" not in gauges:
+                return None
+            lanes = [("all", float(gauges.get("serve.queue_depth", 0.0)),
+                      float(counters.get("serve.dispatches", 0.0)))]
+        worst = 0.0
+        for lane, depth, dispatches in lanes:
+            prev = float(self._prev_counters.get(
+                f"serve.{lane}.dispatches"
+                if lane != "all" else "serve.dispatches", dispatches))
+            stalled = depth > 0 and dispatches <= prev \
+                and self._prev_tick_ts is not None
+            if stalled:
+                start = self._lane_stall.setdefault(lane, self._prev_tick_ts)
+                worst = max(worst, now - start)
+            else:
+                self._lane_stall.pop(lane, None)
+        return worst
+
+    # ----------------------------------------------------- transitions
+    def _observe(self, st: _ObjectiveState, measured: float, now: float):
+        spec = st.spec
+        breach = spec.breaches(measured)
+        st.samples.append((now, float(measured), bool(breach)))
+        st.last_measured = float(measured)
+        if breach:
+            st.over += 1
+            st.under = 0
+        else:
+            st.under += 1
+            st.over = 0
+        fast, slow = st.burn_rates(now)
+        st.last_burn = (fast, slow)
+        if not st.firing:
+            if (st.over >= spec.hysteresis and fast >= spec.burn_threshold
+                    and slow >= spec.burn_threshold):
+                st.firing = True
+                st.alert_seq += 1
+                st.fired_ts = now
+                self._fired += 1
+                return self._alert_record(st, "firing", measured, fast,
+                                          slow, now)
+        else:
+            if st.under >= spec.resolve_hysteresis:
+                st.firing = False
+                rec = self._alert_record(st, "resolved", measured, fast,
+                                         slow, now)
+                rec["duration_s"] = round(now - (st.fired_ts or now), 3)
+                st.fired_ts = None
+                self._resolved += 1
+                return rec
+        return None
+
+    def _alert_record(self, st: _ObjectiveState, state: str, measured,
+                      fast, slow, now) -> Dict[str, Any]:
+        spec = st.spec
+        return {
+            "state": state,
+            "objective": spec.id,
+            "alert_id": f"{spec.id}#{st.alert_seq}",
+            "severity": spec.severity,
+            "kind": spec.kind,
+            "measured": round(float(measured), 6),
+            "target": spec.target,
+            "comparison": spec.comparison,
+            "burn_fast": round(fast, 4),
+            "burn_slow": round(slow, 4),
+            "fast_window_s": spec.fast_window_s,
+            "slow_window_s": spec.slow_window_s,
+            "ts": now,
+        }
+
+    def _emit_transition(self, rec: Dict[str, Any]) -> None:
+        self.tel.event("alert", **rec)
+        self._history.append(dict(rec))
+        if rec["state"] == "firing":
+            self.tel.inc("slo.alerts_fired")
+            if rec["severity"] == "page":
+                self.tel.inc("slo.alerts_page")
+            path = self._capture_incident(rec)
+            if path:
+                rec["incident"] = path
+        else:
+            self.tel.inc("slo.alerts_resolved")
+
+    # -------------------------------------------------------- incident
+    def _capture_incident(self, rec: Dict[str, Any]) -> Optional[str]:
+        if not self.incident_base:
+            return None
+        if len(self._incidents) >= _MAX_INCIDENTS:
+            self.tel.inc("slo.incidents_dropped")
+            return None
+        safe = rec["alert_id"].replace("#", "-").replace("/", "_")
+        path = f"{self.incident_base}.incident.{safe}.json"
+        try:
+            payload = {
+                "schema": INCIDENT_SCHEMA,
+                "ts": rec["ts"],
+                "rank": self.tel.rank,
+                "run_id": self.tel.run_id,
+                "source": self.source,
+                "alert": dict(rec),
+                "active_alerts": [s["alert_id"] for s in self.active_alerts()],
+                "telemetry": self.tel.crash_payload(),
+                "memory": self._memory_snapshot(),
+            }
+            if self.context_fn is not None:
+                try:
+                    payload["context"] = self.context_fn()
+                except Exception as exc:
+                    payload["context"] = {"error": str(exc)}
+            from ..resilience.atomicio import atomic_write_text
+            atomic_write_text(path, json.dumps(payload, indent=1,
+                                               default=str))
+        except Exception as exc:
+            log.warning("incident capture failed for %s: %s",
+                        rec["alert_id"], exc)
+            return None
+        self._incidents.append(path)
+        self.tel.inc("slo.incidents")
+        self.tel.event("incident_captured", objective=rec["objective"],
+                       alert_id=rec["alert_id"], path=path)
+        return path
+
+    @staticmethod
+    def _memory_snapshot() -> Dict[str, Any]:
+        """Per-device memory + fragmentation; host stats API only."""
+        out: Dict[str, Any] = {}
+        try:
+            from .jaxmon import device_memory_stats, fragmentation
+            stats = device_memory_stats()
+            for idx, ent in (stats or {}).items():
+                entry = dict(ent)
+                frag = fragmentation(ent)
+                if frag is not None:
+                    entry["fragmentation"] = frag
+                out[str(idx)] = entry
+        except Exception:
+            pass
+        return out
+
+    # --------------------------------------------------------- queries
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        out = []
+        for st in self._objs.values():
+            if not st.firing:
+                continue
+            out.append({
+                "objective": st.spec.id,
+                "alert_id": f"{st.spec.id}#{st.alert_seq}",
+                "severity": st.spec.severity,
+                "since_ts": st.fired_ts,
+                "measured": st.last_measured,
+                "target": st.spec.target,
+                "burn_fast": round(st.last_burn[0], 4),
+                "burn_slow": round(st.last_burn[1], 4),
+            })
+        return out
+
+    def gating_reason(self) -> Optional[str]:
+        """Objective id of a firing page-severity alert, else None.
+
+        Used by ``/readyz`` when ``slo_readyz_gating`` is on."""
+        for st in self._objs.values():
+            if st.firing and st.spec.severity == "page":
+                return st.spec.id
+        return None
+
+    def alerts_payload(self) -> Dict[str, Any]:
+        """The ``GET /alerts`` + run-report ``alerts`` section source."""
+        with self._mu:
+            objectives = []
+            for st in self._objs.values():
+                objectives.append({
+                    "id": st.spec.id,
+                    "kind": st.spec.kind,
+                    "target": st.spec.target,
+                    "comparison": st.spec.comparison,
+                    "severity": st.spec.severity,
+                    "plane": st.spec.plane,
+                    "firing": st.firing,
+                    "last_measured": st.last_measured,
+                    "breach_streak": st.over,
+                    "burn_fast": round(st.last_burn[0], 4),
+                    "burn_slow": round(st.last_burn[1], 4),
+                    "samples": len(st.samples),
+                })
+            return {
+                "run_id": self.tel.run_id,
+                "rank": self.tel.rank,
+                "source": self.source,
+                "ticks": self._ticks,
+                "fired": self._fired,
+                "resolved": self._resolved,
+                "active": self.active_alerts(),
+                "objectives": objectives,
+                "history": list(self._history),
+                "incidents": list(self._incidents),
+            }

@@ -248,6 +248,9 @@ class CheckpointManager:
             dt = time.perf_counter() - t0
             if tel is not None and tel.enabled:
                 tel.inc("ckpt.written")
+                # checkpoint-age feed for the SLO plane: the
+                # train.checkpoint_age objective measures now - this
+                tel.gauge("ckpt.last_write_ts", time.time())
                 tel.event("checkpoint_written", iteration=iteration,
                           path=cdir, bytes=len(blob),
                           seconds=round(dt, 4))

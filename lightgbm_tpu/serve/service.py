@@ -116,7 +116,11 @@ class PredictionService:
                  drift_eval_rows: Optional[int] = None,
                  drift_hysteresis: Optional[int] = None,
                  serve_devices: Optional[int] = None,
-                 routing: Optional[str] = None):
+                 routing: Optional[str] = None,
+                 slo_enabled: Optional[bool] = None,
+                 slo_config: Optional[str] = None,
+                 slo_tick_period_s: Optional[float] = None,
+                 slo_readyz_gating: Optional[bool] = None):
         if isinstance(boosters_or_paths, dict):
             specs = dict(boosters_or_paths)
         elif isinstance(boosters_or_paths, (list, tuple)):
@@ -150,6 +154,18 @@ class PredictionService:
             drift_eval_rows = param_default("drift_eval_rows")
         if drift_hysteresis is None:
             drift_hysteresis = param_default("drift_hysteresis")
+        # SLO plane knobs (obs/slo.py), defaulted from the config
+        # registry; a spec file implies arming
+        if slo_enabled is None:
+            slo_enabled = param_default("slo_enabled")
+        if slo_config is None:
+            slo_config = param_default("slo_config")
+        if slo_tick_period_s is None:
+            slo_tick_period_s = param_default("slo_tick_period_s")
+        if slo_readyz_gating is None:
+            slo_readyz_gating = param_default("slo_readyz_gating")
+        self._slo_gate = bool(slo_readyz_gating)
+        self.slo = None          # SloEngine, armed below after wiring
         self.retry_policy = retry_policy
 
         # serving fleet (docs/Serving.md "Serving fleet"): replicate
@@ -238,6 +254,20 @@ class PredictionService:
             self.admission = AdmissionController(
                 self.batcher, self.tel, float(target_p99_ms))
             self.batcher.on_batch_done = self.admission.step
+        # SLO plane (obs/slo.py): serving-catalog objectives evaluated
+        # on the engine's own daemon ticker over this registry.
+        # Host-side snapshot reads only — arming adds zero dispatches.
+        if bool(slo_enabled) or str(slo_config or ""):
+            from ..obs.slo import SloEngine
+            self.slo = SloEngine(
+                self.tel, source="serve",
+                config_path=str(slo_config or ""),
+                tick_period_s=float(slo_tick_period_s or 0.0),
+                incident_base=str(telemetry_out or ""),
+                context_fn=self._slo_context)
+            self.slo.start()
+            if self._metrics is not None:
+                self._metrics.alerts_fn = self.slo.alerts_payload
         self.tel.event("serve_start", models=list(specs),
                        max_batch_rows=int(max_batch_rows),
                        max_delay_ms=float(max_delay_ms),
@@ -268,7 +298,25 @@ class PredictionService:
             return False, "rollover_swap"
         if not self._warmed:
             return False, "warmup_pending"
+        if self._slo_gate and self.slo is not None:
+            # opt-in (slo_readyz_gating): a firing PAGE-severity alert
+            # drains this replica at the load balancer while it works
+            # through the violation — alive, but not routable
+            oid = self.slo.gating_reason()
+            if oid is not None:
+                return False, f"slo_alert:{oid}"
         return True, "ready"
+
+    def _slo_context(self):
+        """Incident-artifact context: the full service stats snapshot
+        (per-lane queue/dispatch/spill detail included) plus lineage of
+        the resident models — host-side reads only."""
+        try:
+            ctx = {"stats": self.stats()}
+        except Exception as e:
+            ctx = {"stats_error": repr(e)}
+        ctx["models"] = list(self._model_born)
+        return ctx
 
     def _dispatch_batch(self, model_id: str, X,
                         device: int = 0) -> np.ndarray:
@@ -300,6 +348,8 @@ class PredictionService:
             st["requests"] += 1
             st["remaining"] -= 1
             reqtrace.annotate(shadow_divergence=round(div, 9))
+            # rollover-divergence feed for the SLO plane
+            self.tel.gauge("serve.shadow_divergence", div)
             self.tel.event("serve_shadow", model_id=model_id,
                            divergence=round(div, 9),
                            remaining=int(st["remaining"]),
@@ -739,6 +789,14 @@ class PredictionService:
         if self._closed:
             return
         self._closed = True
+        if self.slo is not None:
+            # final forced evaluation (a resolved-by-shutdown alert
+            # still records its cycle), then stop the ticker
+            try:
+                self.slo.step(force=True)
+            except Exception:
+                pass
+            self.slo.stop()
         self.batcher.close(drain=drain, drain_timeout_s=drain_timeout_s)
         final = self.stats()
         final.pop("residency", None)

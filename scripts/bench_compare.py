@@ -218,6 +218,22 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     # - bulk_identity_mismatch: 0.0 while predict_bulk (row-sharded
     #   over the mesh) stays numerically identical to the
     #   single-device dispatch path; zero-to-nonzero always flags.
+    # - slo_dispatches_per_iter (bench.py --micro slo leg): training
+    #   with the SLO engine armed on the built-in catalog — burn-rate
+    #   evaluation reads host-side telemetry snapshots only, so this
+    #   must EQUAL dispatches_per_iter exactly;
+    # - slo_alerts (same leg): alerts fired on a HEALTHY run — the
+    #   false-positive gate, MUST stay 0; zero-to-nonzero always flags;
+    # - slo_dispatches_per_request (bench.py --serve forced-alert leg):
+    #   the closed loop with the SLO engine armed — exactly 1.0 like
+    #   the bare serving contract;
+    # - slo_false_positives / slo_alert_missed / slo_alert_unresolved /
+    #   slo_incident_invalid (same leg): the deterministic alert
+    #   lifecycle — the injected slow dispatch must fire EXACTLY the
+    #   latency objective (no other objective fires), exactly once,
+    #   resolve after the ring refills, and leave a schema-valid
+    #   incident artifact; each is 0 on a correct run and
+    #   zero-to-nonzero always flags.
     report["deterministic"] = {}
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
@@ -238,7 +254,11 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
                  "drift_alerts_control", "drift_psi_max",
                  "fleet_dispatches_per_request_worst",
                  "fleet_compiles_per_1k_worst",
-                 "fleet_unrouted_devices", "bulk_identity_mismatch"):
+                 "fleet_unrouted_devices", "bulk_identity_mismatch",
+                 "slo_dispatches_per_iter", "slo_alerts",
+                 "slo_dispatches_per_request", "slo_false_positives",
+                 "slo_alert_missed", "slo_alert_unresolved",
+                 "slo_incident_invalid"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue

@@ -115,6 +115,7 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     drift: List[Dict[str, Any]] = []
     fleet_access: List[Dict[str, Any]] = []
     bulk: List[Dict[str, Any]] = []
+    alerts: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -124,8 +125,10 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             iters.append(r["iter"])
         if r.get("event") in ("anomaly", "rank_divergence", "straggler",
                               "serve_batch_error", "recovery",
-                              "drift_alert", "mapper_drift"):
+                              "drift_alert", "mapper_drift", "alert"):
             findings.append(r)
+        if r.get("event") == "alert":
+            alerts.append(r)
         if r.get("event") == "ingest":
             ingest.append(r)
         if r.get("event") == "cost_ledger":
@@ -210,6 +213,20 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             if rates:
                 parts.append(f"bulk_rows_per_s={_mean(rates):.4g}")
         lines.append("  ".join(parts))
+    if alerts:
+        # one line for the SLO plane (obs/slo.py): fire/resolve totals
+        # and which objectives are still firing at the end of the
+        # stream (last state per objective wins)
+        fired = sum(1 for a in alerts if a.get("state") == "firing")
+        resolved = sum(1 for a in alerts if a.get("state") == "resolved")
+        last_state: Dict[str, str] = {}
+        for a in alerts:
+            last_state[str(a.get("objective", "?"))] = \
+                str(a.get("state", "?"))
+        active = sorted(o for o, s in last_state.items() if s == "firing")
+        lines.append(
+            f"alerts: fired={fired}  resolved={resolved}  "
+            f"active={active if active else 'none'}")
     if ingest:
         # one line per ingest (streamed/cached dataset build): source,
         # chunk arithmetic, the bounded-residency watermark, cache hit
@@ -233,20 +250,48 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def _stat_id(path: str):
+    """(st_dev, st_ino, st_size) of path, or None while it's absent
+    (mid-rotation the new file may not exist yet)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_dev, st.st_ino, st.st_size)
+
+
 def follow(path: str, events: Optional[List[str]],
-           rank: Optional[int]) -> None:
+           rank: Optional[int], _poll_s: float = 0.2) -> None:
     """tail -f semantics: print matching records as the writer appends
     (poll loop).  A readline() that races the writer mid-flush returns
     a newline-less fragment — buffer it and re-read until the line
     completes, so a large record split across flushes is parsed whole
-    instead of dropped as two corrupt halves."""
+    instead of dropped as two corrupt halves.
+
+    Rotation-safe: on every idle poll the path is re-stat()ed — a new
+    inode (rotate/rename) or a size smaller than our read offset
+    (truncate-in-place) means the handle tails a dead offset, so the
+    file is reopened from the start and the partial-fragment buffer is
+    dropped with it (it belonged to the old stream)."""
     t0 = None
     partial = ""
-    with open(path) as fh:
+    fh = open(path)
+    try:
         while True:
             chunk = fh.readline()
             if not chunk:
-                time.sleep(0.2)
+                cur = _stat_id(path)
+                if cur is not None:
+                    opened = os.fstat(fh.fileno())
+                    rotated = (cur[0], cur[1]) != (opened.st_dev,
+                                                   opened.st_ino)
+                    truncated = cur[2] < fh.tell()
+                    if rotated or truncated:
+                        fh.close()
+                        fh = open(path)
+                        partial = ""
+                        continue
+                time.sleep(_poll_s)
                 continue
             partial += chunk
             if not partial.endswith("\n"):
@@ -257,6 +302,8 @@ def follow(path: str, events: Optional[List[str]],
                     t0 = rec["ts"]
                 if _match(rec, events, rank):
                     print(format_record(rec, t0), flush=True)
+    finally:
+        fh.close()
 
 
 def render_report(path: str) -> str:

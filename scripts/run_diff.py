@@ -9,7 +9,10 @@ established — counters that carry no wall-clock noise (dispatches per
 iteration, cost-ledger flops/bytes per iteration, the analytic-model
 fraction) get a tight threshold, zero-to-nonzero always flags, a NEW
 ``megastep_evicted`` / ``degrade`` reason (or ``drift_alert``) always
-flags, and wall timings diff per-call under the loose timing
+flags, an SLO objective that FIRED in the candidate but not in the
+baseline (``slo_alert:<objective>``) always flags — baseline-clean vs
+candidate-firing exits 1 under ``--fail-on-regress`` with no
+threshold — and wall timings diff per-call under the loose timing
 threshold — flagged timings are informational unless
 ``--fail-on-timing`` is given, because identical runs must compare
 clean and per-call wall time between identical runs crosses any

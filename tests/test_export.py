@@ -326,6 +326,40 @@ def test_serve_access_on_closed_batcher():
     assert acc[0]["error"] == "ServeClosed"
 
 
+# ------------------------------------------------------- build info
+def test_build_info_series(tmp_path):
+    """The exporter carries one constant ``lgbm_build_info{...} 1``
+    info-series so scrapes are joinable across deploys: package
+    version, jax version, active backend, plus the exporter's own
+    rank/run_id labels."""
+    from lightgbm_tpu.obs.export import build_info_labels
+    info = build_info_labels()
+    assert set(info) == {"version", "jax_version", "backend"}
+    assert all(isinstance(v, str) and v for v in info.values())
+    assert info["version"] == lgb.__version__
+
+    port = _free_port()
+    X, y = _data(n=300)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "metrics_port": port},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    try:
+        _, body = _scrape(port)
+        types, samples = _parse_exposition(body)
+        assert types["lgbm_build_info"] == "gauge"
+        key = next(k for k in samples
+                   if k.startswith("lgbm_build_info{"))
+        assert samples[key] == 1.0
+        run_id = bst._gbdt.telemetry.run_id
+        for frag in (f'version="{info["version"]}"',
+                     f'jax_version="{info["jax_version"]}"',
+                     f'backend="{info["backend"]}"',
+                     'rank="0"', f'run_id="{run_id}"'):
+            assert frag in key, (frag, key)
+    finally:
+        bst._gbdt._metrics.stop()
+
+
 # --------------------------------------------- per-device memory stats
 def test_device_memory_stats_cpu_degrades_to_none():
     from lightgbm_tpu.obs.jaxmon import (device_memory_stats,
@@ -394,6 +428,58 @@ def test_obs_tail_dedups_bench_runs(tmp_path, capsys):
     recs = obs_tail.load_records(str(traj), dedup_runs=True)
     # last-wins per run_id, bench_compare semantics
     assert [r["value"] for r in recs] == [2.0, 3.0]
+
+
+def _readline_or_die(stream, timeout=60):
+    """Blocking-readline with a deadline so a broken --follow hangs the
+    TEST, not the whole tier-1 sweep."""
+    import queue as _q
+    import threading
+    q = _q.Queue()
+    threading.Thread(target=lambda: q.put(stream.readline()),
+                     daemon=True).start()
+    try:
+        return q.get(timeout=timeout)
+    except _q.Empty:
+        raise AssertionError("--follow produced no output in time")
+
+
+def test_obs_tail_follow_survives_rotation(tmp_path):
+    """`obs_tail --follow` across the two sink-recycle shapes: a rename
+    rotation (new inode) and a truncate-in-place rewrite (size below
+    the read offset). Both must reopen and keep printing — the old
+    behavior tailed a dead offset forever."""
+    path = tmp_path / "t.jsonl"
+    path.write_text(json.dumps({"event": "a", "ts": 1.0}) + "\n")
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "obs_tail.py")
+    proc = subprocess.Popen(
+        [sys.executable, script, str(path), "--follow"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        assert "event=a" in _readline_or_die(proc.stdout)
+
+        # rotation: a NEW file renamed over the path (fresh inode)
+        side = tmp_path / "t.jsonl.new"
+        side.write_text(json.dumps({"event": "b", "ts": 2.0}) + "\n")
+        os.replace(side, path)
+        assert "event=b" in _readline_or_die(proc.stdout)
+
+        # grow the offset well past the next rewrite's size so the
+        # shrink check (size < offset) is unambiguous
+        with open(path, "a") as fh:
+            for i in range(5):
+                fh.write(json.dumps({"event": "pad", "ts": 3.0 + i,
+                                     "fill": "x" * 64}) + "\n")
+        for _ in range(5):
+            assert "event=pad" in _readline_or_die(proc.stdout)
+
+        # truncate-in-place mid-follow: same inode, shrunk content
+        path.write_text(json.dumps({"event": "c", "ts": 9.0}) + "\n")
+        assert "event=c" in _readline_or_die(proc.stdout)
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)
 
 
 # ------------------------------------------------- two-process cohort

@@ -298,6 +298,63 @@ def test_queue_gauges_published_on_submit_while_worker_stalled():
         tel.close()
 
 
+def test_sustained_imbalance_per_lane_skew_and_spill_sums():
+    """Per-lane queue gauges + spill counters under SUSTAINED imbalance:
+    every worker is wedged inside a gated dispatch (lane 0 plays the
+    slow-faulted lane traffic keeps targeting), the flood pins to lane
+    0 until it fills, and the excess spills toward the colder lanes.
+    The skew must be visible in the per-lane gauges, the spill-to-
+    coldest counters must advance on the receiving lanes only, and the
+    aggregate gauges/counters must equal the per-lane sums exactly (no
+    double or lost accounting)."""
+    tel = Telemetry(enabled=True)
+    gates = {d: threading.Event() for d in range(4)}
+
+    def dispatch(model_id, X, device):
+        gates[device].wait(10.0)
+        return np.zeros((X.shape[0],))
+
+    b = MicroBatcher(dispatch, max_batch_rows=4, max_delay_ms=1.0,
+                     telemetry=tel, max_queue_rows=32, n_lanes=4)
+    try:
+        busy = _wedge_lanes(b, 4, None)
+        # pin routing to the faulted lane: spill mechanics under test
+        b._pick_lane = lambda: b._lanes[0]
+        # lane cap = ceil(32/4) = 8 rows: 4 submits fill lane 0, the
+        # next 6 must spill (12 rows spread over lanes 1-3)
+        futs = [b.submit("m", np.zeros((2, F), np.float32))
+                for _ in range(10)]
+        g = tel.snapshot()["gauges"]
+        assert g["serve.d0.queue_depth"] == 4
+        assert g["serve.d0.queue_rows"] == 8
+        for d in (1, 2, 3):
+            assert g[f"serve.d{d}.queue_rows"] > 0
+            assert g["serve.d0.queue_depth"] > \
+                g[f"serve.d{d}.queue_depth"]
+        assert sum(g[f"serve.d{d}.queue_rows"] for d in (1, 2, 3)) == 12
+        # aggregates are EXACTLY the per-lane sums
+        assert g["serve.queue_depth"] == sum(
+            g[f"serve.d{d}.queue_depth"] for d in range(4))
+        assert g["serve.queue_rows"] == sum(
+            g[f"serve.d{d}.queue_rows"] for d in range(4))
+        c = tel.snapshot()["counters"]
+        assert c.get("serve.spills") == 6
+        assert sum(c.get(f"serve.d{d}.spills", 0)
+                   for d in range(4)) == c["serve.spills"]
+        assert c.get("serve.d0.spills", 0) == 0   # full lane never gains
+        for d in (1, 2, 3):
+            assert c.get(f"serve.d{d}.spills", 0) >= 1
+        for gate in gates.values():
+            gate.set()
+        for f in busy + futs:
+            f.result(timeout=10.0)
+    finally:
+        for gate in gates.values():
+            gate.set()
+        b.close(drain_timeout_s=5.0)
+        tel.close()
+
+
 # ------------------------------------------------------------- rollover
 @fleet
 def test_fleet_rollover_swaps_every_replica_atomically(bst):

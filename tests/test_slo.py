@@ -1,0 +1,451 @@
+"""SLO plane (obs/slo.py + wiring).
+
+Tier-1 coverage of the declarative-objective engine: fire/resolve
+lifecycle with burn-rate + hysteresis semantics, the slow-window burn
+guard (a breach streak alone must not page), config-file overlay over
+the built-in catalog (merge / disable / reject), plane filtering,
+bounded incident capture, the ``GET /alerts`` endpoint, ``/readyz``
+gating behind ``slo_readyz_gating``, the run-report ``alerts`` section
+and its run_diff regression gate, the dispatch-neutral training
+integration, and the obs_tail ``alerts:`` summary line.
+
+Every engine in here runs with ``tick_period_s=0`` (no daemon thread)
+and an injected ``now`` so the burn windows are exact.
+"""
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import MetricsExporter, Telemetry
+from lightgbm_tpu.obs.report import (build_report, compare_reports,
+                                     render_markdown)
+from lightgbm_tpu.obs.slo import (BUILTIN_OBJECTIVES, INCIDENT_SCHEMA,
+                                  SloEngine, SloSpec, load_slo_config)
+from lightgbm_tpu.serve import PredictionService
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lat_spec(**kw):
+    base = dict(id="lat", kind="latency_p99", target=50.0,
+                comparison="above", severity="page", hysteresis=2,
+                resolve_hysteresis=2, plane="serve")
+    base.update(kw)
+    return SloSpec(**base)
+
+
+def _counters(tel):
+    return tel.snapshot().get("counters", {})
+
+
+def _events(tel, name):
+    return [e for e in tel.snapshot().get("events", [])
+            if e.get("event") == name]
+
+
+# ---------------------------------------------------------------- core
+def test_fire_resolve_lifecycle_and_incident(tmp_path):
+    tel = Telemetry(enabled=True)
+    base = str(tmp_path / "tel.jsonl")
+    eng = SloEngine(tel, source="serve", specs=[_lat_spec()],
+                    tick_period_s=0.0, incident_base=base,
+                    context_fn=lambda: {"who": "test"})
+    try:
+        tel.dist("serve.latency_ms", 400.0)
+        assert eng.step(now=100.0, force=True)
+        assert eng.active_alerts() == []          # hysteresis=2: not yet
+        assert eng.step(now=130.0, force=True)
+
+        active = eng.active_alerts()
+        assert len(active) == 1
+        a = active[0]
+        assert a["objective"] == "lat"
+        assert a["alert_id"] == "lat#1"
+        assert a["severity"] == "page"
+        assert a["burn_fast"] == 1.0 and a["burn_slow"] == 1.0
+        assert eng.gating_reason() == "lat"
+
+        c = _counters(tel)
+        assert c.get("slo.alerts_fired") == 1
+        assert c.get("slo.alerts_page") == 1
+        assert c.get("slo.incidents") == 1
+        assert c.get("slo.ticks") == 2
+
+        # the transition is a finding event: it survives the whole run
+        alerts = [e for e in tel.snapshot().get("findings", [])
+                  if e.get("event") == "alert"]
+        assert [e["state"] for e in alerts] == ["firing"]
+        assert alerts[0]["measured"] == 400.0
+        assert alerts[0]["target"] == 50.0
+
+        # incident artifact: bounded, schema-versioned, context attached
+        inc_path = base + ".incident.lat-1.json"
+        assert os.path.exists(inc_path)
+        with open(inc_path) as fh:
+            inc = json.load(fh)
+        assert inc["schema"] == INCIDENT_SCHEMA
+        assert inc["source"] == "serve"
+        assert inc["alert"]["objective"] == "lat"
+        assert inc["context"] == {"who": "test"}
+        assert "lat#1" in inc["active_alerts"]
+        assert isinstance(inc["telemetry"], dict)
+        assert len(_events(tel, "incident_captured")) == 1
+
+        # drown the slow sample: p99 of the ring drops under target
+        for _ in range(300):
+            tel.dist("serve.latency_ms", 1.0)
+        assert eng.step(now=160.0, force=True)
+        assert eng.active_alerts(), "one clean tick must not resolve"
+        assert eng.step(now=190.0, force=True)
+        assert eng.active_alerts() == []
+        assert eng.gating_reason() is None
+
+        c = _counters(tel)
+        assert c.get("slo.alerts_resolved") == 1
+        pay = eng.alerts_payload()
+        assert pay["fired"] == 1 and pay["resolved"] == 1
+        assert pay["source"] == "serve"
+        assert [h["state"] for h in pay["history"]] == ["firing", "resolved"]
+        resolved = pay["history"][-1]
+        assert resolved["alert_id"] == "lat#1"
+        assert resolved["duration_s"] == pytest.approx(60.0, abs=1e-6)
+        assert pay["incidents"] == [inc_path]
+    finally:
+        eng.stop()
+        tel.close()
+
+
+def test_hysteresis_blocks_short_breach():
+    tel = Telemetry(enabled=True)
+    eng = SloEngine(tel, source="serve", specs=[_lat_spec(hysteresis=3)],
+                    tick_period_s=0.0)
+    try:
+        tel.dist("serve.latency_ms", 400.0)
+        eng.step(now=10.0, force=True)
+        eng.step(now=20.0, force=True)
+        assert eng.active_alerts() == []          # 2 of 3 breaches
+        eng.step(now=30.0, force=True)
+        assert len(eng.active_alerts()) == 1      # third fires
+    finally:
+        eng.stop()
+        tel.close()
+
+
+def test_slow_burn_window_blocks_premature_page():
+    """A fresh breach streak satisfies hysteresis and the fast window,
+    but the slow-window burn rate must also cross the threshold: a long
+    clean history keeps the page from firing until the breach has
+    consumed enough of the slow window."""
+    tel = Telemetry(enabled=True)
+    spec = SloSpec(id="div", kind="shadow_divergence", target=0.1,
+                   severity="page", hysteresis=2, fast_window_s=60.0,
+                   slow_window_s=600.0, burn_threshold=0.5, plane="serve")
+    eng = SloEngine(tel, source="serve", specs=[spec], tick_period_s=0.0)
+    try:
+        tel.gauge("serve.shadow_divergence", 0.0)
+        for k in range(20):                       # clean history, 30 s ticks
+            eng.step(now=30.0 * k, force=True)    # t = 0 .. 570
+        tel.gauge("serve.shadow_divergence", 0.9)
+        fired_at = None
+        for k in range(1, 21):                    # breaches at t = 600, 630, ..
+            eng.step(now=570.0 + 30.0 * k, force=True)
+            if eng.active_alerts():
+                fired_at = k
+                break
+        # over-streak and fast burn are satisfied from breach #2 on, but
+        # slow burn is k/21 — it crosses 0.5 only at the 11th breach
+        assert fired_at == 11
+        obj = eng.alerts_payload()["objectives"][0]
+        assert obj["burn_slow"] >= spec.burn_threshold
+        assert _counters(tel).get("slo.alerts_fired") == 1
+    finally:
+        eng.stop()
+        tel.close()
+
+
+# -------------------------------------------------------------- config
+def test_config_overlay_merge_disable_reject(tmp_path):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"objectives": [
+        {"id": "serve.latency_p99", "target": 123.0},
+        {"id": "serve.shed_ratio", "disabled": True},
+        {"id": "custom.div", "kind": "shadow_divergence", "target": 0.9,
+         "severity": "page"},
+        {"id": "bogus.new"},                       # new id without a kind
+        {"id": "bad.kind", "kind": "nope"},        # unknown kind
+    ]}))
+    tel = Telemetry(enabled=True)
+    eng = SloEngine(tel, source="serve", config_path=str(cfg),
+                    tick_period_s=0.0)
+    try:
+        objs = {o["id"]: o for o in eng.alerts_payload()["objectives"]}
+        assert objs["serve.latency_p99"]["target"] == 123.0
+        assert objs["serve.latency_p99"]["severity"] == "page"  # kept
+        assert "serve.shed_ratio" not in objs                   # disabled
+        assert objs["custom.div"]["kind"] == "shadow_divergence"
+        assert "bogus.new" not in objs
+        assert "bad.kind" not in objs
+        errs = _events(tel, "slo_config_error")
+        assert {e.get("objective") for e in errs} == {"bogus.new",
+                                                      "bad.kind"}
+        loaded = _events(tel, "slo_config_loaded")
+        assert len(loaded) == 1 and loaded[0]["path"] == str(cfg)
+        assert tel.snapshot()["gauges"].get("slo.objectives") == float(
+            len(objs))
+    finally:
+        eng.stop()
+        tel.close()
+
+
+def test_malformed_config_falls_back_to_catalog(tmp_path):
+    cfg = tmp_path / "broken.json"
+    cfg.write_text("{not json")
+    with pytest.raises(ValueError):
+        load_slo_config(str(cfg))
+    tel = Telemetry(enabled=True)
+    eng = SloEngine(tel, source="serve", config_path=str(cfg),
+                    tick_period_s=0.0)
+    try:
+        errs = _events(tel, "slo_config_error")
+        assert len(errs) == 1 and errs[0]["path"] == str(cfg)
+        serve_catalog = [s for s in BUILTIN_OBJECTIVES
+                         if s.plane in ("any", "serve")]
+        assert len(eng.alerts_payload()["objectives"]) == len(serve_catalog)
+        assert eng.step(force=True)               # catalog still evaluates
+    finally:
+        eng.stop()
+        tel.close()
+
+
+def test_plane_filter_selects_source_objectives():
+    tel = Telemetry(enabled=True)
+    serve_eng = SloEngine(tel, source="serve", tick_period_s=0.0)
+    train_eng = SloEngine(tel, source="train", tick_period_s=0.0)
+    try:
+        serve_ids = {o["id"] for o in serve_eng.alerts_payload()["objectives"]}
+        train_ids = {o["id"] for o in train_eng.alerts_payload()["objectives"]}
+        assert "serve.latency_p99" in serve_ids
+        assert not any(i.startswith("train.") for i in serve_ids)
+        assert "train.liveness" in train_ids
+        assert "serve.latency_p99" not in train_ids
+        # plane="any" objectives run on both engines (the drift ceiling
+        # watches ingest-side PSI during training and serving alike)
+        assert "obs.scrape_staleness" in serve_ids & train_ids
+        assert "serve.drift_score" in serve_ids & train_ids
+    finally:
+        serve_eng.stop()
+        train_eng.stop()
+        tel.close()
+
+
+def test_incident_capture_is_bounded(tmp_path):
+    from lightgbm_tpu.obs import slo as slo_mod
+    tel = Telemetry(enabled=True)
+    specs = [_lat_spec(id=f"lat{i}", hysteresis=1)
+             for i in range(slo_mod._MAX_INCIDENTS + 3)]
+    eng = SloEngine(tel, source="serve", specs=specs, tick_period_s=0.0,
+                    incident_base=str(tmp_path / "t.jsonl"))
+    try:
+        tel.dist("serve.latency_ms", 400.0)
+        eng.step(now=10.0, force=True)            # every objective fires
+        c = _counters(tel)
+        assert c.get("slo.alerts_fired") == len(specs)
+        assert c.get("slo.incidents") == slo_mod._MAX_INCIDENTS
+        assert c.get("slo.incidents_dropped") == 3
+        assert len(eng.alerts_payload()["incidents"]) == slo_mod._MAX_INCIDENTS
+    finally:
+        eng.stop()
+        tel.close()
+
+
+# ----------------------------------------------------------- endpoints
+def test_alerts_endpoint_serves_payload_and_404s_without_engine():
+    tel = Telemetry(enabled=True)
+    eng = SloEngine(tel, source="serve", specs=[_lat_spec()],
+                    tick_period_s=0.0)
+    exp = MetricsExporter(tel, 0, alerts_fn=eng.alerts_payload)
+    port = exp.start()
+    try:
+        tel.dist("serve.latency_ms", 400.0)
+        eng.step(now=1.0, force=True)
+        eng.step(now=2.0, force=True)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=10) as resp:
+            pay = json.loads(resp.read().decode("utf-8"))
+        assert pay["fired"] == 1
+        assert pay["active"][0]["objective"] == "lat"
+        assert pay["objectives"][0]["firing"] is True
+    finally:
+        exp.stop()
+        eng.stop()
+        tel.close()
+
+    tel2 = Telemetry(enabled=True)
+    exp2 = MetricsExporter(tel2, 0)               # no engine armed
+    port2 = exp2.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/alerts", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        exp2.stop()
+        tel2.close()
+
+
+def _svc_model():
+    rng = np.random.RandomState(0)
+    X = rng.rand(300, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    return lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbose": -1, "min_data_in_leaf": 5},
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+
+
+def test_readyz_gating_on_page_alert(tmp_path):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"objectives": [
+        {"id": "serve.latency_p99", "target": 50.0,
+         "hysteresis": 2, "resolve_hysteresis": 2}]}))
+    bst = _svc_model()
+    svc = PredictionService({"m": bst}, max_batch_rows=64,
+                            batch_events=False, slo_config=str(cfg),
+                            slo_tick_period_s=0.0, slo_readyz_gating=True)
+    try:
+        assert svc.slo is not None
+        svc.warmup(buckets=[64])
+        ok, _reason = svc._readiness()
+        assert ok
+        svc.tel.dist("serve.latency_ms", 400.0)
+        svc.slo.step(now=10.0, force=True)
+        svc.slo.step(now=20.0, force=True)
+        ok, reason = svc._readiness()
+        assert not ok
+        assert reason == "slo_alert:serve.latency_p99"
+    finally:
+        svc.close()
+
+    # gating off (the default): the same firing alert must NOT drop
+    # readiness — alerting observes, gating is an explicit opt-in
+    svc2 = PredictionService({"m": bst}, max_batch_rows=64,
+                             batch_events=False, slo_config=str(cfg),
+                             slo_tick_period_s=0.0)
+    try:
+        svc2.warmup(buckets=[64])
+        svc2.tel.dist("serve.latency_ms", 400.0)
+        svc2.slo.step(now=10.0, force=True)
+        svc2.slo.step(now=20.0, force=True)
+        assert svc2.slo.gating_reason() == "serve.latency_p99"
+        ok, _reason = svc2._readiness()
+        assert ok
+    finally:
+        svc2.close()
+
+
+# ------------------------------------------------------ report / diff
+def _fired_snapshot(tmp_path):
+    tel = Telemetry(enabled=True)
+    eng = SloEngine(tel, source="serve", specs=[_lat_spec()],
+                    tick_period_s=0.0,
+                    incident_base=str(tmp_path / "tel.jsonl"))
+    tel.dist("serve.latency_ms", 400.0)
+    eng.step(now=10.0, force=True)
+    eng.step(now=20.0, force=True)
+    snap = tel.snapshot()
+    eng.stop()
+    tel.close()
+    return snap
+
+
+def test_report_alerts_section_and_markdown(tmp_path):
+    snap = _fired_snapshot(tmp_path)
+    rep = build_report(snap, run_id="r1")
+    al = rep["alerts"]
+    assert al["fired"] == 1 and al["resolved"] == 0
+    assert al["incidents"] == 1
+    assert al["active"] == ["lat"]
+    assert al["transitions"][-1]["state"] == "firing"
+    assert al["transitions"][-1]["objective"] == "lat"
+    md = render_markdown(rep)
+    assert "## SLO alerts" in md
+    assert "lat" in md
+
+
+def test_run_diff_flags_newly_firing_alert(tmp_path):
+    clean_tel = Telemetry(enabled=True)
+    clean = build_report(clean_tel.snapshot(), run_id="base")
+    clean_tel.close()
+    fired = build_report(_fired_snapshot(tmp_path), run_id="cand")
+
+    cmp_rep = compare_reports(clean, fired)
+    names = [r["name"] for r in cmp_rep.get("regressions", [])]
+    assert "slo_alert:lat" in names
+
+    # identical runs compare clean — the alert gate must not misfire
+    cmp_same = compare_reports(fired, fired)
+    assert not any(r["name"].startswith("slo_alert:")
+                   for r in cmp_same.get("regressions", []))
+
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(clean))
+    cand_p.write_text(json.dumps(fired))
+    run_diff = _load_script("run_diff")
+    assert run_diff.main([str(base_p), str(cand_p),
+                          "--fail-on-regress"]) == 1
+    assert run_diff.main([str(cand_p), str(cand_p),
+                          "--fail-on-regress"]) == 0
+
+
+# ------------------------------------------------- training integration
+def test_training_with_slo_enabled_is_clean_and_ticks(tmp_path):
+    rng = np.random.RandomState(7)
+    X = rng.rand(500, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    out = str(tmp_path / "tel.jsonl")
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "telemetry_out": out, "slo_enabled": True},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    snap = bst.telemetry()
+    c = snap.get("counters", {})
+    assert c.get("slo.ticks", 0) >= 1
+    assert c.get("slo.alerts_fired", 0) == 0      # clean run: no alerts
+    assert snap.get("gauges", {}).get("slo.objectives", 0) > 0
+    # the final forced step at finalize lands in the sink too
+    with open(out) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    assert not any(r.get("event") == "alert" for r in recs)
+
+
+# ------------------------------------------------------------ obs_tail
+def test_obs_tail_summary_alerts_line():
+    obs_tail = _load_script("obs_tail")
+    recs = [
+        {"event": "alert", "state": "firing", "objective": "a",
+         "severity": "page", "ts": 1.0},
+        {"event": "alert", "state": "resolved", "objective": "a",
+         "severity": "page", "ts": 2.0},
+        {"event": "alert", "state": "firing", "objective": "b",
+         "severity": "ticket", "ts": 3.0},
+    ]
+    out = obs_tail.summarize(recs)
+    line = next(ln for ln in out.splitlines() if ln.startswith("alerts:"))
+    assert "fired=2" in line
+    assert "resolved=1" in line
+    assert "b" in line and "'a'" not in line      # only b still active
