@@ -545,6 +545,13 @@ def run_micro() -> None:
     ctl_port = _free_port()
     tel_ctl = tel_path + ".ctl"
     ctl_prof_dir = tempfile.mkdtemp(prefix="bench_micro_ctlprof_")
+    # roofline leg rides the control-plane leg: the window close parses
+    # the trace (obs/kernelstats.py) and appends measured samples to
+    # this perf database (obs/perfdb.py); a second profiled run below
+    # appends to the SAME file to prove cross-run accumulation
+    ctl_perfdb = tel_path + ".perfdb"
+    if os.path.exists(ctl_perfdb):
+        os.unlink(ctl_perfdb)
     n_ctl_iters = 2 * n_iters
     ctl_stop = _threading.Event()
     ctl_armed = {}
@@ -588,7 +595,8 @@ def run_micro() -> None:
     t0 = time.perf_counter()
     bst6 = lgb.train(dict(params, telemetry_out=tel_ctl,
                           metrics_port=ctl_port,
-                          tpu_megastep_iters=n_iters),
+                          tpu_megastep_iters=n_iters,
+                          perf_db=ctl_perfdb),
                      ds6, num_boost_round=n_ctl_iters)
     ctl_wall = time.perf_counter() - t0
     ctl_stop.set()
@@ -608,11 +616,54 @@ def run_micro() -> None:
     ctl_files = [os.path.join(r, f)
                  for r, _, fs in os.walk(ctl_prof_dir) for f in fs]
     _RESULT["ctl_profile_trace_ok"] = bool(ctl_files)
+    # ---- roofline leg (rides the control-plane leg): the window close
+    # above already parsed the trace via obs/kernelstats.py and joined
+    # it to the cost ledger. Deterministic gates: join coverage must be
+    # EXACTLY 1.0 (every measured megastep dispatch joined its analytic
+    # cost signature) and the dispatch counter measured WITH the parse
+    # active must equal the base leg's (the parser is host-side work at
+    # a window close the driver already owns — dispatch-neutral).
+    g6 = snap6.get("gauges", {})
+    _RESULT["roofline_join_coverage"] = float(
+        g6.get("roofline.join_coverage", -1.0))
+    _RESULT["roofline_joined_executables"] = int(
+        g6.get("roofline.joined_executables", 0))
+    _RESULT["roofline_dispatches_per_iter"] = round(
+        float(c6.get("train.dispatches", 0)) / ctl_iters, 4)
+    _RESULT["roofline_trace_bytes_ok"] = bool(
+        g6.get("profile.trace_bytes", 0) > 0
+        and g6.get("profile.trace_files", 0) > 0)
     mx6 = getattr(bst6._gbdt, "_metrics", None)
     if mx6 is not None:
         mx6.stop()
     shutil.rmtree(ctl_prof_dir, ignore_errors=True)
-    _emit()   # the control-plane counters are on stdout now
+    # second profiled run, same shape, appending to the SAME perf
+    # database — this one through the profile_dir config window (the
+    # other capture flavor; it closes at finalize) — then assert the
+    # shape key accumulated one sample per run. perfdb_samples == 2 is
+    # the deterministic cross-run-accumulation gate.
+    ctl2_prof_dir = tempfile.mkdtemp(prefix="bench_micro_ctlprof2_")
+    ds7 = lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+    bst7 = lgb.train(dict(params, telemetry_out=tel_path + ".ctl2",
+                          tpu_megastep_iters=n_iters,
+                          profile_dir=ctl2_prof_dir,
+                          perf_db=ctl_perfdb),
+                     ds7, num_boost_round=n_ctl_iters)
+    _phase("micro_ctl2_train_ok")
+    from lightgbm_tpu.obs import perfdb as _perfdb
+    _db = _perfdb.PerfDB(ctl_perfdb).load()
+    _summ = _perfdb.summarize(_db["rows"])
+    _RESULT["perfdb_rows"] = len(_db["rows"])
+    _RESULT["perfdb_keys"] = len(_summ)
+    # samples accumulated for the most-sampled shape key (the megastep
+    # executable both runs measured): exactly one per profiled run
+    _RESULT["perfdb_samples"] = max(
+        (e["samples"] for e in _summ), default=0)
+    mx7 = getattr(bst7._gbdt, "_metrics", None)
+    if mx7 is not None:
+        mx7.stop()
+    shutil.rmtree(ctl2_prof_dir, ignore_errors=True)
+    _emit()   # the control-plane + roofline counters are on stdout now
 
     # ---- histogram-plane leg: quantized gradients + gain screening +
     # adaptive per-feature bins (ROADMAP item 4). Two trainings on a

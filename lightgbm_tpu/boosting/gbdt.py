@@ -508,7 +508,9 @@ class GBDT:
                     else None)
             self._metrics = MetricsExporter(
                 tel, want_port, profile_control=self._profile_ctl,
-                report_fn=self.build_run_report)
+                report_fn=self.build_run_report,
+                roofline_fn=lambda: getattr(self, "_roofline_last",
+                                            None))
             if self._metrics.start() < 0:
                 # total bind failure (not the in-use fallback): drop
                 # the dead exporter so a later reset_parameter round
@@ -547,6 +549,10 @@ class GBDT:
         elif self._cost is None or self._cost.mode != cost_mode:
             from ..obs.cost import CostLedger
             self._cost = CostLedger(tel, cost_mode)
+        # roofline plane (obs/kernelstats.py): measured samples from
+        # every closed profile window accumulate in the shape-keyed
+        # perf database when perf_db is set (obs/perfdb.py)
+        self._perf_db_path = str(getattr(config, "perf_db", "") or "")
         # SLO plane (obs/slo.py): one engine per registry lifetime,
         # rebuilt when a reset_config changes the arming keys.  The
         # engine only reads host-side snapshots — arming it is
@@ -692,6 +698,7 @@ class GBDT:
         self._prof_done = True
         self.telemetry.event("profiler_trace_stop", iteration=self.iter,
                              log_dir=self._prof_dir)
+        self._roofline_capture(self._prof_dir)
 
     # ------------------------------------------- on-demand profile windows
     def _profile_ctl_step(self) -> None:
@@ -757,8 +764,108 @@ class GBDT:
                              iteration=self.iter, dir=win["dir"],
                              iters=win["iters"],
                              covered=self.iter - win["it0"])
+        self._roofline_capture(win["dir"])
         if self._profile_ctl is not None:
             self._profile_ctl.done()
+
+    # --------------------------------------------------- roofline plane
+    def _shape_class(self) -> str:
+        """Perfdb shape key: rows bucketed to the next power of two
+        (padding-invariant across minor row-count jitter), feature
+        count and bin budget — what determines which measured samples
+        are comparable (obs/perfdb.py)."""
+        rows = max(1, int(getattr(self, "num_data", 0) or 1))
+        rows_p2 = 1 << (rows - 1).bit_length()
+        feats = int(getattr(getattr(self, "train_data", None),
+                            "num_features", 0) or 0)
+        max_bin = int(getattr(self.config, "max_bin", 0) or 0)
+        return f"r{rows_p2}.f{feats}.b{max_bin}"
+
+    def _roofline_capture(self, trace_dir: str) -> None:
+        """Post-window measurement hook, both window flavors
+        (profile_dir config window and POST /profile): record the trace
+        dir size/count gauges (an empty or truncated capture must be
+        observable, not silently parsed to zero kernels), parse the
+        Chrome trace via obs/kernelstats.py, join it to the cost
+        ledger's analytic entries, publish the roofline gauges + one
+        ``roofline`` event, and append measured samples to the perf
+        database when ``perf_db`` is set.  Pure host work at a point
+        the profiler already synced — zero device dispatches — and
+        exception-proof: measurement must never kill training."""
+        tel = self.telemetry
+        if not trace_dir or not tel.enabled:
+            return
+        try:
+            from ..obs import kernelstats
+            n_files, n_bytes = kernelstats.dir_stats(trace_dir)
+            tel.gauge("profile.trace_files", float(n_files))
+            tel.gauge("profile.trace_bytes", float(n_bytes))
+            if self._cost is not None:
+                self._cost.flush()   # analyses queued since last drain
+            compile_evs = [e for e in tel.snapshot().get("events", [])
+                           if e.get("event") == "compile_executable"]
+            roof = kernelstats.roofline_from_dir(
+                trace_dir,
+                cost_entries=(self._cost.entries()
+                              if self._cost is not None else None),
+                compile_entries=compile_evs)
+            tel.gauge("roofline.join_coverage",
+                      float(roof["join_coverage"]))
+            tel.gauge("roofline.joined_executables",
+                      float(roof["joined_executables"]))
+            tel.gauge("roofline.anchor_dispatches",
+                      float(roof["anchor_dispatches"]))
+            # measured occupancy of the training executable's host
+            # span — the measured complement to the analytic
+            # cost.achieved_fraction gauge
+            fracs = [r["measured_fraction"]
+                     for r in roof["executables"]
+                     if r["kind"] in ("megastep", "fast_step")
+                     and isinstance(r.get("measured_fraction"),
+                                    (int, float))]
+            if fracs:
+                tel.gauge("cost.measured_fraction", max(fracs))
+            top = roof["kernels"][0] if roof["kernels"] else None
+            tel.event(
+                "roofline", iteration=self.iter, dir=trace_dir,
+                join_coverage=roof["join_coverage"],
+                joined_executables=roof["joined_executables"],
+                anchor_dispatches=roof["anchor_dispatches"],
+                total_device_time_us=roof["total_device_time_us"],
+                measured_fraction=(max(fracs) if fracs else None),
+                top_kernel=(top["name"] if top else None),
+                top_kernel_us=(top["time_us"] if top else None),
+                trace_files=roof["trace_files"],
+                trace_bytes=roof["trace_bytes"],
+                parse_errors=roof["parse_errors"])
+            self._roofline_last = roof
+            if self._perf_db_path:
+                from ..obs import perfdb
+                try:
+                    import jax as _jax
+                    backend = _jax.default_backend()
+                    world = int(_jax.process_count())
+                except Exception:
+                    backend, world = "unknown", 1
+                # packed hist layout = the feature-bin axis was padded
+                # to a lane multiple (hist.fb_padded gauge > hist.fb)
+                hs = getattr(self, "_hist_stats", None) or {}
+                packed = bool(hs.get("fb_padded", 0) > hs.get("fb", 0))
+                rows = perfdb.samples_from_roofline(
+                    roof, shape_class=self._shape_class(),
+                    backend=backend,
+                    quant_bits=int(getattr(self, "quant_bits", 0) or 0),
+                    packed_layout=packed,
+                    world_size=world, source="profile_window",
+                    run_id=tel.run_id)
+                n = perfdb.PerfDB(self._perf_db_path).append(rows)
+                tel.inc("perfdb.samples_written", n)
+                tel.event("perfdb_append", path=self._perf_db_path,
+                          samples=n)
+        except Exception as e:   # measurement must never kill training
+            log.warning("roofline capture of %s failed: %s",
+                        trace_dir, e)
+            tel.event("roofline", dir=trace_dir, error=str(e)[:200])
 
     def finalize_telemetry(self) -> None:
         """End-of-training hook: stop an open profiler trace, emit the
@@ -874,6 +981,7 @@ class GBDT:
             run_id=tel.run_id, rank=tel.rank, world_size=world,
             evicted=self._evicted_snapshot(),
             cost_entries=self._cost.entries() if self._cost else None,
+            roofline=getattr(self, "_roofline_last", None),
             extra=extra, ranks=rank_sections)
 
     def _write_run_report(self, snap, rank_sections) -> None:
@@ -3573,7 +3681,11 @@ class GBDT:
         self.telemetry.inc("train.dispatches")
         ext = bool(self.use_screening or self.quant_bits)
         t_call0 = time.perf_counter() if fresh_step else 0.0
-        with self._maybe_record_collectives(fresh_step) as rec:
+        with self._maybe_record_collectives(fresh_step) as rec, \
+                jax.profiler.StepTraceAnnotation("fast_step",
+                                                 step_num=self.iter):
+            # the kind-named anchor span the roofline plane
+            # (obs/kernelstats.py) attributes fast-step kernels to
             if ext:
                 ema = (self._ensure_gain_ema() if self.use_screening
                        else None)

@@ -483,6 +483,20 @@ _PARAMS: List[_Param] = [
             "telemetry is enabled; feeds cost.flops_per_iter / "
             "cost.hlo_bytes_per_iter / cost.achieved_fraction gauges "
             "and one cost_ledger record per drained batch"),
+    _p("perf_db", str, "", ("perf_database", "perfdb"),
+       desc="path to the append-only, shape-keyed performance database "
+            "(obs/perfdb.py, JSONL). Every profile window that closes "
+            "(profile_dir config window or POST /profile) is parsed by "
+            "the roofline plane (obs/kernelstats.py) and its joined "
+            "executables append one measured sample each — keyed by "
+            "(signature, kind, shape class, backend, quant bits, "
+            "packed layout, world size) — so measured device times "
+            "accumulate across runs into the tuning cache "
+            "scripts/perfdb_query.py and run_diff --perf-db read. "
+            "Appends are atomic (single O_APPEND write); concurrent "
+            "runs may share one file. Empty (default) disables the "
+            "perfdb write; the roofline record and gauges are emitted "
+            "either way whenever a window closes under telemetry"),
     _p("drift_profile", bool, True, ("data_profile", "drift_monitor"),
        desc="capture a compact DataProfile of the training distribution "
             "at dataset finalize (per-feature bin-occupancy histograms "

@@ -54,7 +54,12 @@ exporter is the RUNNING job's control surface —
 - ``GET /alerts`` — the SLO plane's alert view (obs/slo.py): active
   alerts, per-objective status, burn rates and the recent transition
   history; 404 until an SloEngine is armed (``slo_enabled`` /
-  ``slo_config``).
+  ``slo_config``);
+- ``GET /roofline`` — the roofline plane's latest measured view
+  (obs/kernelstats.py): per-executable device times joined to their
+  analytic cost entries, join coverage, top kernels.  404 until a
+  profile window has closed and parsed (arm one with
+  ``POST /profile``).
 
 ``/metrics`` bodies are cached for ``cache_ttl`` (~1 s): a tight
 external scrape loop re-reads the cached rendering instead of
@@ -338,6 +343,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500, str(e)[:200])
                 return
             self._send_json(200, payload)
+        elif path == "/roofline":
+            fn = self.exporter.roofline_fn
+            if fn is None:
+                self._send_json(404, {"error": "no roofline source "
+                                               "attached"})
+                return
+            try:
+                payload = fn()
+            except Exception as e:
+                self.send_error(500, str(e)[:200])
+                return
+            if payload is None:
+                self._send_json(404, {"error": "no profile window "
+                                               "parsed yet (arm one "
+                                               "with POST /profile)"})
+                return
+            self._send_json(200, payload)
         elif path == "/healthz":
             body = b"ok\n"
             self.send_response(200)
@@ -408,7 +430,8 @@ class MetricsExporter:
     def __init__(self, telemetry, port: int, host: str = "127.0.0.1",
                  extra_labels: Optional[Dict[str, Any]] = None,
                  ready_check=None, profile_control=None, report_fn=None,
-                 alerts_fn=None, cache_ttl: float = 1.0):
+                 alerts_fn=None, roofline_fn=None,
+                 cache_ttl: float = 1.0):
         self.telemetry = telemetry
         self.requested_port = int(port)
         self.host = host
@@ -424,6 +447,10 @@ class MetricsExporter:
         # the SLO plane's alert view (GET /alerts) — an SloEngine's
         # alerts_payload when one is armed, else 404
         self.alerts_fn = alerts_fn
+        # the roofline plane's latest parsed window (GET /roofline) —
+        # None until a profile window closes, then the kernelstats
+        # join_cost record of the most recent one
+        self.roofline_fn = roofline_fn
         self.build_info = build_info_labels()
         # /metrics body cache: a tight external scrape loop re-reads
         # the cached rendering for cache_ttl seconds instead of

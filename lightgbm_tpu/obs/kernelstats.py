@@ -1,0 +1,387 @@
+"""Measured kernel-time attribution from ``jax.profiler`` traces.
+
+The cost ledger (obs/cost.py) prices every executable with *analytic*
+HLO flops/bytes; nothing in the system was a measured per-kernel device
+time — the number ROADMAP item 5's measured-cost autotuner actually
+needs.  This module closes that gap without any new dependency: the
+profile windows the drivers already own (the ``profile_dir`` config
+window and ``POST /profile`` on the exporter) write Chrome-trace
+``*.trace.json.gz`` artifacts under
+``<dir>/plugins/profile/<ts>/<host>.trace.json.gz``, and everything in
+a Chrome trace is plain gzip + JSON — stdlib territory.
+
+Three layers:
+
+- ``parse_profile_dir(dir)`` — find and parse every trace file under a
+  profile directory into one stats dict: anchor spans (the
+  ``megastep`` / ``fast_step`` step annotations and the serving
+  ``serve_bucket`` annotation the drivers emit), per-kernel device
+  durations off the runtime threads/device lanes, and per-anchor
+  attribution by time-interval containment (busy time is the interval
+  UNION, so overlapping kernels are not double-counted; the raw sum
+  minus the union is reported as ``overlap_us``).  Malformed input —
+  truncated gzip, empty file, JSON without ``traceEvents`` — lands in
+  the ``errors`` list, NEVER an exception: the parser runs inside the
+  training driver's window-close hook.
+- ``join_cost(stats, cost_entries, compile_entries)`` — JOIN the
+  measured anchors to the analytic ``cost_executable`` records by
+  executable kind (the anchor names ARE the ledger kinds, which is the
+  whole reason the annotations exist: the jit function names
+  ``step``/``step_ext`` are ambiguous between megastep and fast-step)
+  and to ``compile_executable`` records by signature.  Every joined
+  executable gets a measured ``device_time_us_per_dispatch`` (from
+  per-kernel device events when the backend emits them, else the
+  anchor's host span — labeled by ``timing_source``),
+  ``achieved_flops_per_s`` / ``achieved_bytes_per_s`` (analytic work
+  over measured device time) and a measured ``measured_fraction``
+  (device-busy occupancy of the anchor's host span — the measured
+  complement to the ledger's analytic ``cost.achieved_fraction``).
+  ``join_coverage`` is the dispatch-weighted fraction of anchors that
+  joined: unjoinable signatures report coverage < 1.0, never raise.
+- ``roofline_from_dir(...)`` — the one-call convenience the drivers,
+  ``scripts/profile.py summarize`` and the tests use.
+
+docs/Observability.md §15 documents the join semantics and the
+``roofline`` record this feeds.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA = "lightgbm_tpu.kernelstats/1"
+
+#: anchor span names the drivers annotate — one per executable kind the
+#: cost ledger knows (boosting/gbdt.py megastep + fast-step dispatch,
+#: serve/engine.py bucket dispatch)
+ANCHOR_KINDS = ("megastep", "fast_step", "serve_bucket")
+
+#: runtime/bookkeeping event-name prefixes that are NOT device kernels:
+#: executor scaffolding, host<->device transfers, python-side frames.
+#: Everything else on a non-python thread (or a "/device:" lane in a
+#: TPU trace) counts as measured kernel time.
+_RUNTIME_PREFIXES = (
+    "TfrtCpu", "Thunk", "ThreadpoolListener", "ParseArguments",
+    "ExecuteHelper", "PjitFunction", "$", "XlaModule", "XlaComputation",
+    "BufferFromHost", "CopyToHost", "CopyFromHost", "TransferFrom",
+    "TransferTo", "Memcpy", "infeed", "outfeed", "Stream #",
+    "program_interpreter", "RunAsync", "EnqueueWork", "H2D", "D2H",
+    "TaskDispatcher",   # llvm-codegen work dispatch (compile, not run)
+)
+
+_TRACE_SUFFIXES = (".trace.json.gz", ".trace.json")
+
+
+def _base(name: str) -> str:
+    return name.split("[", 1)[0].split("#", 1)[0].strip()
+
+
+def trace_files(root: str) -> List[str]:
+    """All Chrome-trace artifacts under a profile dir (sorted for
+    deterministic multi-file merges)."""
+    out: List[str] = []
+    for r, _, fs in os.walk(root):
+        for f in fs:
+            if f.endswith(_TRACE_SUFFIXES):
+                out.append(os.path.join(r, f))
+    return sorted(out)
+
+
+def dir_stats(root: str) -> Tuple[int, int]:
+    """(file count, total bytes) under a profile dir — the
+    ``profile.trace_files`` / ``profile.trace_bytes`` gauges, so an
+    empty or truncated capture is observable instead of silently
+    parsing to zero kernels."""
+    files = bytes_ = 0
+    try:
+        for r, _, fs in os.walk(root):
+            for f in fs:
+                files += 1
+                try:
+                    bytes_ += os.path.getsize(os.path.join(r, f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return files, bytes_
+
+
+def parse_trace_file(path: str) -> Dict[str, Any]:
+    """One trace file -> ``{"events": [...], "error": None|str}``.
+    Never raises: a truncated gzip or non-JSON body is an ``error``
+    string, an empty event list parses clean."""
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rb") as fh:
+                raw = fh.read()
+        else:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+    except (OSError, EOFError, gzip.BadGzipFile) as e:
+        return {"events": [], "error": f"{os.path.basename(path)}: "
+                                       f"{type(e).__name__}: {e}"}
+    if not raw.strip():
+        return {"events": [], "error": f"{os.path.basename(path)}: "
+                                       "empty trace"}
+    try:
+        doc = json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        return {"events": [], "error": f"{os.path.basename(path)}: "
+                                       f"not JSON: {e}"}
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        return {"events": [], "error": f"{os.path.basename(path)}: "
+                                       "no traceEvents"}
+    return {"events": doc["traceEvents"], "error": None}
+
+
+def _merged_union_us(spans: List[Tuple[float, float]]) -> float:
+    """Total covered time of possibly-overlapping (start, end) spans."""
+    total = 0.0
+    end = None
+    for s, e in sorted(spans):
+        if e <= s:
+            continue
+        if end is None or s >= end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def parse_profile_dir(root: str) -> Dict[str, Any]:
+    """Parse every trace artifact under ``root`` into one measured-time
+    stats dict (schema above).  Timestamps/durations are Chrome-trace
+    microseconds.  Never raises."""
+    files = trace_files(root)
+    n_files, n_bytes = dir_stats(root)
+    stats: Dict[str, Any] = {
+        "schema": SCHEMA, "dir": str(root),
+        "trace_files": len(files), "dir_files": n_files,
+        "trace_bytes": n_bytes, "parsed_files": 0, "errors": [],
+        "events": 0,
+        "anchors": {}, "kernels": {}, "by_kind": {},
+        "unattributed_time_us": 0.0,
+    }
+    anchor_spans: Dict[str, List[Tuple[float, float]]] = {}
+    kind_kernel_spans: Dict[str, List[Tuple[float, float]]] = {}
+    for path in files:
+        parsed = parse_trace_file(path)
+        if parsed["error"]:
+            stats["errors"].append(parsed["error"])
+            continue
+        stats["parsed_files"] += 1
+        events = parsed["events"]
+        # first pass: pid/tid naming metadata (kernel classification
+        # needs to know which threads are python and which pids are
+        # device lanes) AND the anchor spans — traceEvents carry no
+        # ordering guarantee, so kernels emitted before their anchor in
+        # the stream must still attribute
+        proc_names: Dict[Any, str] = {}
+        thread_names: Dict[Tuple[Any, Any], str] = {}
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M":
+                args = ev.get("args") or {}
+                if ev.get("name") == "process_name":
+                    proc_names[ev.get("pid")] = str(args.get("name", ""))
+                elif ev.get("name") == "thread_name":
+                    thread_names[(ev.get("pid"), ev.get("tid"))] = \
+                        str(args.get("name", ""))
+                continue
+            if ev.get("ph") != "X":
+                continue
+            base = _base(str(ev.get("name", "")))
+            if base not in ANCHOR_KINDS:
+                continue
+            try:
+                ts = float(ev.get("ts", 0.0))
+                dur = float(ev.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            a = stats["anchors"].setdefault(
+                base, {"dispatches": 0, "host_time_us": 0.0})
+            a["dispatches"] += 1
+            a["host_time_us"] += dur
+            anchor_spans.setdefault(base, []).append((ts, ts + dur))
+        # second pass: kernel events, attributed to the collected spans
+        for ev in events:
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            name = str(ev.get("name", ""))
+            try:
+                ts = float(ev.get("ts", 0.0))
+                dur = float(ev.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            stats["events"] += 1
+            if _base(name) in ANCHOR_KINDS:
+                continue          # counted in the first pass
+            if dur <= 0:
+                continue
+            tname = thread_names.get((ev.get("pid"), ev.get("tid")), "")
+            pname = proc_names.get(ev.get("pid"), "")
+            device_lane = "/device:" in pname
+            if not device_lane and tname.lower().startswith("python"):
+                continue          # host frames, not device work
+            if any(name.startswith(p) for p in _RUNTIME_PREFIXES):
+                continue          # runtime scaffolding / transfers
+            k = stats["kernels"].setdefault(
+                name, {"count": 0, "time_us": 0.0})
+            k["count"] += 1
+            k["time_us"] += dur
+            mid = ts + dur / 2.0
+            owner = None
+            for kind, spans in anchor_spans.items():
+                if any(s <= mid < e for s, e in spans):
+                    owner = kind
+                    break
+            if owner is None:
+                stats["unattributed_time_us"] += dur
+                continue
+            bk = stats["by_kind"].setdefault(
+                owner, {"device_time_us": 0.0, "kernel_time_us": 0.0,
+                        "overlap_us": 0.0, "kernels": {}})
+            bk["kernel_time_us"] += dur
+            kk = bk["kernels"].setdefault(
+                name, {"count": 0, "time_us": 0.0})
+            kk["count"] += 1
+            kk["time_us"] += dur
+            kind_kernel_spans.setdefault(owner, []).append(
+                (ts, ts + dur))
+    for kind, spans in kind_kernel_spans.items():
+        bk = stats["by_kind"][kind]
+        bk["device_time_us"] = _merged_union_us(spans)
+        bk["overlap_us"] = max(
+            0.0, bk["kernel_time_us"] - bk["device_time_us"])
+    return stats
+
+
+def _top_kernels(kernels: Dict[str, Dict[str, Any]], top: int
+                 ) -> List[Dict[str, Any]]:
+    rows = [{"name": n, "count": int(k["count"]),
+             "time_us": round(float(k["time_us"]), 3)}
+            for n, k in kernels.items()]
+    rows.sort(key=lambda r: (-r["time_us"], r["name"]))
+    return rows[:top]
+
+
+def join_cost(stats: Dict[str, Any],
+              cost_entries: Optional[List[Dict[str, Any]]] = None,
+              compile_entries: Optional[List[Dict[str, Any]]] = None,
+              top: int = 8) -> Dict[str, Any]:
+    """Measured stats x analytic ledger -> the roofline record.
+
+    Anchors join ``cost_executable`` entries by executable kind (newest
+    entry per kind wins, matching CostLedger's active-schedule rule)
+    and ``compile_executable`` records by the joined signature.  An
+    anchor with no matching cost entry stays in the table unjoined and
+    drags ``join_coverage`` below 1.0 — reported, never raised."""
+    by_kind: Dict[str, Dict[str, Any]] = {}
+    for ent in cost_entries or []:
+        if isinstance(ent, dict) and ent.get("kind"):
+            by_kind[str(ent["kind"])] = ent
+    compile_by_sig: Dict[str, Dict[str, Any]] = {}
+    for ent in compile_entries or []:
+        if isinstance(ent, dict) and ent.get("signature"):
+            compile_by_sig[str(ent["signature"])] = ent
+    executables: List[Dict[str, Any]] = []
+    total_disp = joined_disp = 0
+    total_device_us = 0.0
+    for kind in sorted(stats.get("anchors", {})):
+        a = stats["anchors"][kind]
+        bk = stats.get("by_kind", {}).get(kind, {})
+        disp = int(a.get("dispatches", 0))
+        total_disp += disp
+        device_us = float(bk.get("device_time_us", 0.0))
+        host_us = float(a.get("host_time_us", 0.0))
+        total_device_us += device_us
+        ent = by_kind.get(kind)
+        # timing source: per-kernel device events when the backend
+        # emits them (TPU lanes), else the anchor's host span — the CPU
+        # runtime executes a jitted executable without per-op trace
+        # events, and a labeled host-span measurement beats a zero
+        timed_us = device_us if device_us > 0 else host_us
+        row: Dict[str, Any] = {
+            "kind": kind,
+            "signature": str(ent["signature"]) if ent else None,
+            "joined": ent is not None,
+            "dispatches": disp,
+            "device_time_us": round(device_us, 3),
+            "host_time_us": round(host_us, 3),
+            "kernel_time_us": round(
+                float(bk.get("kernel_time_us", 0.0)), 3),
+            "overlap_us": round(float(bk.get("overlap_us", 0.0)), 3),
+            "timing_source": ("kernels" if device_us > 0
+                              else "host_span"),
+            "device_time_us_per_dispatch": round(
+                timed_us / disp, 3) if disp and timed_us > 0 else None,
+            "measured_fraction": round(
+                device_us / host_us, 6) if host_us > 0 else None,
+            "top_kernels": _top_kernels(bk.get("kernels", {}), top),
+        }
+        if ent is not None:
+            joined_disp += disp
+            row["scale"] = int(ent.get("scale", 1))
+            row["flops"] = float(ent.get("flops", 0.0))
+            row["hlo_bytes"] = float(ent.get("hlo_bytes", 0.0))
+            if timed_us > 0 and disp > 0:
+                per_disp_s = timed_us / disp * 1e-6
+                row["achieved_flops_per_s"] = row["flops"] / per_disp_s
+                row["achieved_bytes_per_s"] = \
+                    row["hlo_bytes"] / per_disp_s
+            comp = compile_by_sig.get(row["signature"])
+            if comp is not None:
+                row["compile_ms"] = comp.get("compile_ms")
+                row["operand_bytes"] = comp.get("operand_bytes")
+        executables.append(row)
+    executables.sort(key=lambda r: -r["device_time_us"])
+    return {
+        "schema": SCHEMA,
+        "dir": stats.get("dir"),
+        "join_coverage": round(
+            joined_disp / total_disp, 6) if total_disp else 0.0,
+        "anchor_dispatches": total_disp,
+        "joined_executables": sum(1 for r in executables
+                                  if r["joined"]),
+        "executables": executables,
+        "kernels": _top_kernels(stats.get("kernels", {}), top),
+        "total_device_time_us": round(total_device_us, 3),
+        "unattributed_time_us": round(
+            float(stats.get("unattributed_time_us", 0.0)), 3),
+        "trace_files": int(stats.get("trace_files", 0)),
+        "trace_bytes": int(stats.get("trace_bytes", 0)),
+        "parsed_files": int(stats.get("parsed_files", 0)),
+        "parse_errors": len(stats.get("errors", [])),
+        "errors": list(stats.get("errors", []))[:8],
+    }
+
+
+def roofline_from_dir(root: str,
+                      cost_entries: Optional[List[Dict[str, Any]]] = None,
+                      compile_entries: Optional[
+                          List[Dict[str, Any]]] = None,
+                      top: int = 8) -> Dict[str, Any]:
+    """Parse + join in one call — the window-close hook, the ``profile.py
+    summarize`` subcommand and the e2e tests all go through here."""
+    return join_cost(parse_profile_dir(root), cost_entries,
+                     compile_entries, top=top)
+
+
+def cost_entries_from_events(events: List[Dict[str, Any]]
+                             ) -> Tuple[List[Dict[str, Any]],
+                                        List[Dict[str, Any]]]:
+    """Split a JSONL/event-ring record stream into the
+    (cost_executable, compile_executable) entry lists ``join_cost``
+    consumes — for joining a trace against a ``telemetry_out`` sink
+    after the fact (``profile.py summarize --telemetry``)."""
+    cost = [e for e in events if isinstance(e, dict)
+            and e.get("event") == "cost_executable"]
+    compiles = [e for e in events if isinstance(e, dict)
+                and e.get("event") == "compile_executable"]
+    return cost, compiles

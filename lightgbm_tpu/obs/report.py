@@ -38,7 +38,16 @@ DETERMINISTIC_KEYS = (
     "cost.achieved_fraction",
     "hist.bytes_per_iter",
     "counters.iterations",
+    # roofline plane (obs/kernelstats.py): the fraction of measured
+    # anchor dispatches that joined an analytic cost signature.  A
+    # DROP means a signature stopped joining (renamed, lost its cost
+    # entry) — flagged decrease-only below; rising coverage is fine.
+    "roofline.join_coverage",
 )
+
+#: deterministic keys where only a DECREASE regresses (more is better,
+#: and a baseline below 1.0 must not flag the fix that raised it)
+_DECREASE_ONLY = ("roofline.join_coverage",)
 
 
 def _g(d: Dict[str, Any], dotted: str) -> Any:
@@ -54,6 +63,7 @@ def build_report(snapshot: Dict[str, Any], *,
                  run_id: str = "", rank: int = 0, world_size: int = 1,
                  evicted: Optional[List[str]] = None,
                  cost_entries: Optional[List[Dict[str, Any]]] = None,
+                 roofline: Optional[Dict[str, Any]] = None,
                  extra: Optional[Dict[str, Any]] = None,
                  ranks: Optional[List[Dict[str, Any]]] = None
                  ) -> Dict[str, Any]:
@@ -187,6 +197,23 @@ def build_report(snapshot: Dict[str, Any], *,
         "profile_windows": profile_windows[-32:],
         "events": {"by_name": by_name},
     }
+    if roofline:
+        # roofline plane (obs/kernelstats.py): the last parsed profile
+        # window's measured view, bounded to the top executables and
+        # kernels — run_diff diffs per-executable measured device time
+        # from here the way it diffs deterministic counters
+        report["roofline"] = {
+            "join_coverage": roofline.get("join_coverage"),
+            "joined_executables": roofline.get("joined_executables"),
+            "anchor_dispatches": roofline.get("anchor_dispatches"),
+            "total_device_time_us": roofline.get("total_device_time_us"),
+            "unattributed_time_us": roofline.get("unattributed_time_us"),
+            "trace_files": roofline.get("trace_files"),
+            "trace_bytes": roofline.get("trace_bytes"),
+            "parse_errors": roofline.get("parse_errors"),
+            "executables": list(roofline.get("executables", []))[:16],
+            "kernels": list(roofline.get("kernels", []))[:8],
+        }
     if extra:
         report.update(extra)
     if ranks is not None:
@@ -316,6 +343,28 @@ def render_markdown(report: Dict[str, Any]) -> str:
         for t in al.get("transitions", [])[:8]:
             lines.append("- " + "  ".join(f"{k}={_fmt(v)}"
                                           for k, v in sorted(t.items())))
+    roof = report.get("roofline", {})
+    if roof:
+        lines += ["", "## Roofline (measured)",
+                  f"- join coverage: {_fmt(roof.get('join_coverage'))}   "
+                  f"joined executables: {roof.get('joined_executables')} "
+                  f"  anchor dispatches: {roof.get('anchor_dispatches')}"
+                  f"   device time: "
+                  f"{_fmt(roof.get('total_device_time_us'))} us"]
+        for ex in roof.get("executables", [])[:8]:
+            extra = ""
+            if ex.get("achieved_flops_per_s") is not None:
+                extra = (f", {_fmt(ex['achieved_flops_per_s'])} flop/s"
+                         f", {_fmt(ex.get('achieved_bytes_per_s'))} B/s")
+            lines.append(
+                f"  - `{ex.get('signature') or ex.get('kind')}`: "
+                f"{_fmt(ex.get('device_time_us_per_dispatch'))} us/disp "
+                f"x{ex.get('dispatches')}, measured fraction "
+                f"{_fmt(ex.get('measured_fraction'))}{extra}")
+        for k in roof.get("kernels", [])[:5]:
+            lines.append(f"  - kernel `{k.get('name')}`: "
+                         f"{_fmt(k.get('time_us'))} us "
+                         f"(x{k.get('count')})")
     pw = report.get("profile_windows", [])
     if pw:
         lines += ["", "## Profile windows"]
@@ -408,6 +457,8 @@ def compare_reports(prev: Dict[str, Any], cur: Dict[str, Any],
             if key.endswith("achieved_fraction") \
                     and ratio < 1.0 - det_threshold:
                 ent["regressed"] = True
+            if key in _DECREASE_ONLY:
+                ent["regressed"] = ratio < 1.0 - det_threshold
         rep["deterministic"][key] = ent
         if ent["regressed"]:
             rep["regressions"].append(ent)
@@ -457,6 +508,33 @@ def compare_reports(prev: Dict[str, Any], cur: Dict[str, Any],
                "ratio": None, "regressed": True}
         rep["new_reasons"].append(ent)
         rep["regressions"].append(ent)
+
+    # roofline plane: MEASURED per-executable device time per dispatch,
+    # joined across the two reports by signature.  Diffs under the
+    # loose wall-clock threshold (measured time carries scheduler
+    # noise) but joins the hard regressions list — unlike section
+    # timings, these are per-dispatch device times from the profiler,
+    # the exact quantity the item-5 autotuner optimizes, and a slip
+    # past the loose threshold is the regression this plane exists to
+    # catch.
+    def _roof_execs(r: Dict[str, Any]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for ex in (_g(r, "roofline.executables") or []):
+            sig = ex.get("signature") or ex.get("kind")
+            per = ex.get("device_time_us_per_dispatch")
+            if sig and isinstance(per, (int, float)) and per > 0:
+                out[str(sig)] = float(per)
+        return out
+    pr_ex, cu_ex = _roof_execs(prev), _roof_execs(cur)
+    rep["roofline"] = []
+    for sig in sorted(set(pr_ex) & set(cu_ex)):
+        ratio = cu_ex[sig] / pr_ex[sig]
+        ent = {"name": f"roofline:{sig}", "prev": round(pr_ex[sig], 3),
+               "cur": round(cu_ex[sig], 3), "ratio": round(ratio, 4),
+               "regressed": ratio > 1.0 + threshold}
+        rep["roofline"].append(ent)
+        if ent["regressed"]:
+            rep["regressions"].append(ent)
 
     pt, ct = prev.get("timings", {}) or {}, cur.get("timings", {}) or {}
     # only run-time duration families diff as timings: compile.* is

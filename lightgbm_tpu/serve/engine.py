@@ -335,9 +335,13 @@ class ServingEngine:
         # device, not the process default
         enc_dev = jnp.asarray(enc) if self.device is None \
             else jax.device_put(enc, self.device)
-        out = stacked_run_fn(self.pred.variant)(
-            enc_dev, *self._operands, k=self.k,
-            max_steps=self.pred.max_steps)
+        # kind-named anchor span for the roofline plane
+        # (obs/kernelstats.py): a profile window over serving attributes
+        # predictor kernels to this bucket's dispatch
+        with jax.profiler.TraceAnnotation("serve_bucket"):
+            out = stacked_run_fn(self.pred.variant)(
+                enc_dev, *self._operands, k=self.k,
+                max_steps=self.pred.max_steps)
         # register only AFTER the call returns: a failed first dispatch
         # (transient device error) must not mark the signature compiled,
         # or the successful retry's real compile would count as a cache

@@ -14,6 +14,12 @@ Run (on the chip):   ROWS=10500000 python scripts/ablate_hist.py
 CPU smoke:           ROWS=4096 INTERPRET=1 REPS=1 python scripts/ablate_hist.py
 Knobs: TILES=0,512,1024,2048  BITS=0,16,8  SP=64  MIXED=1 (half the
 features at 8 distinct values — the adaptive-layout shape).
+
+PERF_DB=<path> additionally appends each measured combination to the
+shape-keyed performance database (obs/perfdb.py) — the same store the
+profile-window close hook and ``bench.py`` write, so the ablation grid
+lands in the history ``scripts/perfdb_query.py`` and
+``scripts/run_diff.py --perf-db`` read.
 """
 import json
 import os
@@ -144,6 +150,27 @@ def main():
                         fb, nch, Sp, Rp, min(eff_tile, Rp), bits),
                     "rows_per_s": round(R / sec, 1),
                 })
+                if os.environ.get("PERF_DB"):
+                    # one measured sample per combination in the
+                    # shape-keyed perf database (obs/perfdb.py):
+                    # level_pass timing keyed exactly like the
+                    # training executables, tile width in the
+                    # signature so the grid stays queryable
+                    from lightgbm_tpu.obs import perfdb
+                    key = perfdb.make_key(
+                        f"level_pass[sp={Sp},tile={tile}]",
+                        "hist_level",
+                        f"r{Rp}.f{n_feat}.b{max_bin}",
+                        jax.default_backend(), quant_bits=bits,
+                        packed_layout=packed is not None)
+                    perfdb.PerfDB(os.environ["PERF_DB"]).append([
+                        perfdb.sample(
+                            key, dispatches=reps,
+                            device_time_us_per_dispatch=sec * 1e6,
+                            achieved_bytes_per_s=hist_plane_bytes(
+                                fb, nch, Sp, Rp,
+                                min(eff_tile, Rp), bits) / sec,
+                            source="ablate_hist", run_id=_RUN_ID)])
 
 
 if __name__ == "__main__":

@@ -233,8 +233,21 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
     #   latency objective (no other objective fires), exactly once,
     #   resolve after the ring refills, and leave a schema-valid
     #   incident artifact; each is 0 on a correct run and
-    #   zero-to-nonzero always flags.
+    #   zero-to-nonzero always flags;
+    # - roofline_join_coverage (bench.py --micro control-plane leg):
+    #   dispatch-weighted fraction of measured profile-window anchors
+    #   that joined an analytic cost signature (obs/kernelstats.py) —
+    #   EXACTLY 1.0 on a correct run; a DROP means a signature stopped
+    #   joining, so this one flags decreases (rising coverage is fine);
+    # - roofline_dispatches_per_iter (same leg): the dispatch counter
+    #   measured WITH the trace parse active — must equal
+    #   dispatches_per_iter (the parser is host-side, dispatch-neutral);
+    # - perfdb_samples (same leg): measured samples accumulated for the
+    #   most-sampled shape key across the leg's two profiled runs —
+    #   exactly 2 (one per run); a drop means cross-run accumulation
+    #   in the perf database (obs/perfdb.py) broke, flags decreases.
     report["deterministic"] = {}
+    _decrease_only = ("roofline_join_coverage", "perfdb_samples")
     for name in ("dispatches_per_iter", "eval_dispatches_per_iter",
                  "ckpt_dispatches_per_iter", "obs_dispatches_per_iter",
                  "ingest_dispatches_per_iter", "ingest_chunks",
@@ -258,7 +271,9 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
                  "slo_dispatches_per_iter", "slo_alerts",
                  "slo_dispatches_per_request", "slo_false_positives",
                  "slo_alert_missed", "slo_alert_unresolved",
-                 "slo_incident_invalid"):
+                 "slo_incident_invalid",
+                 "roofline_join_coverage",
+                 "roofline_dispatches_per_iter", "perfdb_samples"):
         p, c = prev.get(name), cur.get(name)
         if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
             continue
@@ -273,6 +288,10 @@ def compare(prev: Dict[str, Any], cur: Dict[str, Any],
         else:
             ent = _ratio_entry(name, float(p), float(c),
                                min(threshold, det_threshold))
+            if name in _decrease_only:
+                # more-is-better counters: only a DROP regresses
+                ent["regressed"] = float(c) < float(p) * (
+                    1.0 - min(threshold, det_threshold))
         report["deterministic"][name] = ent
         if ent["regressed"]:
             report["regressions"].append(ent)

@@ -116,6 +116,7 @@ def summarize(records: List[Dict[str, Any]]) -> str:
     fleet_access: List[Dict[str, Any]] = []
     bulk: List[Dict[str, Any]] = []
     alerts: List[Dict[str, Any]] = []
+    roofs: List[Dict[str, Any]] = []
     for r in records:
         by_event[str(r.get("event", "?"))] = \
             by_event.get(str(r.get("event", "?")), 0) + 1
@@ -139,6 +140,8 @@ def summarize(records: List[Dict[str, Any]]) -> str:
             fleet_access.append(r)
         if r.get("event") == "serve_bulk":
             bulk.append(r)
+        if r.get("event") == "roofline":
+            roofs.append(r)
     lines = [f"records: {len(records)}   ranks: {sorted(ranks)}"]
     if iters:
         lines.append(f"iterations: {min(iters)}..{max(iters)}")
@@ -227,6 +230,34 @@ def summarize(records: List[Dict[str, Any]]) -> str:
         lines.append(
             f"alerts: fired={fired}  resolved={resolved}  "
             f"active={active if active else 'none'}")
+    if roofs:
+        # one line for the roofline plane (obs/kernelstats.py): the
+        # latest parsed profile window's measured view — joined
+        # executables, measured occupancy, the top kernel by device
+        # time — plus the perfdb samples the stream appended
+        last = roofs[-1]
+        parts = [f"roofline: {len(roofs)} window(s)"]
+        if isinstance(last.get("join_coverage"), (int, float)):
+            parts.append(f"join={float(last['join_coverage']):.3f}")
+        if isinstance(last.get("joined_executables"), int):
+            parts.append(f"joined={last['joined_executables']}")
+        if isinstance(last.get("measured_fraction"), (int, float)):
+            parts.append(
+                f"measured_fraction="
+                f"{float(last['measured_fraction']):.4g}")
+        if last.get("top_kernel"):
+            parts.append(
+                f"top_kernel={last['top_kernel']}"
+                + (f"({float(last['top_kernel_us']):.4g}us)"
+                   if isinstance(last.get("top_kernel_us"),
+                                 (int, float)) else ""))
+        if last.get("error"):
+            parts.append(f"error={last['error']}")
+        n_db = sum(int(r.get("samples", 0)) for r in records
+                   if r.get("event") == "perfdb_append")
+        if n_db:
+            parts.append(f"perfdb_samples={n_db}")
+        lines.append("  ".join(parts))
     if ingest:
         # one line per ingest (streamed/cached dataset build): source,
         # chunk arithmetic, the bounded-residency watermark, cache hit

@@ -1,0 +1,114 @@
+"""Query the shape-keyed performance database (obs/perfdb.py).
+
+The perfdb is the append-only JSONL of measured per-executable device
+times that profile-window closes, ``bench.py`` and
+``scripts/ablate_hist.py`` accumulate (``perf_db=<path>``).  This CLI
+is the read side an operator (or the item-5 autotuner, interactively)
+uses:
+
+    # per-key summaries: sample counts, mean/min/max measured device
+    # time per dispatch, best achieved rates
+    python scripts/perfdb_query.py perf.jsonl
+
+    # filter by key fields — full signature, its pre-'[' base, kind,
+    # shape class, backend, quant bits, or a specific key_id
+    python scripts/perfdb_query.py perf.jsonl --kind megastep \
+        --backend cpu --shape-class r1024.f6.b63
+
+    # raw matching rows instead of summaries (newest last), as JSON
+    python scripts/perfdb_query.py perf.jsonl --rows --json
+
+Exit status 1 when nothing matches, so shell pipelines can gate on
+"do we have a measured baseline for this shape yet".
+docs/Observability.md §15 documents the row schema.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.obs import perfdb  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="query the shape-keyed perf database "
+                    "(obs/perfdb.py JSONL)")
+    ap.add_argument("path", help="perf database file (perf_db=<path>)")
+    ap.add_argument("--signature", default="",
+                    help="full signature or its pre-'[' base "
+                         "(e.g. 'megastep')")
+    ap.add_argument("--kind", default="",
+                    help="executable kind (megastep/fast_step/"
+                         "serve_bucket)")
+    ap.add_argument("--shape-class", default="", dest="shape_class")
+    ap.add_argument("--backend", default="")
+    ap.add_argument("--quant-bits", default="", dest="quant_bits")
+    ap.add_argument("--key-id", default="", dest="key_id")
+    ap.add_argument("--source", default="",
+                    help="writer tag (profile_window/bench/"
+                         "ablate_hist)")
+    ap.add_argument("--rows", action="store_true",
+                    help="print matching rows instead of per-key "
+                         "summaries")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    db = perfdb.PerfDB(args.path)
+    loaded = db.load()
+    rows = db.query(loaded["rows"], signature=args.signature,
+                    kind=args.kind, shape_class=args.shape_class,
+                    backend=args.backend, quant_bits=args.quant_bits,
+                    key_id=args.key_id, source=args.source)
+    if args.rows:
+        if args.as_json:
+            print(json.dumps(rows, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            for row in rows:
+                key = row.get("key", {})
+                print(f"{row.get('key_id', '?')} "
+                      f"{key.get('signature', '?'):48s} "
+                      f"{row.get('device_time_us_per_dispatch', 0):10.3f}"
+                      f" us/disp  x{row.get('dispatches', 0)}  "
+                      f"[{row.get('source', '?')}]")
+    else:
+        summaries = perfdb.summarize(rows)
+        if args.as_json:
+            print(json.dumps(summaries, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            print(f"{len(loaded['rows'])} rows "
+                  f"({loaded['skipped']} skipped), "
+                  f"{len(rows)} matching, "
+                  f"{len(summaries)} keys")
+            for ent in summaries:
+                key = ent.get("key", {})
+                t = ent.get("device_time_us_per_dispatch", {})
+                line = (f"  {ent['key_id']} "
+                        f"{key.get('signature', '?'):44s} "
+                        f"[{key.get('kind', '?')},"
+                        f"{key.get('shape_class', '?')},"
+                        f"{key.get('backend', '?')},"
+                        f"q{key.get('quant_bits', 0)},"
+                        f"w{key.get('world_size', 1)}] "
+                        f"n={ent['samples']}")
+                if t:
+                    line += (f"  {t['mean']:.3f} us/disp "
+                             f"(min {t['min']:.3f}, max {t['max']:.3f}, "
+                             f"last {t['last']:.3f})")
+                if ent.get("achieved_flops_per_s_best") is not None:
+                    line += (f"  best "
+                             f"{ent['achieved_flops_per_s_best']:.3e} "
+                             f"flop/s")
+                print(line)
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

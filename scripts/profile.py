@@ -1,6 +1,6 @@
 """On-chip profiling entry points, consolidated.
 
-Two modes behind one documented wrapper (they used to live in
+Three modes behind one documented wrapper (iter/micro used to live in
 ``profile_iter.py`` / ``profile_micro.py``, which drifted apart):
 
     # per-phase wall timing of one fused-engine boosting iteration,
@@ -13,11 +13,19 @@ Two modes behind one documented wrapper (they used to live in
     # dispatch latency
     python scripts/profile.py micro
 
-For profiling a LIVE training job, neither is the tool: set
-``metrics_port=<p>`` and ``POST /profile?iters=N`` against the running
-process — the driver captures a bounded ``jax.profiler`` trace at its
-next drain boundary without restarting the job (docs/Observability.md
-§12).
+    # parse a captured jax.profiler trace dir (a profile_dir config
+    # window or a POST /profile capture) via obs/kernelstats.py and
+    # print the top-K kernels by measured device time, joined to
+    # their cost-ledger signatures when --telemetry points at the
+    # run's JSONL — no TensorBoard needed (docs/Observability.md §15)
+    python scripts/profile.py summarize /tmp/prof \
+        [--telemetry run.jsonl] [--top 10] [--json]
+
+For profiling a LIVE training job, the capture side is neither bench:
+set ``metrics_port=<p>`` and ``POST /profile?iters=N`` against the
+running process — the driver captures a bounded ``jax.profiler`` trace
+at its next drain boundary without restarting the job
+(docs/Observability.md §12), then ``summarize`` reads it back.
 """
 from __future__ import annotations
 
@@ -209,16 +217,102 @@ def main_micro() -> None:
         print(f"{k:36s} {v if isinstance(v, str) else round(v, 3)}")
 
 
+# ------------------------------------------------------- summarize mode
+def main_summarize(argv) -> int:
+    """Parse a profile dir (obs/kernelstats.py) and print the top-K
+    kernels and per-executable measured device times, joined to
+    cost-ledger signatures when a telemetry JSONL is given.  Host-side
+    stdlib parsing only — runs anywhere, no TensorBoard."""
+    import argparse
+    import json
+
+    from lightgbm_tpu.obs import kernelstats
+
+    ap = argparse.ArgumentParser(
+        prog="profile.py summarize",
+        description="summarize a jax.profiler trace dir")
+    ap.add_argument("dir", help="profile dir (the profile_dir config "
+                                "window or POST /profile target)")
+    ap.add_argument("--telemetry", default="",
+                    help="telemetry_out JSONL of the same run — joins "
+                         "kernels to cost/compile signatures")
+    ap.add_argument("--top", type=int, default=10,
+                    help="top-K kernels/executables to print")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full roofline record as JSON")
+    args = ap.parse_args(argv)
+
+    cost = compiles = None
+    if args.telemetry:
+        events = []
+        with open(args.telemetry) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    pass
+        cost, compiles = kernelstats.cost_entries_from_events(events)
+    roof = kernelstats.roofline_from_dir(args.dir, cost_entries=cost,
+                                         compile_entries=compiles,
+                                         top=args.top)
+    if args.as_json:
+        print(json.dumps(roof, indent=1, sort_keys=True, default=str))
+        return 0
+    print(f"trace dir: {args.dir}")
+    print(f"  files parsed: {roof['parsed_files']}/{roof['trace_files']}"
+          f"  ({roof['trace_bytes']} bytes, "
+          f"{roof['parse_errors']} errors)")
+    print(f"  anchor dispatches: {roof['anchor_dispatches']}  "
+          f"join coverage: {roof['join_coverage']:.3f}  "
+          f"device time: {roof['total_device_time_us']:.1f} us "
+          f"(+{roof['unattributed_time_us']:.1f} us unattributed)")
+    for err in roof.get("errors", []):
+        print(f"  ! {err}")
+    if roof["executables"]:
+        print("executables (by measured device time):")
+    for ex in roof["executables"][:args.top]:
+        sig = ex.get("signature") or f"<unjoined:{ex['kind']}>"
+        per = ex.get("device_time_us_per_dispatch")
+        frac = ex.get("measured_fraction")
+        line = (f"  {sig:48s} {ex['device_time_us']:10.1f} us  "
+                f"x{ex['dispatches']}")
+        if per is not None:
+            line += f"  {per:9.1f} us/disp"
+        if frac is not None:
+            line += f"  frac={frac:.3f}"
+        if ex.get("achieved_flops_per_s") is not None:
+            line += (f"  {ex['achieved_flops_per_s']:.3e} flop/s"
+                     f"  {ex['achieved_bytes_per_s']:.3e} B/s")
+        print(line)
+        for k in ex.get("top_kernels", [])[:3]:
+            print(f"      {k['name']:44s} {k['time_us']:10.1f} us  "
+                  f"x{k['count']}")
+    if roof["kernels"]:
+        print("top kernels (all lanes):")
+    for k in roof["kernels"][:args.top]:
+        print(f"  {k['name']:48s} {k['time_us']:10.1f} us  "
+              f"x{k['count']}")
+    if not args.telemetry:
+        print("(no --telemetry JSONL given: executables stay unjoined; "
+              "pass the run's telemetry_out file to join signatures)")
+    return 0
+
+
 def main() -> int:
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "iter":
         main_iter()
     elif mode == "micro":
         main_micro()
+    elif mode == "summarize":
+        return main_summarize(sys.argv[2:])
     else:
         print(__doc__)
-        print("usage: python scripts/profile.py {iter|micro}",
-              file=sys.stderr)
+        print("usage: python scripts/profile.py "
+              "{iter|micro|summarize <dir>}", file=sys.stderr)
         return 2
     return 0
 

@@ -18,9 +18,20 @@ threshold — flagged timings are informational unless
 clean and per-call wall time between identical runs crosses any
 usable threshold on scheduler noise alone.
 
+The roofline plane rides the same gate two ways: report-vs-report, the
+candidate's MEASURED per-executable device time per dispatch
+(``roofline`` report section, obs/kernelstats.py) diffs against the
+baseline's under the loose threshold and joins the hard regressions;
+and ``--perf-db <path>`` additionally checks the candidate against the
+accumulated measured history in the shape-keyed perf database
+(obs/perfdb.py) — a signature whose measured time slipped past the
+threshold vs its db mean flags even when the baseline report predates
+the roofline section.
+
 Usage:
     python scripts/run_diff.py baseline.json candidate.json \
-        [--threshold 0.15] [--det-threshold 0.05] [--fail-on-regress]
+        [--threshold 0.15] [--det-threshold 0.05] [--fail-on-regress] \
+        [--perf-db perf.jsonl]
 
 Exit codes: 0 clean (identical runs compare clean by construction),
 1 regressions flagged under ``--fail-on-regress``, 2 the reports are
@@ -51,6 +62,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "counters (no wall-clock noise)")
     ap.add_argument("--fail-on-regress", action="store_true",
                     help="exit 1 when a regression is flagged")
+    ap.add_argument("--perf-db", default="", dest="perf_db",
+                    help="shape-keyed perf database (obs/perfdb.py "
+                         "JSONL): also flag candidate roofline "
+                         "executables whose measured device time per "
+                         "dispatch regressed past --threshold vs "
+                         "their accumulated db mean")
     ap.add_argument("--fail-on-timing", action="store_true",
                     help="let flagged wall-timing swings fail the run "
                          "too (off by default: scheduler noise between "
@@ -69,6 +86,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep = compare_reports(prev, cur, threshold=args.threshold,
                           det_threshold=args.det_threshold,
                           fail_on_timing=args.fail_on_timing)
+    if args.perf_db:
+        # measured-history gate: each candidate roofline executable vs
+        # the mean of its accumulated perfdb samples for the same
+        # signature — catches a slow drift no single baseline report
+        # would show
+        from lightgbm_tpu.obs import perfdb
+        db_rows = perfdb.PerfDB(args.perf_db).load()["rows"]
+        rep.setdefault("perf_db", [])
+        for ex in (cur.get("roofline", {}) or {}).get(
+                "executables", []) or []:
+            sig = ex.get("signature")
+            per = ex.get("device_time_us_per_dispatch")
+            if not sig or not isinstance(per, (int, float)) or per <= 0:
+                continue
+            hist = [float(r["device_time_us_per_dispatch"])
+                    for r in db_rows
+                    if (r.get("key", {}) or {}).get("signature") == sig
+                    and isinstance(r.get("device_time_us_per_dispatch"),
+                                   (int, float))]
+            if not hist:
+                continue
+            base = sum(hist) / len(hist)
+            ratio = float(per) / base if base > 0 else None
+            ent = {"name": f"perfdb:{sig}", "prev": round(base, 3),
+                   "cur": round(float(per), 3),
+                   "ratio": round(ratio, 4) if ratio else None,
+                   "samples": len(hist),
+                   "regressed": bool(ratio
+                                     and ratio > 1.0 + args.threshold)}
+            rep["perf_db"].append(ent)
+            if ent["regressed"]:
+                rep["regressions"].append(ent)
     print(json.dumps(rep))
     if rep["status"] != "ok":
         print(f"run_diff: not comparable ({rep['status']})",

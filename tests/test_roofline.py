@@ -1,0 +1,350 @@
+"""Roofline plane: trace parsing, cost joins, the perf database.
+
+Covers obs/kernelstats.py (malformed Chrome-trace inputs must degrade
+to error entries, never exceptions; synthetic traces must attribute
+kernel time to anchor spans and join the cost ledger), obs/perfdb.py
+(atomic append, schema-gated load, cross-run accumulation), the report
+integration (roofline section, decrease-only join-coverage gate,
+measured device-time regressions), and one end-to-end CPU train that
+closes a real ``profile_dir`` window into joined executables and a
+populated perf database row.
+"""
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import kernelstats, perfdb
+from lightgbm_tpu.obs.report import build_report, compare_reports
+
+_FUSED = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "learning_rate": 0.2, "min_data_in_leaf": 5, "verbose": -1,
+          "metric": "None", "tpu_engine": "fused", "tpu_megastep": True}
+
+
+def _data(n=600, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X @ rng.randn(f).astype(np.float32) > 0).astype(np.float32)
+    return X, y
+
+
+def _ds(X, y):
+    return lgb.Dataset(X, label=y, params={"max_bin": 63, "verbose": -1})
+
+
+def _write_trace(root, payload, name="host.trace.json.gz"):
+    d = os.path.join(root, "plugins", "profile", "2026_01_01_00_00_00")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, name)
+    if isinstance(payload, bytes):
+        with open(path, "wb") as fh:
+            fh.write(payload)
+    else:
+        with gzip.open(path, "wb") as fh:
+            fh.write(json.dumps(payload).encode())
+    return path
+
+
+def _synthetic_events():
+    """One megastep anchor (0..1000us) with two overlapping kernels
+    inside it, one kernel outside it, runtime noise, and python
+    frames."""
+    return [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "python"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient/2"}},
+        # kernels FIRST in the stream: attribution must not depend on
+        # event order (the two-pass contract)
+        {"ph": "X", "pid": 1, "tid": 2, "name": "dot.3",
+         "ts": 100.0, "dur": 200.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "reduce.8",
+         "ts": 250.0, "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1",
+         "ts": 2000.0, "dur": 50.0},
+        {"ph": "X", "pid": 1, "tid": 2,
+         "name": "ThunkExecutor::Execute", "ts": 150.0, "dur": 500.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "$foo.py:1 bar",
+         "ts": 120.0, "dur": 10.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "megastep",
+         "ts": 0.0, "dur": 1000.0},
+    ]
+
+
+_COST = [{"event": "cost_executable", "kind": "megastep",
+          "signature": "megastep[chunk=2,k=1,eval=False]", "scale": 2,
+          "flops": 1.0e6, "hlo_bytes": 2.0e6, "operand_bytes": 4096}]
+_COMPILE = [{"event": "compile_executable",
+             "signature": "megastep[chunk=2,k=1,eval=False]",
+             "compile_ms": 5.0, "operand_bytes": 4096}]
+
+
+# ------------------------------------------------------------ parsing
+class TestParseMalformed:
+    def test_missing_dir(self, tmp_path):
+        roof = kernelstats.roofline_from_dir(str(tmp_path / "nope"))
+        assert roof["join_coverage"] == 0.0
+        assert roof["trace_files"] == 0
+
+    def test_truncated_gzip(self, tmp_path):
+        good = gzip.compress(json.dumps(
+            {"traceEvents": _synthetic_events()}).encode())
+        _write_trace(str(tmp_path), good[:len(good) // 3])
+        roof = kernelstats.roofline_from_dir(str(tmp_path))
+        assert roof["parse_errors"] == 1
+        assert roof["join_coverage"] == 0.0
+
+    def test_empty_file(self, tmp_path):
+        _write_trace(str(tmp_path), b"")
+        roof = kernelstats.roofline_from_dir(str(tmp_path))
+        assert roof["parse_errors"] == 1
+
+    def test_not_json(self, tmp_path):
+        _write_trace(str(tmp_path), gzip.compress(b"hello world"))
+        roof = kernelstats.roofline_from_dir(str(tmp_path))
+        assert roof["parse_errors"] == 1
+        assert "not JSON" in roof["errors"][0]
+
+    def test_missing_trace_events(self, tmp_path):
+        _write_trace(str(tmp_path), {"metadata": {}})
+        roof = kernelstats.roofline_from_dir(str(tmp_path))
+        assert roof["parse_errors"] == 1
+        assert "traceEvents" in roof["errors"][0]
+
+    def test_bad_mixed_with_good(self, tmp_path):
+        _write_trace(str(tmp_path), gzip.compress(b"junk"),
+                     name="a.trace.json.gz")
+        _write_trace(str(tmp_path),
+                     {"traceEvents": _synthetic_events()},
+                     name="b.trace.json.gz")
+        roof = kernelstats.roofline_from_dir(str(tmp_path),
+                                             cost_entries=_COST)
+        assert roof["parse_errors"] == 1
+        assert roof["join_coverage"] == 1.0
+
+
+class TestAttribution:
+    def test_anchor_kernels_union_overlap(self, tmp_path):
+        _write_trace(str(tmp_path), {"traceEvents": _synthetic_events()})
+        st = kernelstats.parse_profile_dir(str(tmp_path))
+        assert st["anchors"]["megastep"]["dispatches"] == 1
+        assert st["anchors"]["megastep"]["host_time_us"] == 1000.0
+        bk = st["by_kind"]["megastep"]
+        # dot.3 (100..300) + reduce.8 (250..350): sum 300, union 250
+        assert bk["kernel_time_us"] == pytest.approx(300.0)
+        assert bk["device_time_us"] == pytest.approx(250.0)
+        assert bk["overlap_us"] == pytest.approx(50.0)
+        # fusion.1 is outside the anchor span
+        assert st["unattributed_time_us"] == pytest.approx(50.0)
+        # runtime noise and python frames never count as kernels
+        assert "ThunkExecutor::Execute" not in st["kernels"]
+        assert "$foo.py:1 bar" not in st["kernels"]
+
+    def test_join_rates_and_compile(self, tmp_path):
+        _write_trace(str(tmp_path), {"traceEvents": _synthetic_events()})
+        roof = kernelstats.roofline_from_dir(
+            str(tmp_path), cost_entries=_COST, compile_entries=_COMPILE)
+        assert roof["join_coverage"] == 1.0
+        assert roof["joined_executables"] == 1
+        ex = roof["executables"][0]
+        assert ex["joined"] and ex["kind"] == "megastep"
+        assert ex["timing_source"] == "kernels"
+        assert ex["device_time_us_per_dispatch"] == pytest.approx(250.0)
+        assert ex["measured_fraction"] == pytest.approx(0.25)
+        # analytic work over measured time: 1e6 flops / 250us
+        assert ex["achieved_flops_per_s"] == pytest.approx(4.0e9)
+        assert ex["achieved_bytes_per_s"] == pytest.approx(8.0e9)
+        assert ex["compile_ms"] == 5.0
+
+    def test_unjoinable_signature_coverage_below_one(self, tmp_path):
+        _write_trace(str(tmp_path), {"traceEvents": _synthetic_events()})
+        roof = kernelstats.roofline_from_dir(
+            str(tmp_path),
+            cost_entries=[{"kind": "fast_step", "signature": "f[k=1]"}])
+        assert roof["join_coverage"] < 1.0
+        ex = roof["executables"][0]
+        assert not ex["joined"] and ex["signature"] is None
+
+    def test_host_span_fallback(self, tmp_path):
+        # anchor with NO kernel events inside: the CPU runtime shape —
+        # per-dispatch timing falls back to the host span, labeled
+        evs = [e for e in _synthetic_events()
+               if e.get("tid") != 2 or e.get("ph") == "M"]
+        _write_trace(str(tmp_path), {"traceEvents": evs})
+        roof = kernelstats.roofline_from_dir(str(tmp_path),
+                                             cost_entries=_COST)
+        ex = roof["executables"][0]
+        assert ex["timing_source"] == "host_span"
+        assert ex["device_time_us_per_dispatch"] == pytest.approx(1000.0)
+        assert ex["device_time_us"] == 0.0
+
+    def test_cost_entries_from_events(self):
+        evs = _COST + _COMPILE + [{"event": "roofline"}]
+        cost, compiles = kernelstats.cost_entries_from_events(evs)
+        assert len(cost) == 1 and len(compiles) == 1
+
+
+# ------------------------------------------------------------- perfdb
+class TestPerfDB:
+    def test_key_identity(self):
+        k1 = perfdb.make_key("m[c=2]", "megastep", "r1024.f6.b63", "cpu")
+        k2 = perfdb.make_key("m[c=2]", "megastep", "r1024.f6.b63", "cpu")
+        k3 = perfdb.make_key("m[c=2]", "megastep", "r2048.f6.b63", "cpu")
+        assert k1["key_id"] == k2["key_id"] != k3["key_id"]
+
+    def test_append_load_accumulate(self, tmp_path):
+        path = str(tmp_path / "perf.jsonl")
+        key = perfdb.make_key("m[c=2]", "megastep", "r1024.f6.b63",
+                              "cpu")
+        db = perfdb.PerfDB(path)
+        for i in range(2):   # two "runs" appending to the same file
+            n = db.append([perfdb.sample(
+                key, dispatches=1,
+                device_time_us_per_dispatch=100.0 + i,
+                source="test")])
+            assert n == 1
+        loaded = db.load()
+        assert len(loaded["rows"]) == 2 and loaded["skipped"] == 0
+        summ = perfdb.summarize(loaded["rows"])
+        assert summ[0]["samples"] == 2
+        assert summ[0]["device_time_us_per_dispatch"]["mean"] == \
+            pytest.approx(100.5)
+
+    def test_load_skips_malformed_and_foreign(self, tmp_path):
+        path = str(tmp_path / "perf.jsonl")
+        key = perfdb.make_key("m", "megastep", "s", "cpu")
+        perfdb.PerfDB(path).append([perfdb.sample(
+            key, dispatches=1, device_time_us_per_dispatch=1.0)])
+        with open(path, "a") as fh:
+            fh.write("{not json\n")
+            fh.write(json.dumps({"schema": "other.format/9"}) + "\n")
+        loaded = perfdb.PerfDB(path).load()
+        assert len(loaded["rows"]) == 1 and loaded["skipped"] == 2
+
+    def test_append_never_raises(self, tmp_path):
+        # a directory as the db path: open fails, append returns 0
+        assert perfdb.PerfDB(str(tmp_path)).append(
+            [{"schema": perfdb.SCHEMA}]) == 0
+        assert perfdb.PerfDB(str(tmp_path / "x.jsonl")).append([]) == 0
+
+    def test_query_filters(self, tmp_path):
+        path = str(tmp_path / "perf.jsonl")
+        db = perfdb.PerfDB(path)
+        for sig, kind in (("megastep[chunk=2]", "megastep"),
+                          ("serve[stacked,bucket=1]", "serve_bucket")):
+            db.append([perfdb.sample(
+                perfdb.make_key(sig, kind, "s1", "cpu"), dispatches=1,
+                device_time_us_per_dispatch=1.0, source="test")])
+        assert len(db.query(kind="megastep")) == 1
+        # signature matches the pre-'[' base too
+        assert len(db.query(signature="serve")) == 1
+        assert len(db.query(signature="megastep[chunk=2]")) == 1
+        assert len(db.query(kind="fast_step")) == 0
+
+    def test_samples_from_roofline_skips_unjoined(self):
+        roof = {"executables": [
+            {"joined": True, "signature": "m[c=2]", "kind": "megastep",
+             "dispatches": 2, "device_time_us_per_dispatch": 50.0,
+             "timing_source": "kernels"},
+            {"joined": False, "signature": None, "kind": "fast_step",
+             "dispatches": 1, "device_time_us_per_dispatch": 10.0},
+        ]}
+        rows = perfdb.samples_from_roofline(
+            roof, shape_class="s", backend="cpu", source="test")
+        assert len(rows) == 1
+        assert rows[0]["key"]["signature"] == "m[c=2]"
+        assert rows[0]["timing_source"] == "kernels"
+
+
+# ---------------------------------------------------- report integration
+def _report(cov, per_disp):
+    roof = {"join_coverage": cov, "joined_executables": 1,
+            "anchor_dispatches": 1, "total_device_time_us": per_disp,
+            "executables": [
+                {"kind": "megastep", "signature": "m[c=2]",
+                 "joined": True, "dispatches": 1,
+                 "device_time_us": per_disp,
+                 "device_time_us_per_dispatch": per_disp,
+                 "measured_fraction": 0.5}],
+            "kernels": []}
+    return build_report({"counters": {"iterations": 8},
+                         "gauges": {}}, roofline=roof)
+
+
+class TestReportIntegration:
+    def test_roofline_section(self):
+        rep = _report(1.0, 100.0)
+        assert rep["roofline"]["join_coverage"] == 1.0
+        assert rep["roofline"]["executables"][0]["signature"] == "m[c=2]"
+
+    def test_identical_reports_compare_clean(self):
+        rep = _report(1.0, 100.0)
+        cmp = compare_reports(rep, rep)
+        assert cmp["status"] == "ok" and not cmp["regressions"]
+
+    def test_coverage_drop_flags_rise_does_not(self):
+        cmp = compare_reports(_report(1.0, 100.0), _report(0.5, 100.0))
+        assert any(e["name"] == "roofline.join_coverage"
+                   for e in cmp["regressions"])
+        cmp = compare_reports(_report(0.5, 100.0), _report(1.0, 100.0))
+        assert not any(e["name"] == "roofline.join_coverage"
+                       for e in cmp["regressions"])
+
+    def test_measured_device_time_regression(self):
+        cmp = compare_reports(_report(1.0, 100.0), _report(1.0, 300.0),
+                              threshold=0.5)
+        assert any(e["name"] == "roofline:m[c=2]"
+                   for e in cmp["regressions"])
+        cmp = compare_reports(_report(1.0, 100.0), _report(1.0, 101.0),
+                              threshold=0.5)
+        assert not cmp["regressions"]
+
+
+# ------------------------------------------------------------------ e2e
+def test_profile_window_roofline_e2e(tmp_path):
+    """A CPU fused-megastep train with a ``profile_dir`` config window
+    and ``perf_db`` set: the window close must parse the trace, join
+    >= 1 executable at full coverage, record the trace-size gauges,
+    surface the roofline in the run report, and append a measured
+    sample to the perf database."""
+    X, y = _data()
+    prof = str(tmp_path / "prof")
+    dbpath = str(tmp_path / "perf.jsonl")
+    bst = lgb.train(dict(_FUSED, tpu_megastep_iters=4,
+                         telemetry_out=str(tmp_path / "tel.jsonl"),
+                         profile_dir=prof, perf_db=dbpath),
+                    _ds(X, y), num_boost_round=8)
+    snap = bst.telemetry()
+    g = snap.get("gauges", {})
+    # the satellite fix: a window close records what it captured
+    assert g.get("profile.trace_files", 0) >= 1
+    assert g.get("profile.trace_bytes", 0) > 0
+    assert g.get("roofline.join_coverage") == 1.0
+    assert g.get("roofline.joined_executables", 0) >= 1
+    assert snap["counters"].get("perfdb.samples_written", 0) >= 1
+    roof = bst._gbdt._roofline_last
+    ex = [r for r in roof["executables"] if r["joined"]]
+    assert ex and ex[0]["kind"] == "megastep"
+    assert ex[0]["device_time_us_per_dispatch"] > 0
+    assert ex[0]["achieved_flops_per_s"] > 0
+    # the roofline event (obs_tail's source) made it to the JSONL sink
+    events = [json.loads(line)
+              for line in open(str(tmp_path / "tel.jsonl"))]
+    roofs = [e for e in events if e.get("event") == "roofline"]
+    assert roofs and roofs[-1]["join_coverage"] == 1.0
+    # the run report carries the roofline section
+    rep = bst._gbdt.build_run_report()
+    assert rep["roofline"]["join_coverage"] == 1.0
+    # the perf database accumulated a measured sample for this shape
+    loaded = perfdb.PerfDB(dbpath).load()
+    assert loaded["rows"], "perfdb row missing"
+    row = loaded["rows"][-1]
+    assert row["key"]["kind"] == "megastep"
+    assert row["device_time_us_per_dispatch"] > 0
+    assert row["key"]["backend"] == "cpu"
